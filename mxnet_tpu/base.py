@@ -1,0 +1,61 @@
+"""Base types and helpers for the TPU-native MXNet-style framework.
+
+The reference framework's base layer (``include/mxnet/base.h``) supplies Context,
+TShape and error types to every other layer.  Here the analogous primitives are
+thin wrappers over JAX: shapes are plain tuples, dtypes are numpy dtypes, and
+errors are Python exceptions (the reference's dmlc ``LOG(FATAL)``/``MXGetLastError``
+thread-local error stack collapses into ordinary exception propagation, since
+there is no C ABI boundary to cross in the hot path).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "classproperty", "data_dir"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by framework internals.
+
+    Mirrors the role of ``MXGetLastError`` in the reference C API
+    (src/c_api/c_api.cc) — but since we never cross a C ABI for dispatch,
+    a plain exception suffices.
+    """
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+def data_dir():
+    """Default data directory (~/.mxnet), mirroring python/mxnet/base.py data_dir."""
+    import os
+    return os.environ.get("MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet"))
+
+
+class classproperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, owner_self, owner_cls):
+        return self.fget(owner_cls)
+
+
+def _make_hashable(v):
+    """Canonicalise an attribute value into a hashable jit-cache key component."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_make_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _make_hashable(x)) for k, x in v.items()))
+    if isinstance(v, _np.dtype):
+        return v.name
+    if isinstance(v, _np.ndarray):
+        return (v.shape, v.dtype.name, v.tobytes())
+    return v
+
+
+def attrs_key(attrs):
+    """Stable hashable key for an op attribute dict (jit-cache key)."""
+    return tuple(sorted((k, _make_hashable(v)) for k, v in attrs.items()))

@@ -1,0 +1,150 @@
+"""CachedOp: whole-graph compilation of an imperative forward.
+
+Reference: src/imperative/cached_op.{cc,h} — Gluon ``hybridize()`` traces the
+python forward once into an NNVM graph and replays it with pre-planned memory
+(StaticForward) or re-inferred shapes (DynamicForward); registered as the
+``_CachedOp`` op so the whole call is one node on the autograd tape.
+
+TPU-native redesign: the python forward runs once under ``jax.jit`` tracing —
+NDArray handles wrap tracers, every registered op applies its jax fcompute, and
+XLA compiles the entire model as ONE module (the reference's whole-graph
+executor + memory planner + op bulking, all in the compiler).  Notes:
+
+  * static_alloc/static_shape ≙ XLA buffer assignment + (optionally) donation;
+    there is no dynamic path to choose — shapes are static per compiled
+    signature, and a new input signature triggers a cached recompile (the
+    analog of bucketed DynamicForward).
+  * training vs inference are two cache entries (mode changes dropout/BN).
+  * aux state (BatchNorm running stats) is threaded functionally: mutations
+    layers make to aux NDArray handles during the trace are captured as extra
+    outputs and written back after the call.
+  * under autograd.record() the call runs via jax.vjp over the jitted
+    function, and ONE tape node carries the precomputed compiled vjp —
+    exactly mirroring ``_CachedOp``'s single-node recording (cached_op.cc:228).
+"""
+from __future__ import annotations
+
+from . import autograd
+from .base import MXNetError
+
+__all__ = ["CachedOp"]
+
+
+class CachedOp:
+    def __init__(self, forward_fn, param_dict, aux_names=(), flags=None):
+        """
+        forward_fn(params: dict name->NDArray, *inputs: NDArray) -> NDArray or
+            list/tuple of NDArray.  Must be jax-traceable (the gluon
+            hybrid_forward path is).
+        param_dict: dict name -> NDArray handle (live parameter storage).
+        aux_names: parameter names whose mutation during forward must be
+            captured and written back (BatchNorm running stats).
+        """
+        self._forward_fn = forward_fn
+        self._param_names = sorted(param_dict.keys())
+        self._aux_names = [n for n in self._param_names if n in set(aux_names)]
+        self._flags = dict(flags or {})
+        self._jitted = {}          # training(bool) -> jitted fn
+        self._out_tree = None      # 'single' | 'list'
+
+    # ------------------------------------------------------------------
+    def _make_traced(self, training):
+        from .ndarray import NDArray
+        forward_fn = self._forward_fn
+        names = self._param_names
+        aux_names = self._aux_names
+        n_params = len(names)
+
+        def traced(*vals):
+            # vals = param vals (ordered) + input vals + (rng_key,)
+            key = vals[-1]
+            param_vals = vals[:n_params]
+            input_vals = vals[n_params:-1]
+            param_nds = {n: NDArray(v) for n, v in zip(names, param_vals)}
+            input_nds = [NDArray(v) for v in input_vals]
+            from . import random as _random
+            with autograd._RecordingStateScope(False, training), \
+                    _random.key_override(key):
+                out = forward_fn(param_nds, *input_nds)
+            if isinstance(out, (list, tuple)):
+                outs = list(out)
+                self._out_tree = "list"
+            else:
+                outs = [out]
+                self._out_tree = "single"
+            out_vals = tuple(o._data for o in outs)
+            aux_vals = tuple(param_nds[n]._data for n in aux_names)
+            return out_vals + aux_vals
+
+        return traced
+
+    def _get_jitted(self, training):
+        fn = self._jitted.get(training)
+        if fn is None:
+            import jax
+            fn = jax.jit(self._make_traced(training))
+            self._jitted[training] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def __call__(self, param_dict, *inputs):
+        import jax
+        from .ndarray import NDArray, _wrap
+        from . import random as _random
+
+        training = autograd.is_training()
+        recording = autograd.is_recording()
+        param_handles = [param_dict[n] for n in self._param_names]
+        param_vals = [p._data for p in param_handles]
+        input_vals = [x._data for x in inputs]
+        key = _random.next_key()
+        vals = tuple(param_vals) + tuple(input_vals) + (key,)
+        ctx = inputs[0].context if inputs else param_handles[0].context
+
+        jitted = self._get_jitted(training)
+        n_aux = len(self._aux_names)
+
+        if recording:
+            flat_out, vjp_fn = jax.vjp(jitted, *vals)
+        else:
+            flat_out = jitted(*vals)
+            vjp_fn = None
+
+        if n_aux:
+            out_vals = flat_out[:-n_aux]
+            aux_vals = flat_out[-n_aux:]
+        else:
+            out_vals, aux_vals = flat_out, ()
+
+        outputs = [_wrap(v, ctx=ctx) for v in out_vals]
+        aux_outputs = [_wrap(v, ctx=ctx) for v in aux_vals]
+
+        # write updated aux state back into the live parameters
+        if training and n_aux:
+            with autograd.pause():
+                for name, v in zip(self._aux_names, aux_vals):
+                    param_dict[name]._set_data(v)
+
+        if recording:
+            autograd.record_op(
+                None, list(param_handles) + list(inputs),
+                outputs + aux_outputs, name="_CachedOp",
+                vjp_fn=_VjpAdapter(vjp_fn, len(vals) - 1),
+                primals_out=tuple(flat_out))
+            # patch: record_op stored fn=None; backward uses vjp_fn
+        if self._out_tree == "single":
+            return outputs[0]
+        return outputs
+
+
+class _VjpAdapter:
+    """Adapt jax vjp over (params..., inputs..., key) to the tape's
+    (params..., inputs...) cotangent contract by dropping the key cotangent."""
+
+    def __init__(self, vjp_fn, n_real_inputs):
+        self._vjp_fn = vjp_fn
+        self._n = n_real_inputs
+
+    def __call__(self, out_cts):
+        in_cts = self._vjp_fn(out_cts)
+        return in_cts[:self._n]

@@ -1,0 +1,121 @@
+"""Device context.
+
+Reference: ``include/mxnet/base.h:135-139`` defines Context with device types
+kCPU/kGPU/kCPUPinned/kCPUShared; ``python/mxnet/context.py`` exposes
+``mx.cpu()``/``mx.gpu()`` and a thread-local current-context stack.
+
+TPU-native redesign: a Context names a JAX device.  ``mx.tpu(i)`` is the
+first-class accelerator; ``mx.gpu(i)`` is kept as a compatibility alias that
+resolves to the i-th accelerator so reference scripts run unchanged.  There is
+no pinned/shared distinction — host staging is managed by XLA transfers and
+DataLoader workers ship numpy through shared memory at the Python level.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+_context_stack = threading.local()
+
+
+class Context:
+    """A device context.  devtype in {'cpu', 'tpu'}; 'gpu' aliases 'tpu'."""
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 5: "cpu_shared"}
+    devstr2type = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    # --- JAX resolution -------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (lazily; may fall back to cpu)."""
+        import jax
+        if self.device_type == "cpu" or self.device_typeid in (3, 5):
+            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            return devs[min(self.device_id, len(devs) - 1)]
+        # accelerator ('tpu' or legacy 'gpu' alias)
+        accel = _accel_devices()
+        if not accel:  # no accelerator present (test / CI): fall back to default
+            devs = jax.devices()
+            return devs[min(self.device_id, len(devs) - 1)]
+        return accel[min(self.device_id, len(accel) - 1)]
+
+
+def _has_platform(name):
+    import jax
+    try:
+        return bool(jax.devices(name))
+    except RuntimeError:
+        return False
+
+
+def _accel_devices():
+    import jax
+    devs = jax.devices()
+    return [d for d in devs if d.platform != "cpu"]
+
+
+Context._default_ctx.value = Context("cpu", 0)
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias: resolves to the i-th accelerator (TPU) device."""
+    return Context("tpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def current_context():
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value
+
+
+def num_gpus():
+    return num_tpus()
+
+
+def num_tpus():
+    return len(_accel_devices())

@@ -1,0 +1,7 @@
+"""Contrib: control flow, quantization, text utils, ONNX (reference:
+python/mxnet/contrib/)."""
+from . import ndarray
+from . import control_flow
+from .control_flow import foreach, while_loop, cond
+from . import autograd  # old-API shim
+from . import quantization

@@ -1,0 +1,9 @@
+"""Old contrib autograd API (reference python/mxnet/contrib/autograd.py) —
+thin aliases over mxnet_tpu.autograd."""
+from ..autograd import (record as train_section, pause as test_section,  # noqa: F401
+                        backward, grad, mark_variables, set_recording,
+                        set_training)
+
+
+def compute_gradient(outputs):
+    backward(outputs)

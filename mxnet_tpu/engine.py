@@ -1,0 +1,34 @@
+"""Engine-semantics shims.
+
+Reference: src/engine/ — the async dependency scheduler (ThreadedEngine with
+versioned vars, threaded_engine.cc:51-142) plus the python ``mx.engine.bulk``
+bulking context (python/mxnet/engine.py).
+
+TPU-native: XLA's async dispatch provides the engine's semantics — every op
+call returns before the device finishes, ordering is by data dependence, and
+reads synchronize (``NDArray.wait_to_read`` = ``block_until_ready``).  Bulking
+(batching many small ops into one engine segment, threaded_engine.h:411) is
+superseded by jit: the ``bulk`` context is kept as API but XLA fusion already
+bulk-compiles any jitted region.  ``set_bulk_size`` is accepted and recorded
+for compatibility."""
+from __future__ import annotations
+
+import contextlib
+
+_bulk_size = 15
+
+
+def set_bulk_size(size):
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,211 @@
+"""Executor: compiled evaluation of a Symbol graph.
+
+Reference: src/executor/graph_executor.cc — GraphExecutor::Init runs nnvm
+passes (shape/type infer, PlanMemory, AttachOpExecs, InitCachedOps) then
+Forward/Backward replay cached engine ops (:64-93, :1318).
+
+TPU-native: "Init" = trace the DAG into one JAX function; jit compiles the
+whole graph as a single XLA module (forward) and jax.vjp provides backward —
+XLA's buffer assignment replaces PlanMemory, fusion replaces op bulking, and
+donation replaces the shared-memory-pool trick (graph_executor.cc:927).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, _wrap, zeros as nd_zeros
+from .ops.registry import get_op
+from . import autograd
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        from .context import current_context
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self.arg_names, args))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self.aux_names, aux_states))
+        self.arg_dict = dict(args)
+        self.aux_dict = dict(aux_states or {})
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self.arg_names, args_grad))
+        self.grad_dict = dict(args_grad) if args_grad else {}
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self.grad_req = dict(grad_req)
+        self.outputs = []
+        self._fwd_train = None
+        self._fwd_infer = None
+        self._vjp = None
+        self._monitor_callback = None
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # ------------------------------------------------------------------
+    def _build_fn(self, is_train):
+        """Trace the DAG into fn(arg_vals_list, aux_vals_list, keys) -> outs."""
+        sym = self._symbol
+        nodes = sym._topo_nodes()
+        arg_order = {n: i for i, n in enumerate(self.arg_names)}
+        aux_order = {n: i for i, n in enumerate(self.aux_names)}
+        rng_nodes = [n for n in nodes
+                     if n.op is not None and get_op(n.op).needs_rng]
+        rng_index = {id(n): i for i, n in enumerate(rng_nodes)}
+
+        def fn(arg_vals, aux_vals, keys):
+            env = {}
+            for n in nodes:
+                if n.op is None:
+                    if n.attrs.get("__is_aux__"):
+                        env[(id(n), 0)] = aux_vals[aux_order[n.name]]
+                    else:
+                        env[(id(n), 0)] = arg_vals[arg_order[n.name]]
+                    continue
+                op = get_op(n.op)
+                attrs = {k: v for k, v in n.attrs.items()
+                         if not k.startswith("__")}
+                if op.mode_dependent:
+                    attrs["_training"] = is_train
+                if op.needs_rng:
+                    attrs["_rng_key"] = keys[rng_index[id(n)]]
+                in_vals = [env[(id(inp), idx)] for (inp, idx) in n.inputs]
+                out = op.fcompute(attrs, *in_vals)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                for i, o in enumerate(outs):
+                    env[(id(n), i)] = o
+            return [env[(id(n), idx)] for (n, idx) in sym._entries]
+
+        self._n_rng = len(rng_nodes)
+        return fn
+
+    def _keys(self):
+        import jax
+        from . import random as _random
+        if self._n_rng == 0:
+            import jax.numpy as jnp
+            return jnp.zeros((1, 2), dtype=jnp.uint32)
+        return jax.numpy.stack([_random.next_key() for _ in range(self._n_rng)])
+
+    def forward(self, is_train=False, **kwargs):
+        import jax
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(v._data if isinstance(v, NDArray)
+                                           else jax.numpy.asarray(v))
+        arg_vals = [self.arg_dict[n]._data for n in self.arg_names]
+        aux_vals = [self.aux_dict[n]._data for n in self.aux_names]
+        if is_train:
+            if self._fwd_train is None:
+                raw = self._build_fn(True)
+                self._raw_train = raw
+            keys = self._keys()
+            # vjp at forward time so backward() can run later
+            wrt_names = [n for n in self.arg_names
+                         if self.grad_req.get(n, "null") != "null"]
+            wrt_idx = [self.arg_names.index(n) for n in wrt_names]
+
+            def f_wrt(*wrt_vals):
+                vals = list(arg_vals)
+                for i, v in zip(wrt_idx, wrt_vals):
+                    vals[i] = v
+                return tuple(self._raw_train(vals, aux_vals, keys))
+
+            outs, vjp = jax.vjp(f_wrt, *[arg_vals[i] for i in wrt_idx])
+            self._vjp = (vjp, wrt_names)
+            self.outputs = [_wrap(o, ctx=self._ctx) for o in outs]
+        else:
+            if self._fwd_infer is None:
+                raw = self._build_fn(False)
+                self._fwd_infer = jax.jit(lambda a, x, k: tuple(raw(a, x, k)))
+                self._raw_infer = raw
+            keys = self._keys()
+            outs = self._fwd_infer(arg_vals, aux_vals, keys)
+            self.outputs = [_wrap(o, ctx=self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        import jax.numpy as jnp
+        if self._vjp is None:
+            raise MXNetError("must call forward(is_train=True) before backward")
+        vjp, wrt_names = self._vjp
+        if out_grads is None:
+            cts = tuple(jnp.ones_like(o._data) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(g._data for g in out_grads)
+        grads = vjp(cts)
+        for name, g in zip(wrt_names, grads):
+            req = self.grad_req.get(name, "write")
+            if req == "null":
+                continue
+            if name not in self.grad_dict or self.grad_dict[name] is None:
+                self.grad_dict[name] = _wrap(g, ctx=self._ctx)
+            elif req == "add":
+                self.grad_dict[name]._set_data(self.grad_dict[name]._data + g)
+            else:
+                self.grad_dict[name]._set_data(g)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor for new input shapes (XLA recompiles per
+        shape; the jit cache keeps previously-seen shapes hot — the analog of
+        GraphExecutor::Reshape, graph_executor.cc:786)."""
+        new_args = {}
+        for n in self.arg_names:
+            if n in kwargs:
+                new_args[n] = nd_zeros(kwargs[n], ctx=self._ctx)
+            else:
+                new_args[n] = self.arg_dict[n]
+        new_grads = None
+        if self.grad_dict:
+            new_grads = {n: nd_zeros(new_args[n].shape, ctx=self._ctx)
+                         for n in self.grad_dict if self.grad_dict[n] is not None}
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self.grad_req, dict(self.aux_dict))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the arguments" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    array.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise ValueError("Find name \"%s\" that is not in the auxiliary "
+                                     "states" % name)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        return "Executor(symbol=%s, args=%s)" % (self._symbol.name, self.arg_names)

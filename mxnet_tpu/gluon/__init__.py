@@ -1,0 +1,11 @@
+"""Gluon: the imperative layer API (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import model_zoo
+from . import utils
+from . import contrib

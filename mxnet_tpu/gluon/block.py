@@ -1,0 +1,510 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: python/mxnet/gluon/block.py — ``Block`` (:127) imperative container
+with prefix/param scoping; ``HybridBlock`` (:673) adds ``hybridize()`` which
+traces the forward into a CachedOp (:787-797); ``SymbolBlock`` (:954) wraps a
+saved symbol graph.
+
+TPU-native: hybridize() compiles the forward (and, under record, its vjp) into
+a single XLA module via mxnet_tpu.cached_op.CachedOp.  ``hybrid_forward`` is
+F-generic exactly like the reference: F=mx.nd eagerly, and the same code also
+builds a Symbol graph (F=mx.sym) for ``export()``/SymbolBlock round-trips.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+import warnings
+from collections import OrderedDict
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray
+from .. import ndarray as nd_mod
+from .. import autograd
+from ..cached_op import CachedOp
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from ..name import NameManager
+
+
+class _BlockScope:
+    """Name scoping for nested blocks (reference block.py:35)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                if not hasattr(NameManager._current, "value"):
+                    NameManager._current.value = NameManager()
+                prefix = NameManager._current.value.get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        self._name_scope = NameManager._current.value.__class__()
+        from ..name import Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (reference block.py:127)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(["  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block)])
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(
+                    value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from {type1} "
+                                "to {type2} is not allowed.".format(
+                                    name=name, type1=type(existing), type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename):
+        params = self._collect_params_with_prefix()
+        from .. import ndarray as nd
+        arg_dict = {key: val._reduce() for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False):
+        from .. import ndarray as nd
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in i for i in loaded.keys()):
+            # legacy collect_params().save format
+            del loaded
+            self.collect_params().load(filename, ctx, allow_missing,
+                                       ignore_extra, self.prefix)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    "Parameter '%s' is missing in file '%s'" % (name, filename)
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise ValueError(
+                    "Parameter '%s' loaded from file '%s' is not present in this "
+                    "block" % (name, filename))
+            if name in params:
+                params[name]._load_init(loaded[name], ctx)
+
+    # compat aliases (reference deprecated names)
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle._id] = hook
+        return handle
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks)
+        self._forward_hooks[handle._id] = hook
+        return handle
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        from ..initializer import Uniform
+        self.collect_params().initialize(init or Uniform(), ctx, verbose,
+                                         force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        summary_lines = []
+        params = self.collect_params()
+        n_params = 0
+        for name, p in params.items():
+            if p.shape and all(s > 0 for s in p.shape):
+                cnt = 1
+                for s in p.shape:
+                    cnt *= s
+                n_params += cnt
+                summary_lines.append("%-60s %s" % (name, str(p.shape)))
+        summary_lines.append("Total params: %d" % n_params)
+        print("\n".join(summary_lines))
+
+
+class _HookHandle:
+    _id_counter = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks_dict = hooks_dict
+        _HookHandle._id_counter += 1
+        self._id = _HookHandle._id_counter
+
+    def detach(self):
+        self._hooks_dict.pop(self._id, None)
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    first = lines.pop(0)
+    lines = [(num_spaces * " ") + line for line in lines]
+    return "\n".join([first] + lines)
+
+
+class HybridBlock(Block):
+    """Block with a compile-on-demand forward (reference block.py:673)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+        self._in_hybrid_forward = False
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            if not isinstance(block, Block):
+                raise ValueError("Children of HybridBlock must also be HybridBlock")
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._clear_cached_op()
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def infer_shape(self, *args):
+        """Finish deferred parameter init by running shape hooks on leaves."""
+        self._deferred_infer(*args)
+
+    def _deferred_infer(self, *args):
+        # run the eager forward with deferred handling: leaf layers override
+        # _shape_hook to fill parameter shapes from inputs.
+        pass
+
+    def _build_cache(self):
+        """Create the CachedOp over this block's full forward
+        (analog of block.py:787 _build_cache)."""
+        params = {p.name: p for p in self.collect_params().values()}
+        # resolve one NDArray handle per param (single-ctx fast path)
+        aux_names = [name for name, p in params.items() if p.grad_req == "null"
+                     and ("running" in name or "moving" in name)]
+        block = self
+
+        def forward_fn(param_nds, *input_nds):
+            # substitute each Parameter's data with the provided handle for the
+            # duration of the call
+            return _with_param_override(block, params, param_nds,
+                                        lambda: block.hybrid_call(*input_nds))
+
+        self._cached_op = CachedOp(forward_fn, {n: params[n].data()
+                                                for n in params}, aux_names,
+                                   self._flags)
+        self._cached_params = params
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            # ensure params are initialized (run one eager call path for
+            # deferred shapes)
+            try:
+                for p in self.collect_params().values():
+                    if p._deferred_init:
+                        raise DeferredInitializationError("deferred")
+                    p.data()
+            except (DeferredInitializationError, RuntimeError):
+                out = self.hybrid_call(*args)
+                self._build_cache()
+                return out
+            self._build_cache()
+        param_dict = {n: p.data() for n, p in self._cached_params.items()}
+        return self._cached_op(param_dict, *args)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        if self._active and not self._in_hybrid_forward:
+            out = self._call_cached_op(*args)
+        else:
+            out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def hybrid_call(self, *args):
+        """Run the eager (unhybridized) forward regardless of _active."""
+        return self.forward(*args)
+
+    def forward(self, x, *args):
+        """Eager path: resolve params on x's context and call hybrid_forward."""
+        ctx = x.context if isinstance(x, NDArray) else current_context()
+        try:
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred(x, *args)
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        self._in_hybrid_forward = True
+        try:
+            return self.hybrid_forward(nd_mod, x, *args, **params)
+        finally:
+            self._in_hybrid_forward = False
+
+    def _finish_deferred(self, *args):
+        """Infer unknown param dims from inputs and finish deferred init."""
+        if hasattr(self, "_shape_hook"):
+            self._shape_hook(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export as symbol json + params (reference block.py export)."""
+        from .. import symbol as sym_mod
+        from .. import ndarray as nd
+        inputs = [sym_mod.var("data")]
+        out = self._build_symbol(*inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save("%s-symbol.json" % path)
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            prefix = "aux:" if param.grad_req == "null" and (
+                "running" in name or "moving" in name) else "arg:"
+            arg_dict[prefix + name] = param._reduce()
+        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
+
+    def _build_symbol(self, *inputs):
+        """Run hybrid_forward with F=symbol to build a graph."""
+        from .. import symbol as sym_mod
+        params = {k: v.var() for k, v in self._reg_params.items()}
+        if params or not self._children:
+            return self.hybrid_forward(sym_mod, *inputs, **params)
+        return self.hybrid_forward(sym_mod, *inputs)
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol graph as a Block (reference block.py:954)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        from .. import ndarray as nd
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(i) for i in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            arg_dict = nd.load(param_file)
+            params = {}
+            for k, v in arg_dict.items():
+                if k.startswith(("arg:", "aux:")):
+                    params[k.split(":", 1)[1]] = v
+                else:
+                    params[k] = v
+            for name, param in ret.collect_params().items():
+                if name in params:
+                    param._load_init(params[name], ctx)
+        if ctx is not None:
+            ret.collect_params().reset_ctx(ctx)
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from .. import symbol as sym_mod
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        self._output_sym = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names:
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            self.params.get(name, grad_req="null", allow_deferred_init=True)
+
+    def forward(self, *args):
+        from ..executor import Executor
+        arg_dict = {}
+        for name, v in zip(self._input_names, args):
+            arg_dict[name] = v
+        for name, p in self.params.items():
+            try:
+                arg_dict[name] = p.data()
+            except (DeferredInitializationError, RuntimeError):
+                raise MXNetError("SymbolBlock parameter %s is not initialized"
+                                 % name)
+        aux_names = set(self._output_sym.list_auxiliary_states())
+        aux_dict = {k: v for k, v in arg_dict.items() if k in aux_names}
+        args_only = {k: v for k, v in arg_dict.items() if k not in aux_names}
+        ex = Executor(self._output_sym, None, args_only, None, "null", aux_dict)
+        outs = ex.forward(is_train=autograd.is_training())
+        if len(outs) == 1:
+            return outs[0]
+        return outs
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _with_param_override(block, params, param_nds, thunk):
+    """Temporarily substitute Parameter data handles with given NDArrays for
+    all parameters of ``block`` (used during CachedOp tracing)."""
+    saved = []
+    try:
+        for name, p in params.items():
+            saved.append((p, p._data))
+            nd_handle = param_nds[name]
+            p._data = [nd_handle]
+        return thunk()
+    finally:
+        for p, data in saved:
+            # capture any aux mutation back into the traced handle before
+            # restoring (handled by CachedOp via param_nds contents)
+            p._data = data

@@ -1,0 +1,4 @@
+"""gluon.contrib (reference: python/mxnet/gluon/contrib/ — SyncBatchNorm,
+VariationalDropoutCell, etc.).  Round-1 subset."""
+from . import nn
+from . import rnn

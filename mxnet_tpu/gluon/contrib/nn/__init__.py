@@ -1,0 +1,1 @@
+from .basic_layers import Concurrent, HybridConcurrent, Identity, SyncBatchNorm

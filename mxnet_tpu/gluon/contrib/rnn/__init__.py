@@ -1,0 +1,1 @@
+from .rnn_cell import VariationalDropoutCell, Conv1DRNNCell

@@ -1,0 +1,62 @@
+"""gluon.contrib.rnn (reference: python/mxnet/gluon/contrib/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import ModifierCell, BidirectionalCell, HybridRecurrentCell
+from .... import ndarray as nd
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Apply the SAME dropout mask across time steps (variational dropout)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        assert not drop_states or not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support variational state dropout. " \
+            "Please add VariationalDropoutCell to the cells underneath instead."
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _initialize_mask(self, like, p):
+        from ....ndarray import random as ndrandom
+        m = ndrandom.uniform(0, 1, shape=like.shape, ctx=like.context)
+        return (m > p).astype("float32") / (1 - p)
+
+    def _forward(self, inputs, states):
+        from .... import autograd
+        if autograd.is_training():
+            if self.drop_inputs:
+                if self.drop_inputs_mask is None:
+                    self.drop_inputs_mask = self._initialize_mask(inputs,
+                                                                  self.drop_inputs)
+                inputs = inputs * self.drop_inputs_mask
+            if self.drop_states:
+                if self.drop_states_mask is None:
+                    self.drop_states_mask = self._initialize_mask(states[0],
+                                                                  self.drop_states)
+                states = [states[0] * self.drop_states_mask] + list(states[1:])
+        output, next_states = self.base_cell(inputs, states)
+        if autograd.is_training() and self.drop_outputs:
+            if self.drop_outputs_mask is None:
+                self.drop_outputs_mask = self._initialize_mask(output,
+                                                               self.drop_outputs)
+            output = output * self.drop_outputs_mask
+        return output, next_states
+
+
+class Conv1DRNNCell(HybridRecurrentCell):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("ConvRNN cells: planned widening item")

@@ -1,0 +1,153 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py:42-260 —
+multi-worker batch loading with shared-memory NDArray rebuild over
+kCPUShared storage + pthread_atfork engine handling).
+
+TPU-native: worker processes produce numpy batches over a
+multiprocessing.Pool (plain pickle transport — numpy arrays go through
+shared-memory-backed pipes on Linux); the device transfer happens once per
+batch in the consumer.  A num_workers=0 path runs synchronously in-process.
+"""
+from __future__ import annotations
+
+import multiprocessing as _mp
+
+import numpy as _np
+
+from ...ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        from ... import ndarray as nd
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return array(data, dtype=data.dtype)
+
+
+def default_mp_batchify_fn(data):
+    return default_batchify_fn(data)
+
+
+class _SimpleIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._iter = iter(loader._batch_sampler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch_indices = next(self._iter)
+        dataset = self._loader._dataset
+        samples = [dataset[i] for i in batch_indices]
+        return self._loader._batchify_fn(samples)
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(batch_indices):
+    samples = [_worker_dataset[i] for i in batch_indices]
+    # return numpy-only payloads for cheap pickling
+    def to_np(s):
+        if isinstance(s, NDArray):
+            return s.asnumpy()
+        if isinstance(s, tuple):
+            return tuple(to_np(x) for x in s)
+        return s
+    return [to_np(s) for s in samples]
+
+
+class _MultiWorkerIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._iter = iter(loader._batch_sampler)
+        self._pool = loader._pool
+        self._pending = []
+        self._prefetch = max(2 * loader._num_workers, 4)
+        for _ in range(self._prefetch):
+            self._push_next()
+
+    def _push_next(self):
+        try:
+            batch_indices = next(self._iter)
+        except StopIteration:
+            return
+        self._pending.append(self._pool.apply_async(_worker_fn, (batch_indices,)))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._pending:
+            raise StopIteration
+        result = self._pending.pop(0)
+        self._push_next()
+        samples = result.get()
+        return self._loader._batchify_fn(samples)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers,
+                                        initializer=_worker_init,
+                                        initargs=(self._dataset,))
+            else:
+                ctx = _mp.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init,
+                                      initargs=(self._dataset,))
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            return _SimpleIter(self)
+        return _MultiWorkerIter(self)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,178 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from ....ndarray import NDArray, array
+from .... import image as _image
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        transforms.append(None)
+        hybrid = []
+        for i in transforms:
+            if isinstance(i, HybridBlock):
+                hybrid.append(i)
+                continue
+            elif len(hybrid) == 1:
+                self.add(hybrid[0])
+                hybrid = []
+            elif len(hybrid) > 1:
+                hblock = HybridSequential()
+                for j in hybrid:
+                    hblock.add(j)
+                self.add(hblock)
+                hybrid = []
+            if i is not None:
+                self.add(i)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def hybrid_forward(self, F, x):
+        if isinstance(x, NDArray):
+            data = x._data.astype("float32") / 255.0
+            if data.ndim == 3:
+                data = data.transpose(2, 0, 1)
+            from ....ndarray import _wrap
+            return _wrap(data, ctx=x.context)
+        return F.transpose(F.Cast(x, dtype="float32") / 255.0, axes=(2, 0, 1))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def hybrid_forward(self, F, x):
+        from ....ndarray import _wrap
+        mean = self._mean.reshape((-1, 1, 1))
+        std = self._std.reshape((-1, 1, 1))
+        if isinstance(x, NDArray):
+            return _wrap((x._data - mean) / std, ctx=x.context)
+        return (x - mean) / std
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        return _image.imresize(x, self._size[0], self._size[1],
+                               self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        return _image.center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._args = ((size, size) if isinstance(size, int) else size,
+                      scale, ratio, interpolation)
+
+    def forward(self, x):
+        return _image.random_size_crop(x, *self._args)[0]
+
+
+class RandomFlipLeftRight(HybridBlock):
+    def hybrid_forward(self, F, x):
+        import random as _pyrandom
+        if _pyrandom.random() < 0.5:
+            if isinstance(x, NDArray):
+                from ....ndarray import _wrap
+                return _wrap(x._data[:, ::-1], ctx=x.context)
+        return x
+
+
+class RandomFlipTopBottom(HybridBlock):
+    def hybrid_forward(self, F, x):
+        import random as _pyrandom
+        if _pyrandom.random() < 0.5:
+            if isinstance(x, NDArray):
+                from ....ndarray import _wrap
+                return _wrap(x._data[::-1], ctx=x.context)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._aug = _image.BrightnessJitterAug(brightness)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._aug = _image.ContrastJitterAug(contrast)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._aug = _image.SaturationJitterAug(saturation)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._aug = _image.HueJitterAug(hue)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._aug = _image.ColorJitterAug(brightness, contrast, saturation)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super().__init__()
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        self._aug = _image.LightingAug(alpha, eigval, eigvec)
+
+    def forward(self, x):
+        return self._aug(x)

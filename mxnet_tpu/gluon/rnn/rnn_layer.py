@@ -1,0 +1,203 @@
+"""Fused recurrent layers (reference: python/mxnet/gluon/rnn/rnn_layer.py
+RNN/LSTM/GRU at :234-433, backed by the fused RNN op src/operator/rnn-inl.h).
+
+TPU-native: the RNN op is a lax.scan with batched gate matmuls (ops/nn_ops.py);
+a whole multi-layer stack compiles to one XLA while-loop with weights resident
+in VMEM."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..block import HybridBlock
+from ... import ndarray as nd
+from ...ndarray import NDArray
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    self._register_param("{}{}_i2h_weight".format(j, i),
+                                         shape=(ng * nh, ni),
+                                         init=i2h_weight_initializer)
+                    self._register_param("{}{}_h2h_weight".format(j, i),
+                                         shape=(ng * nh, nh),
+                                         init=h2h_weight_initializer)
+                    self._register_param("{}{}_i2h_bias".format(j, i),
+                                         shape=(ng * nh,),
+                                         init=i2h_bias_initializer)
+                    self._register_param("{}{}_h2h_bias".format(j, i),
+                                         shape=(ng * nh,),
+                                         init=h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def _shape_hook(self, x, *args):
+        layout_T = 0 if self._layout == "TNC" else 1
+        input_size = x.shape[2]
+        self._input_size = input_size
+        ng, nh = self._gates, self._hidden_size
+        ni = input_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, "{}{}_i2h_weight".format(j, i)).shape = (ng * nh, ni)
+            ni = nh * self._dir
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(shape[1] if shape[1] else None,
+                                      shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            shape = info["shape"]
+            extra = {k: v for k, v in kwargs.items()
+                     if k not in ("shape", "__layout__")}
+            states.append(func(shape, **extra))
+        return states
+
+    def _collect_flat_parameters(self, F, params):
+        """Pack per-layer weights into the fused-RNN parameter blob order."""
+        ws = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(params["{}{}_i2h_weight".format(j, i)].reshape((-1,)))
+                ws.append(params["{}{}_h2h_weight".format(j, i)].reshape((-1,)))
+        bs = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(params["{}{}_i2h_bias".format(j, i)].reshape((-1,)))
+                bs.append(params["{}{}_h2h_bias".format(j, i)].reshape((-1,)))
+        return F.concat(*(ws + bs), dim=0)
+
+    def forward(self, x, states=None):
+        ctx = x.context
+        try:
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        except Exception:
+            self._finish_deferred(x)
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        batch_size = x.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=ctx)
+        if isinstance(states, NDArray):
+            states = [states]
+        for state, info in zip(states, self.state_info(batch_size)):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    "Invalid recurrent state shape. Expecting %s, got %s." % (
+                        str(info["shape"]), str(state.shape)))
+        out = self._forward_kernel(nd, x, states, params)
+        return out[0] if skip_states else out
+
+    def hybrid_forward(self, F, x, *args, **params):
+        states = list(args) if args else None
+        if states is None:
+            raise ValueError("hybridized RNN layers require explicit begin "
+                             "states in this build")
+        return self._forward_kernel(F, x, states, params)
+
+    def _forward_kernel(self, F, x, states, params):
+        if self._layout == "NTC":
+            x = F.transpose(x, axes=(1, 0, 2))
+        flat = self._collect_flat_parameters(F, params)
+        outs = F.RNN(x, flat, *states, state_size=self._hidden_size,
+                     num_layers=self._num_layers, bidirectional=self._dir == 2,
+                     p=self._dropout, state_outputs=True, mode=self._mode)
+        if self._mode == "lstm":
+            outputs, states = outs[0], [outs[1], outs[2]]
+        else:
+            outputs, states = outs[0], [outs[1]]
+        if self._layout == "NTC":
+            outputs = F.transpose(outputs, axes=(1, 0, 2))
+        return outputs, states
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,114 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import os
+import hashlib
+import warnings
+
+import numpy as _np
+
+from ..ndarray import NDArray, array
+from ..context import Context, cpu
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into num_slice along batch_axis (reference split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along axis "
+            "%d. Use a batch size that's multiple of %d or set even_split=False to "
+            "allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data into len(ctx_list) slices and load each on its context."""
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norms is at most max_norm."""
+    def _norm(arr):
+        return (arr * arr).sum()
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = sum((_norm(arr).as_in_context(ctx) for arr in arrays),
+                     start=_norm(arrays[0]) * 0)
+    total_norm = float(total_norm.asscalar()) ** 0.5
+    if check_isfinite and not _np.isfinite(total_norm):
+        warnings.warn("nan or inf is detected. Clipping results will be undefined.",
+                      stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file (zero-egress environments will fail; callers should
+    pre-stage data and pass local paths)."""
+    import urllib.request
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if overwrite or not os.path.exists(fname) or (
+            sha1_hash and not check_sha1(fname, sha1_hash)):
+        dirname = os.path.dirname(os.path.abspath(os.path.expanduser(fname)))
+        if not os.path.exists(dirname):
+            os.makedirs(dirname)
+        urllib.request.urlretrieve(url, fname)
+        if sha1_hash and not check_sha1(fname, sha1_hash):
+            raise UserWarning("File {} is downloaded but the content hash does "
+                              "not match.".format(fname))
+    return fname
+
+
+def _get_repo_url():
+    return os.environ.get("MXNET_GLUON_REPO",
+                          "https://apache-mxnet.s3-accelerate.dualstack."
+                          "amazonaws.com/")
+
+
+def _get_repo_file_url(namespace, filename):
+    return "{base_url}{namespace}/{filename}".format(
+        base_url=_get_repo_url(), namespace=namespace, filename=filename)
+
+
+def _brief_print_list(lst, limit=7):
+    lst = list(lst)
+    if len(lst) > limit:
+        return _brief_print_list(lst[:limit // 2], limit) + ", ..., " + \
+            _brief_print_list(lst[-limit // 2:], limit)
+    return ", ".join(["'%s'" % str(i) for i in lst])

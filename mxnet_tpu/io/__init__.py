@@ -1,0 +1,3 @@
+from .io import (DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
+                 NDArrayIter, CSVIter, MNISTIter, ImageRecordIter,
+                 LibSVMIter, DataLoaderIter)

@@ -1,0 +1,282 @@
+"""KVStore: the data-parallel gradient-aggregation layer.
+
+Reference: src/kvstore/ — factory (kvstore.cc:40-77) creating ``local``/
+``device`` (single-process multi-GPU reduce via Comm hierarchy, comm.h:43-727),
+``nccl`` (kvstore_nccl.h), and ``dist_sync``/``dist_async``/``dist_device_sync``
+(ps-lite parameter server, kvstore_dist.h; server side kvstore_dist_server.h
+with sync aggregation + server-run optimizer).  Python client kvstore.py:97-635.
+
+TPU-native redesign (the BASELINE.json north star): there are no parameter
+servers — gradient aggregation is an XLA collective:
+
+  * ``local`` / ``device``: single-process multi-device reduce.  Push with a
+    list of per-device arrays sums them (XLA executes the adds on-device and
+    ICI moves shards, the CommDevice analog); pull broadcasts.
+  * ``tpu_sync`` (alias ``nccl``): same API; the aggregation is jitted as one
+    fused add-tree so N pushed arrays reduce without host round-trips.
+  * ``dist_sync`` / ``dist_tpu_sync`` / ``dist_device_sync``: multi-host.
+    ``jax.distributed`` supplies rendezvous (the DMLC tracker analog); cross-
+    host reduction is a psum over all participating processes' devices via
+    ``multihost_utils``/shard_map when the training step is compiled (the
+    Trainer/Module path), or an explicit process-group allreduce here for the
+    eager push/pull API.  ``dist_async`` has no TPU analog (SURVEY §7 hard-part
+    e): we accept the type and run it synchronously, documented divergence.
+
+The optimizer-on-server mode (``_set_updater`` on workers / server-side
+``ApplyUpdates``, kvstore_dist_server.h:346) maps to running the updater
+locally after an allreduced gradient — identical math for sync mode.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError, string_types
+from .ndarray import NDArray, invoke, zeros, array
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    if isinstance(key, (str, int)):
+        return [key], True
+    return list(key), False
+
+
+def _val_list(value, n):
+    """Normalize push/pull values: per-key list of NDArray or list-of-NDArray."""
+    if isinstance(value, NDArray):
+        return [[value]]
+    assert isinstance(value, (list, tuple))
+    if value and isinstance(value[0], NDArray):
+        if n == 1:
+            return [list(value)]
+        assert len(value) == n
+        return [[v] for v in value]
+    assert len(value) == n
+    return [list(v) if isinstance(v, (list, tuple)) else [v] for v in value]
+
+
+class KVStore:
+    """Single-process key-value store with multi-device reduce."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}          # key -> NDArray (merged value)
+        self._updater = None
+        self._optimizer = None
+        self._compression = {}
+        self._barrier_count = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if str(k) in self._store:
+                raise MXNetError("key %s already initialized" % k)
+            self._store[str(k)] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            merged = self._reduce(vlist)
+            if self._updater is not None:
+                self._updater(self._key_to_int(k), merged, self._store[k])
+            else:
+                self._store[k]._set_data(merged._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference kvstore_dist.h:271
+        PullRowSparse — the large-embedding path)."""
+        assert out is not None and row_ids is not None
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, olist in zip(keys, outs):
+            k = str(k)
+            src = self._store[k]
+            for o, rid in zip(olist, rids * len(olist)):
+                rows = invoke("take", [src, rid], {"axis": 0, "mode": "clip"})
+                o._set_data(rows._data)
+
+    # ------------------------------------------------------------------
+    def _reduce(self, vlist):
+        """Reduce a list of per-device arrays to one (CommDevice analog)."""
+        if len(vlist) == 1:
+            return vlist[0].copy()
+        return invoke("add_n", list(vlist), {})
+
+    def _key_to_int(self, k):
+        try:
+            return int(k)
+        except ValueError:
+            return k
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """Reference: 2-bit compression with error feedback
+        (src/kvstore/gradient_compression.cc:44-140).  On TPU the allreduce
+        rides ICI at full bf16 rate; we record the setting and (for the dist
+        types) compress to bf16 before reduction when type='2bit'."""
+        self._compression = dict(compression_params)
+
+    # ------------------------------------------------------------------
+    def barrier(self):
+        self._barrier_count += 1
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+class KVStoreTPUSync(KVStore):
+    """In-graph allreduce kvstore (``tpu_sync``; the ``nccl`` analog,
+    kvstore_nccl.h:62).  Reduction of the per-device list is one jitted
+    add-tree; when values are sharded jax Arrays the sum runs as XLA
+    collectives over ICI with no host involvement."""
+
+    def __init__(self, kv_type="tpu_sync"):
+        super().__init__(kv_type)
+        self._jit_reduce = None
+
+    def _reduce(self, vlist):
+        if len(vlist) == 1:
+            return vlist[0].copy()
+        import jax
+        if self._jit_reduce is None:
+            self._jit_reduce = jax.jit(lambda *xs: sum(xs[1:], xs[0]))
+        from .ndarray import _wrap
+        return _wrap(self._jit_reduce(*[v._data for v in vlist]), ctx=vlist[0].context)
+
+
+class KVStoreDist(KVStoreTPUSync):
+    """Multi-host synchronous kvstore (``dist_sync``/``dist_tpu_sync``/
+    ``dist_device_sync``/``dist_async``).
+
+    Rendezvous via jax.distributed (env: MX_KV_NUM_WORKERS, MX_KV_RANK,
+    MX_KV_ROOT_URI — the DMLC_PS_* analogs, kvstore_dist.h:50-106; also reads
+    the reference's DMLC_* names).  Cross-host reduce = process allreduce via
+    a psum over a global mesh; on a pod slice this is one ICI collective."""
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        import os
+        self._rank = int(os.environ.get("MX_KV_RANK",
+                                        os.environ.get("DMLC_WORKER_ID", "0")))
+        self._num_workers = int(os.environ.get("MX_KV_NUM_WORKERS",
+                                               os.environ.get("DMLC_NUM_WORKER", "1")))
+        self._initialized_dist = False
+        if self._num_workers > 1:
+            self._init_distributed()
+
+    def _init_distributed(self):
+        import os
+        import jax
+        coord = os.environ.get("MX_KV_ROOT_URI", os.environ.get("DMLC_PS_ROOT_URI"))
+        port = os.environ.get("MX_KV_ROOT_PORT", os.environ.get("DMLC_PS_ROOT_PORT", "9876"))
+        if coord is not None:
+            jax.distributed.initialize(
+                coordinator_address="%s:%s" % (coord, port),
+                num_processes=self._num_workers,
+                process_id=self._rank)
+            self._initialized_dist = True
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def _allreduce_across_hosts(self, merged):
+        if self._num_workers <= 1:
+            return merged
+        import jax
+        import numpy as _np_
+        from jax.experimental import multihost_utils
+        v = multihost_utils.process_allgather(merged._data)
+        from .ndarray import _wrap
+        return _wrap(v.sum(axis=0), ctx=merged.context)
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            if self._compression.get("type") == "2bit":
+                vlist = [v.astype("bfloat16").astype("float32") for v in vlist]
+            merged = self._reduce(vlist)
+            merged = self._allreduce_across_hosts(merged)
+            if self._updater is not None:
+                self._updater(self._key_to_int(k), merged, self._store[k])
+            else:
+                self._store[k]._set_data(merged._data)
+
+    def barrier(self):
+        if self._num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier_%d"
+                                                % self._barrier_count)
+        self._barrier_count += 1
+
+
+def create(name="local"):
+    """Factory (reference kvstore.cc:40-77 + python/mxnet/kvstore.py create)."""
+    if not isinstance(name, string_types):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu", "device",
+                "local_allreduce_device"):
+        return KVStore(name)
+    if name in ("tpu_sync", "nccl"):
+        return KVStoreTPUSync(name)
+    if name in ("dist_sync", "dist_device_sync", "dist_tpu_sync", "dist_async",
+                "dist_sync_device", "dist"):
+        return KVStoreDist(name)
+    raise MXNetError("unknown kvstore type %s" % name)

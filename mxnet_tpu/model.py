@@ -1,0 +1,122 @@
+"""Model checkpoint helpers + kvstore wiring.
+
+Reference: python/mxnet/model.py — ``_create_kvstore`` (:77),
+``_initialize_kvstore`` (:116), ``_update_params_on_kvstore`` (:145),
+``_update_params`` (:157), ``save_checkpoint``/``load_checkpoint`` (:383,413).
+The legacy FeedForward API is subsumed by Module (module/).
+"""
+from __future__ import annotations
+
+import logging
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol as sym
+from . import kvstore as kvs
+from .base import string_types
+
+BatchEndParam = None
+try:
+    from collections import namedtuple
+    BatchEndParam = namedtuple("BatchEndParams",
+                               ["epoch", "nbatch", "eval_metric", "locals"])
+except Exception:
+    pass
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Create kvstore + decide update_on_kvstore (reference model.py:77)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, string_types):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape) for param in arg_params.values()) \
+                    if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, string or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+import numpy as np  # noqa: E402  (used above lazily)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore_nccl(param_arrays, grad_arrays, kvstore, param_names):
+    valid_indices = [i for i, g in enumerate(grad_arrays) if g is not None]
+    for i in valid_indices:
+        name = param_names[i]
+        kvstore.push(name, grad_arrays[i], priority=-i)
+    for i in valid_indices:
+        name = param_names[i]
+        kvstore.pull(name, param_arrays[i], priority=-i)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """Local updater path (reference model.py:157): optionally reduce grads on
+    the kvstore, then run the updater on each device copy."""
+    for i, (arg_list, grad_list) in enumerate(zip(param_arrays, grad_arrays)):
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            name = param_names[i]
+            kvstore.push(name, grad_list, priority=-i)
+            kvstore.pull(name, grad_list, priority=-i)
+        for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+            updater(i * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Checkpoint to prefix-symbol.json + prefix-%04d.params (model.py:383)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load checkpoint (model.py:413): returns (symbol, arg_params, aux_params)."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)

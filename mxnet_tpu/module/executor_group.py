@@ -1,0 +1,287 @@
+"""DataParallelExecutorGroup: slice batches across devices.
+
+Reference: python/mxnet/module/executor_group.py:143 — ``decide_slices`` (:281)
+splits the batch axis across contexts, ``bind_exec`` (:344) binds one executor
+per device via ``_bind_ith_exec`` (:641), forward/backward scatter/gather.
+
+TPU-native: kept for API parity and used by Module for multi-context binds.
+(The pjit data-parallel path in parallel/ is the performance route — one
+executor over a sharded mesh rather than N replicas; this class is the
+replica-per-device fallback exactly matching reference semantics.)
+"""
+from __future__ import annotations
+
+import logging
+import numpy as _np
+
+from ..io.io import DataDesc
+from ..ndarray import NDArray, zeros as nd_zeros, concat as nd_concat
+from ..base import MXNetError
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Reference: mxnet.executor_manager._split_input_slice."""
+    total_work_load = sum(work_load_list)
+    batch_num_list = [round(work_load * batch_size / total_work_load)
+                      for work_load in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _load_general(data, targets, major_axis):
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for slice_idx, d_dst in d_targets:
+                if major_axis == 0:
+                    d_src[slice_idx.start:slice_idx.stop].copyto(d_dst)
+                else:
+                    d_src.copyto(d_dst)
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=logging, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                self.grad_req[name] = ("null" if name in self.fixed_param_names
+                                       else grad_req) if for_training else "null"
+            elif name in [d[0] for d in data_shapes]:
+                self.grad_req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                self.grad_req[name] = "null"
+        self.execs = []
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_layouts = None
+        self.label_layouts = None
+        self.batch_size = None
+        self.slices = None
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """Reference executor_group.py:281."""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(ds, "layout", "NCHW"))
+                      for ds in data_shapes]
+        for (name, shape), axis in zip([(d.name, d.shape) for d in data_shapes],
+                                       major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size
+            else:
+                self.batch_size = batch_size
+                self.slices = _split_input_slice(self.batch_size, self.workload)
+        return major_axis
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None and len(label_shapes) > 0:
+            self.label_layouts = self.decide_slices(label_shapes)
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.execs = []
+        for i in range(len(self.contexts)):
+            self.execs.append(self._bind_ith_exec(i, data_shapes, label_shapes,
+                                                  shared_group))
+        self._collect_arrays()
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        sliced = []
+        for (desc, axis) in zip(shapes, major_axis):
+            shape = list(desc.shape)
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            sliced.append(DataDesc(desc.name, tuple(shape),
+                                   getattr(desc, "dtype", _np.float32),
+                                   getattr(desc, "layout", "NCHW")))
+        return sliced
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        """Reference executor_group.py:641."""
+        from ..executor import Executor
+        shapes = dict()
+        data_shapes_i = self._sliced_shape(data_shapes, i, self.data_layouts)
+        for desc in data_shapes_i:
+            shapes[desc.name] = desc.shape
+        if label_shapes is not None:
+            label_shapes_i = self._sliced_shape(label_shapes, i, self.label_layouts)
+            for desc in label_shapes_i:
+                shapes[desc.name] = desc.shape
+        ctx = self.contexts[i]
+        arg_shapes, _, aux_shapes = self.symbol._infer_shape_impl(False, **shapes)
+        if arg_shapes is None:
+            raise MXNetError("shape inference failed in bind")
+        args = {}
+        args_grad = {}
+        for name, shape in zip(self.arg_names, arg_shapes):
+            args[name] = nd_zeros(shape, ctx=ctx)
+            if self.grad_req.get(name, "null") != "null":
+                args_grad[name] = nd_zeros(shape, ctx=ctx)
+        aux = {name: nd_zeros(shape, ctx=ctx)
+               for name, shape in zip(self.aux_names, aux_shapes)}
+        return Executor(self.symbol, ctx, args, args_grad, self.grad_req, aux)
+
+    def _collect_arrays(self):
+        self.data_arrays = [[(self.slices[i], e.arg_dict[name])
+                             for i, e in enumerate(self.execs)]
+                            for name, _ in [(d.name, d.shape) for d in self.data_shapes]]
+        if self.label_shapes is not None:
+            self.label_arrays = [[(self.slices[i], e.arg_dict[name])
+                                  for i, e in enumerate(self.execs)]
+                                 for name, _ in [(l.name, l.shape) for l in self.label_shapes]]
+        else:
+            self.label_arrays = None
+        self.param_arrays = [[exec_.arg_dict[name] for exec_ in self.execs]
+                             for name in self.param_names
+                             if name in self.arg_names]
+        if self.for_training:
+            self.grad_arrays = [[exec_.grad_dict.get(name) for exec_ in self.execs]
+                                for name in self.param_names]
+        else:
+            self.grad_arrays = []
+        data_names = [x.name for x in self.data_shapes]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [[exec_.grad_dict.get(name)
+                                       for exec_ in self.execs]
+                                      for name in data_names]
+        else:
+            self.input_grad_arrays = []
+        self.aux_arrays = [[exec_.aux_dict[name] for exec_ in self.execs]
+                           for name in self.aux_names]
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = block[0]
+            if len(block) > 1:
+                acc = block[0].copy()
+                for w in block[1:]:
+                    acc += w.as_in_context(acc.context)
+                weight = acc / len(block)
+            weight.astype(str(arg_params[name].dtype)).copyto(arg_params[name])
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = block[0]
+            if len(block) > 1:
+                acc = block[0].copy()
+                for w in block[1:]:
+                    acc += w.as_in_context(acc.context)
+                weight = acc / len(block)
+            weight.astype(str(aux_params[name].dtype)).copyto(aux_params[name])
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        # scatter data
+        for j, desc in enumerate(self.data_shapes):
+            src = data_batch.data[j]
+            for i, e in enumerate(self.execs):
+                sl = self.slices[i]
+                e.arg_dict[desc.name]._set_data(src[sl.start:sl.stop]._data
+                                                if len(self.execs) > 1 else src._data)
+        if self.label_shapes is not None and data_batch.label:
+            for j, desc in enumerate(self.label_shapes):
+                src = data_batch.label[j]
+                for i, e in enumerate(self.execs):
+                    sl = self.slices[i]
+                    e.arg_dict[desc.name]._set_data(src[sl.start:sl.stop]._data
+                                                    if len(self.execs) > 1 else src._data)
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        for i, e in enumerate(self.execs):
+            out_grads_slice = None
+            if out_grads is not None:
+                out_grads_slice = []
+                for grad in out_grads:
+                    if len(self.execs) > 1:
+                        sl = self.slices[i]
+                        out_grads_slice.append(grad[sl.start:sl.stop]
+                                               .as_in_context(self.contexts[i]))
+                    else:
+                        out_grads_slice.append(grad)
+            e.backward(out_grads_slice)
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[e.outputs[i] for e in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            merged = []
+            for per_dev in outputs:
+                if len(per_dev) == 1:
+                    merged.append(per_dev[0])
+                else:
+                    merged.append(nd_concat(*[o.as_in_context(per_dev[0].context)
+                                              for o in per_dev], dim=0))
+            return merged
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        if merge_multi_context:
+            merged = []
+            for per_dev in self.input_grad_arrays:
+                if len(per_dev) == 1:
+                    merged.append(per_dev[0])
+                else:
+                    merged.append(nd_concat(*per_dev, dim=0))
+            return merged
+        return self.input_grad_arrays
+
+    def get_states(self, merge_multi_context=True):
+        return []
+
+    def set_states(self, states=None, value=None):
+        pass
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for current_exec, (texec, islice) in enumerate(zip(self.execs, self.slices)):
+            if not pre_sliced:
+                labels_slice = []
+                for label in labels:
+                    if len(self.execs) > 1:
+                        labels_slice.append(label[islice.start:islice.stop])
+                    else:
+                        labels_slice.append(label)
+            else:
+                labels_slice = labels[current_exec]
+            eval_metric.update(labels_slice, texec.outputs)
+
+    def install_monitor(self, mon):
+        for e in self.execs:
+            e.set_monitor_callback(mon.stat_helper if hasattr(mon, "stat_helper")
+                                   else mon)

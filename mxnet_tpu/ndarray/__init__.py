@@ -1,0 +1,31 @@
+"""``mx.nd`` — the imperative array package.
+
+Reference: python/mxnet/ndarray/ — core NDArray plus generated op functions,
+random/linalg/sparse/contrib sub-namespaces.
+"""
+import sys as _sys
+
+from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange,
+                      moveaxis, concat, stack, waitall, from_jax, _wrap)
+from . import register as _register
+from . import random    # noqa: F401
+from . import linalg    # noqa: F401
+
+# install one function per registered op into this module (analog of
+# _init_op_module, python/mxnet/base.py:578)
+_register.install_ops(_sys.modules[__name__])
+
+
+def save(fname, data):
+    from .utils import save as _save
+    return _save(fname, data)
+
+
+def load(fname):
+    from .utils import load as _load
+    return _load(fname)
+
+
+def imdecode(buf, **kwargs):
+    from ..image import imdecode as _imdecode
+    return _imdecode(buf, **kwargs)

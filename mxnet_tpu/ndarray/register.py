@@ -1,0 +1,79 @@
+"""Generate the ``nd.*`` op namespace from the registry.
+
+Reference: python/mxnet/ndarray/register.py:30-169 + base.py:578-645
+``_init_op_module`` — at import, one Python function is created per registered
+C++ op and installed into the ndarray module.  Here generation is pure Python:
+each function splits NDArray positionals from attribute kwargs and calls the
+imperative dispatcher.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import get_op, list_ops
+from .ndarray import NDArray, invoke
+
+__all__ = ["make_op_func", "install_ops"]
+
+
+# trailing non-array positional arguments of common MXNet op signatures,
+# mapped to their attr names (the reference's generated signatures carry
+# these as named params after the data args)
+_POS_ATTRS = {
+    "one_hot": ["depth", "on_value", "off_value"],
+    "clip": ["a_min", "a_max"],
+    "expand_dims": ["axis"],
+    "repeat": ["repeats", "axis"],
+    "tile": ["reps"],
+    "reshape": ["shape"],
+    "Reshape": ["shape"],
+    "broadcast_to": ["shape"],
+    "slice_axis": ["axis", "begin", "end"],
+    "slice": ["begin", "end", "step"],
+    "smooth_l1": ["scalar"],
+    "Cast": ["dtype"],
+    "cast": ["dtype"],
+}
+
+
+def make_op_func(op_name):
+    pos_attrs = _POS_ATTRS.get(op_name, [])
+
+    def op_func(*args, out=None, name=None, **kwargs):
+        inputs = []
+        trailing = []
+        for a in args:
+            if isinstance(a, NDArray):
+                if trailing:
+                    raise TypeError("NDArray argument after scalar argument in %s"
+                                    % op_name)
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
+                inputs.extend(a)
+            else:
+                trailing.append(a)
+        if trailing:
+            if len(trailing) > len(pos_attrs):
+                raise TypeError("too many positional arguments to %s" % op_name)
+            for attr_name, v in zip(pos_attrs, trailing):
+                kwargs.setdefault(attr_name, v)
+        # NDArrays passed by keyword are inputs too (MXNet allows both)
+        attrs = {}
+        kw_inputs = []
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                kw_inputs.append(v)
+            elif v is not None:
+                attrs[k] = v
+        return invoke(op_name, inputs + kw_inputs, attrs, out=out)
+    op_func.__name__ = op_name
+    op = get_op(op_name)
+    op_func.__doc__ = op.__doc__
+    return op_func
+
+
+def install_ops(module, names=None, symbol=False):
+    """Install one function per registered op into ``module``."""
+    for name in (names or list_ops()):
+        fn = make_op_func(name)
+        setattr(module, name, fn)

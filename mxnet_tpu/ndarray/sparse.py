@@ -1,0 +1,177 @@
+"""Sparse NDArrays: row_sparse and CSR.
+
+Reference: python/mxnet/ndarray/sparse.py + src/ndarray (stypes at
+include/mxnet/ndarray.h:61-65) — RowSparseNDArray (indices + values rows,
+the large-embedding/gradient format pulled via kvstore PullRowSparse) and
+CSRNDArray.
+
+TPU-native: backed by jax.experimental.sparse BCOO where ops need it, with
+explicit (indices, data) fields matching the reference layout.  Round-1 scope:
+construction, conversion to/from dense, retain, basic arithmetic via
+densification; sparse-aware dot and optimizer updates widen later.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, _wrap, array, zeros as nd_zeros
+from ..base import MXNetError
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
+           "cast_storage", "rand_sparse_ndarray", "retain"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_aux",)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self, stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values-rows) pair: data[indices[i]] = values[i]."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        import jax.numpy as jnp
+        dense = jnp.zeros(shape, dtype=data._data.dtype if isinstance(data, NDArray)
+                          else _np.float32)
+        super().__init__(dense, ctx=ctx)
+        self._stype = "row_sparse"
+        self._aux = {"data": data, "indices": indices}
+        idx = indices._data.astype("int32") if isinstance(indices, NDArray) else indices
+        vals = data._data if isinstance(data, NDArray) else data
+        self._data = dense.at[idx].set(vals)
+
+    @property
+    def data(self):
+        return self._aux["data"]
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    def todense(self):
+        return _wrap(self._data, ctx=self._ctx)
+
+    def retain(self, row_ids):
+        import jax.numpy as jnp
+        rid = row_ids._data.astype("int32")
+        rows = self._data[rid]
+        return row_sparse_array((_wrap(rows), _wrap(rid)),
+                                shape=self.shape, ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(self._data)
+            return other
+        return super().copyto(other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        import jax.numpy as jnp
+        vals = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        idx = (indices._data if isinstance(indices, NDArray)
+               else jnp.asarray(indices)).astype("int32")
+        ptr = (indptr._data if isinstance(indptr, NDArray)
+               else jnp.asarray(indptr)).astype("int32")
+        dense = _np.zeros(shape, dtype=_np.asarray(vals).dtype)
+        ptr_np = _np.asarray(ptr)
+        idx_np = _np.asarray(idx)
+        vals_np = _np.asarray(vals)
+        for r in range(shape[0]):
+            for j in range(ptr_np[r], ptr_np[r + 1]):
+                dense[r, idx_np[j]] = vals_np[j]
+        super().__init__(jnp.asarray(dense), ctx=ctx)
+        self._stype = "csr"
+        self._aux = {"data": _wrap(vals), "indices": _wrap(idx), "indptr": _wrap(ptr)}
+
+    @property
+    def data(self):
+        return self._aux["data"]
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    @property
+    def indptr(self):
+        return self._aux["indptr"]
+
+    def todense(self):
+        return _wrap(self._data, ctx=self._ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(array(_np.asarray(data, dtype=dtype or _np.float32)),
+                          array(_np.asarray(indices)),
+                          array(_np.asarray(indptr)), shape, ctx=ctx)
+    # dense input
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1,
+                        dtype=dtype or _np.float32)
+    indptr = [0]
+    indices = []
+    data = []
+    for row in dense:
+        nz = _np.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(array(_np.array(data, dtype=dense.dtype)),
+                      array(_np.array(indices, dtype=_np.int64)),
+                      array(_np.array(indptr, dtype=_np.int64)),
+                      dense.shape, ctx=ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if not isinstance(data, NDArray):
+            data = array(_np.asarray(data, dtype=dtype or _np.float32))
+        if not isinstance(indices, NDArray):
+            indices = array(_np.asarray(indices, dtype=_np.int64))
+        return RowSparseNDArray(data, indices, shape, ctx=ctx)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1,
+                        dtype=dtype or _np.float32)
+    nz_rows = _np.nonzero(_np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+    return RowSparseNDArray(array(dense[nz_rows]),
+                            array(nz_rows.astype(_np.int64)),
+                            dense.shape, ctx=ctx)
+
+
+def cast_storage(arr, stype):
+    if stype == "default":
+        if isinstance(arr, BaseSparseNDArray):
+            return arr.todense()
+        return arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr, shape=arr.shape, ctx=arr.context)
+    if stype == "csr":
+        return csr_matrix(arr, shape=arr.shape, ctx=arr.context)
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def retain(arr, indices):
+    assert isinstance(arr, RowSparseNDArray)
+    return arr.retain(indices)
+
+
+def rand_sparse_ndarray(shape, stype, density=0.05, dtype=None):
+    dense = _np.random.uniform(-1, 1, shape)
+    mask = _np.random.uniform(0, 1, shape) < density
+    dense = (dense * mask).astype(dtype or _np.float32)
+    if stype == "row_sparse":
+        return row_sparse_array(dense, shape=shape), dense
+    if stype == "csr":
+        return csr_matrix(dense, shape=shape), dense
+    raise MXNetError("unknown stype %s" % stype)

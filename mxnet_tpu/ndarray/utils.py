@@ -1,0 +1,46 @@
+"""NDArray save/load.
+
+Reference: python/mxnet/ndarray/utils.py:149,222 → src/ndarray/ndarray.cc
+Save/Load (binary dmlc format with magic number, name→array dicts).
+
+TPU-native: a portable ``.npz``-based container with the same surface —
+``save(fname, list-or-dict)`` / ``load(fname)`` round-trips lists and
+name→NDArray dicts.  (Orbax handles sharded checkpoints at the gluon/module
+layer; this is the single-host array container.)
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+_LIST_PREFIX = "__mx_list__:"
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    payload = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            if not isinstance(v, NDArray):
+                raise TypeError("save only supports NDArray values")
+            payload[k] = v.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            if not isinstance(v, NDArray):
+                raise TypeError("save only supports NDArray values")
+            payload["%s%d" % (_LIST_PREFIX, i)] = v.asnumpy()
+    else:
+        raise TypeError("data must be NDArray, list of NDArray, or dict of NDArray")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname):
+    with _np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            items = sorted(((int(k[len(_LIST_PREFIX):]), npz[k]) for k in keys))
+            return [array(v) for _, v in items]
+        return {k: array(npz[k]) for k in keys}

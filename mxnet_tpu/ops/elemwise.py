@@ -1,0 +1,266 @@
+"""Elementwise, scalar, and broadcast binary ops.
+
+Reference: src/operator/tensor/elemwise_unary_op_basic.cc, elemwise_binary_op*.cc,
+elemwise_binary_broadcast_op*.cc, mshadow_op.h (scalar math library).
+
+All ops lower straight to jax.numpy — XLA fuses chains of these into single
+kernels on TPU, which supersedes the reference engine's op-bulking
+(threaded_engine.h:411 BulkStatus).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# unary math
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": lambda jnp, x: jnp.abs(x),
+    "sign": lambda jnp, x: jnp.sign(x),
+    "round": lambda jnp, x: jnp.round(x),
+    "rint": lambda jnp, x: jnp.rint(x),
+    "ceil": lambda jnp, x: jnp.ceil(x),
+    "floor": lambda jnp, x: jnp.floor(x),
+    "trunc": lambda jnp, x: jnp.trunc(x),
+    "fix": lambda jnp, x: jnp.fix(x),
+    "square": lambda jnp, x: jnp.square(x),
+    "sqrt": lambda jnp, x: jnp.sqrt(x),
+    "rsqrt": lambda jnp, x: 1.0 / jnp.sqrt(x),
+    "cbrt": lambda jnp, x: jnp.cbrt(x),
+    "rcbrt": lambda jnp, x: 1.0 / jnp.cbrt(x),
+    "exp": lambda jnp, x: jnp.exp(x),
+    "log": lambda jnp, x: jnp.log(x),
+    "log10": lambda jnp, x: jnp.log10(x),
+    "log2": lambda jnp, x: jnp.log2(x),
+    "log1p": lambda jnp, x: jnp.log1p(x),
+    "expm1": lambda jnp, x: jnp.expm1(x),
+    "gamma": lambda jnp, x: _gamma_fn(x),
+    "gammaln": lambda jnp, x: _gammaln_fn(x),
+    "sin": lambda jnp, x: jnp.sin(x),
+    "cos": lambda jnp, x: jnp.cos(x),
+    "tan": lambda jnp, x: jnp.tan(x),
+    "arcsin": lambda jnp, x: jnp.arcsin(x),
+    "arccos": lambda jnp, x: jnp.arccos(x),
+    "arctan": lambda jnp, x: jnp.arctan(x),
+    "degrees": lambda jnp, x: jnp.degrees(x),
+    "radians": lambda jnp, x: jnp.radians(x),
+    "sinh": lambda jnp, x: jnp.sinh(x),
+    "cosh": lambda jnp, x: jnp.cosh(x),
+    "tanh": lambda jnp, x: jnp.tanh(x),
+    "arcsinh": lambda jnp, x: jnp.arcsinh(x),
+    "arccosh": lambda jnp, x: jnp.arccosh(x),
+    "arctanh": lambda jnp, x: jnp.arctanh(x),
+    "negative": lambda jnp, x: -x,
+    "reciprocal": lambda jnp, x: 1.0 / x,
+    "sigmoid": lambda jnp, x: _sigmoid(jnp, x),
+    "softsign": lambda jnp, x: x / (1.0 + jnp.abs(x)),
+    "relu": lambda jnp, x: jnp.maximum(x, 0),
+    "erf": lambda jnp, x: _erf_fn(x),
+    "erfinv": lambda jnp, x: _erfinv_fn(x),
+    "logical_not": lambda jnp, x: (x == 0).astype(x.dtype),
+    "isnan": lambda jnp, x: jnp.isnan(x),
+    "isinf": lambda jnp, x: jnp.isinf(x),
+    "identity": lambda jnp, x: x,
+}
+
+
+def _sigmoid(jnp, x):
+    import jax
+    return jax.nn.sigmoid(x)
+
+
+def _erf_fn(x):
+    import jax.scipy.special as jsp
+    return jsp.erf(x)
+
+
+def _erfinv_fn(x):
+    import jax.scipy.special as jsp
+    return jsp.erfinv(x)
+
+
+def _gamma_fn(x):
+    import jax.scipy.special as jsp
+    return jsp.gamma(x) if hasattr(jsp, "gamma") else _jnp().exp(jsp.gammaln(x))
+
+
+def _gammaln_fn(x):
+    import jax.scipy.special as jsp
+    return jsp.gammaln(x)
+
+
+def _make_unary(name, fn):
+    @register(name)
+    def _op(attrs, x, _fn=fn):
+        return _fn(_jnp(), x)
+    return _op
+
+
+for _name, _fn in _UNARY.items():
+    _make_unary(_name, _fn)
+
+alias("_copy", "identity")
+alias("stop_gradient", "BlockGrad_impl") if False else None
+
+
+@register("BlockGrad")
+def _block_grad(attrs, x):
+    import jax
+    return jax.lax.stop_gradient(x)
+
+
+alias("stop_gradient", "BlockGrad")
+
+
+@register("make_loss")
+def _make_loss(attrs, x):
+    return x
+
+
+@register("Cast")
+def _cast(attrs, x):
+    dtype = attrs.get("dtype", "float32")
+    if dtype == "bfloat16":
+        return x.astype(_jnp().bfloat16)
+    return x.astype(_np.dtype(dtype))
+
+
+alias("cast", "Cast")
+
+
+@register("zeros_like")
+def _zeros_like(attrs, x):
+    return _jnp().zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(attrs, x):
+    return _jnp().ones_like(x)
+
+
+# ---------------------------------------------------------------------------
+# scalar ops  (src/operator/tensor/elemwise_binary_scalar_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+def _make_scalar(name, fn):
+    @register(name)
+    def _op(attrs, x, _fn=fn):
+        s = attrs.get("scalar", 1.0)
+        if attrs.get("reverse", False):
+            return _fn(_jnp(), s, x)
+        return _fn(_jnp(), x, s)
+    return _op
+
+
+_SCALAR = {
+    "_plus_scalar": lambda jnp, a, b: a + b,
+    "_minus_scalar": lambda jnp, a, b: a - b,
+    "_mul_scalar": lambda jnp, a, b: a * b,
+    "_div_scalar": lambda jnp, a, b: a / b,
+    "_mod_scalar": lambda jnp, a, b: jnp.mod(a, b),
+    "_power_scalar": lambda jnp, a, b: jnp.power(a, b),
+    "_maximum_scalar": lambda jnp, a, b: jnp.maximum(a, b),
+    "_minimum_scalar": lambda jnp, a, b: jnp.minimum(a, b),
+    "_hypot_scalar": lambda jnp, a, b: jnp.hypot(a, b),
+    "_equal_scalar": lambda jnp, a, b: (a == b).astype(_res_dtype(a)),
+    "_not_equal_scalar": lambda jnp, a, b: (a != b).astype(_res_dtype(a)),
+    "_greater_scalar": lambda jnp, a, b: (a > b).astype(_res_dtype(a)),
+    "_greater_equal_scalar": lambda jnp, a, b: (a >= b).astype(_res_dtype(a)),
+    "_lesser_scalar": lambda jnp, a, b: (a < b).astype(_res_dtype(a)),
+    "_lesser_equal_scalar": lambda jnp, a, b: (a <= b).astype(_res_dtype(a)),
+    "_logical_and_scalar": lambda jnp, a, b: ((a != 0) & (b != 0)).astype(_res_dtype(a)),
+    "_logical_or_scalar": lambda jnp, a, b: ((a != 0) | (b != 0)).astype(_res_dtype(a)),
+    "_logical_xor_scalar": lambda jnp, a, b: ((a != 0) ^ (b != 0)).astype(_res_dtype(a)),
+}
+
+
+def _res_dtype(a):
+    dt = a.dtype
+    return dt
+
+
+for _name, _fn in _SCALAR.items():
+    _make_scalar(_name, _fn)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise + broadcast
+# (MXNet distinguishes elemwise_* — same shape — from broadcast_*; on TPU both
+#  lower to the same XLA HLO, so elemwise names alias broadcast ops.)
+# ---------------------------------------------------------------------------
+
+def _make_binary(name, fn):
+    @register(name)
+    def _op(attrs, a, b, _fn=fn):
+        return _fn(_jnp(), a, b)
+    return _op
+
+
+_BINARY = {
+    "broadcast_add": lambda jnp, a, b: a + b,
+    "broadcast_sub": lambda jnp, a, b: a - b,
+    "broadcast_mul": lambda jnp, a, b: a * b,
+    "broadcast_div": lambda jnp, a, b: a / b,
+    "broadcast_mod": lambda jnp, a, b: jnp.mod(a, b),
+    "broadcast_power": lambda jnp, a, b: jnp.power(a, b),
+    "broadcast_maximum": lambda jnp, a, b: jnp.maximum(a, b),
+    "broadcast_minimum": lambda jnp, a, b: jnp.minimum(a, b),
+    "broadcast_hypot": lambda jnp, a, b: jnp.hypot(a, b),
+    "broadcast_equal": lambda jnp, a, b: (a == b).astype(_res_dtype(a)),
+    "broadcast_not_equal": lambda jnp, a, b: (a != b).astype(_res_dtype(a)),
+    "broadcast_greater": lambda jnp, a, b: (a > b).astype(_res_dtype(a)),
+    "broadcast_greater_equal": lambda jnp, a, b: (a >= b).astype(_res_dtype(a)),
+    "broadcast_lesser": lambda jnp, a, b: (a < b).astype(_res_dtype(a)),
+    "broadcast_lesser_equal": lambda jnp, a, b: (a <= b).astype(_res_dtype(a)),
+    "broadcast_logical_and": lambda jnp, a, b: ((a != 0) & (b != 0)).astype(_res_dtype(a)),
+    "broadcast_logical_or": lambda jnp, a, b: ((a != 0) | (b != 0)).astype(_res_dtype(a)),
+    "broadcast_logical_xor": lambda jnp, a, b: ((a != 0) ^ (b != 0)).astype(_res_dtype(a)),
+    "arctan2": lambda jnp, a, b: jnp.arctan2(a, b),
+    "ldexp": lambda jnp, a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+}
+
+for _name, _fn in _BINARY.items():
+    _make_binary(_name, _fn)
+
+alias("elemwise_add", "broadcast_add")
+alias("elemwise_sub", "broadcast_sub")
+alias("elemwise_mul", "broadcast_mul")
+alias("elemwise_div", "broadcast_div")
+alias("_plus", "broadcast_add")
+alias("_sub", "broadcast_sub")
+alias("_mul", "broadcast_mul")
+alias("_div", "broadcast_div")
+alias("_maximum", "broadcast_maximum")
+alias("_minimum", "broadcast_minimum")
+alias("_power", "broadcast_power")
+alias("maximum", "broadcast_maximum")
+alias("minimum", "broadcast_minimum")
+
+
+@register("add_n")
+def _add_n(attrs, *arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+alias("ElementWiseSum", "add_n")
+
+
+@register("smooth_l1")
+def _smooth_l1(attrs, x):
+    jnp = _jnp()
+    sigma = float(attrs.get("scalar", 1.0))
+    s2 = sigma * sigma
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)

@@ -1,0 +1,170 @@
+"""Linear-algebra ops (mx.nd.linalg namespace).
+
+Reference: src/operator/tensor/la_op.cc — gemm/gemm2, potrf/potri (Cholesky),
+trsm/trmm, syrk, gelqf (LQ), syevd, sumlogdiag.  Lowered to jnp.linalg /
+lax.linalg; batching is native (leading dims map to XLA batch dims).
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _t(x):
+    return _jnp().swapaxes(x, -1, -2)
+
+
+@register("_linalg_gemm")
+def _linalg_gemm(attrs, A, B, C):
+    jnp = _jnp()
+    ta, tb = bool(attrs.get("transpose_a", False)), bool(attrs.get("transpose_b", False))
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    a = _t(A) if ta else A
+    b = _t(B) if tb else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_gemm2")
+def _linalg_gemm2(attrs, A, B):
+    jnp = _jnp()
+    ta, tb = bool(attrs.get("transpose_a", False)), bool(attrs.get("transpose_b", False))
+    alpha = float(attrs.get("alpha", 1.0))
+    a = _t(A) if ta else A
+    b = _t(B) if tb else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf")
+def _linalg_potrf(attrs, A):
+    jnp = _jnp()
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri")
+def _linalg_potri(attrs, A):
+    """Inverse from Cholesky factor: (L L^T)^-1 given L."""
+    jnp = _jnp()
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    import jax.scipy.linalg as jsl
+    Linv = jsl.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(_t(Linv), Linv)
+
+
+@register("_linalg_trsm")
+def _linalg_trsm(attrs, A, B):
+    import jax.scipy.linalg as jsl
+    jnp = _jnp()
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    lower = bool(attrs.get("lower", True))
+    alpha = float(attrs.get("alpha", 1.0))
+    if rightside:
+        # solve X A = alpha B  =>  A^T X^T = alpha B^T
+        X = jsl.solve_triangular(_t(A), _t(B) * alpha, lower=not lower,
+                                 trans=1 if transpose else 0)
+        return _t(X)
+    return jsl.solve_triangular(A, B * alpha, lower=lower,
+                                trans=1 if transpose else 0)
+
+
+@register("_linalg_trmm")
+def _linalg_trmm(attrs, A, B):
+    jnp = _jnp()
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    lower = bool(attrs.get("lower", True))
+    alpha = float(attrs.get("alpha", 1.0))
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = _t(tri)
+    if rightside:
+        return alpha * jnp.matmul(B, tri)
+    return alpha * jnp.matmul(tri, B)
+
+
+@register("_linalg_syrk")
+def _linalg_syrk(attrs, A):
+    jnp = _jnp()
+    transpose = bool(attrs.get("transpose", False))
+    alpha = float(attrs.get("alpha", 1.0))
+    if transpose:
+        return alpha * jnp.matmul(_t(A), A)
+    return alpha * jnp.matmul(A, _t(A))
+
+
+@register("_linalg_gelqf", num_outputs=2)
+def _linalg_gelqf(attrs, A):
+    jnp = _jnp()
+    q, r = jnp.linalg.qr(_t(A))
+    # LQ of A: A = L Q  with  L = R^T, Q = Q^T
+    return _t(r), _t(q)
+
+
+@register("_linalg_syevd", num_outputs=2)
+def _linalg_syevd(attrs, A):
+    jnp = _jnp()
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@register("_linalg_sumlogdiag")
+def _linalg_sumlogdiag(attrs, A):
+    jnp = _jnp()
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_extractdiag")
+def _linalg_extractdiag(attrs, A):
+    jnp = _jnp()
+    return jnp.diagonal(A, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag")
+def _linalg_makediag(attrs, d):
+    jnp = _jnp()
+    n = d.shape[-1]
+    out = jnp.zeros(d.shape + (n,), dtype=d.dtype)
+    idx = jnp.arange(n)
+    return out.at[..., idx, idx].set(d)
+
+
+@register("_linalg_extracttrian")
+def _linalg_extracttrian(attrs, A):
+    jnp = _jnp()
+    lower = bool(attrs.get("lower", True))
+    offset = int(attrs.get("offset", 0))
+    n = A.shape[-1]
+    rows, cols = [], []
+    import numpy as np
+    for i in range(n):
+        for j in range(n):
+            if (lower and j <= i + offset) or (not lower and j >= i + offset):
+                if lower and j > i + offset:
+                    continue
+                if not lower and j < i + offset:
+                    continue
+                rows.append(i); cols.append(j)
+    return A[..., np.array(rows), np.array(cols)]
+
+
+@register("_linalg_inverse")
+def _linalg_inverse(attrs, A):
+    return _jnp().linalg.inv(A)
+
+
+@register("_linalg_det")
+def _linalg_det(attrs, A):
+    return _jnp().linalg.det(A)
+
+
+@register("_linalg_slogdet", num_outputs=2)
+def _linalg_slogdet(attrs, A):
+    jnp = _jnp()
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet

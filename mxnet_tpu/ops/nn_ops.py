@@ -1,0 +1,776 @@
+"""Neural-network ops.
+
+Reference: src/operator/nn/ (fully_connected.cc, convolution.cc, pooling.cc,
+batch_norm.cc, layer_norm.cc, dropout.cc, activation.cc, softmax.cc, lrn.cc,
+upsampling.cc, deconvolution.cc), src/operator/{softmax_output,regression_output,
+leaky_relu,l2_normalization,instance_norm}.cc, sequence_*.cc, rnn-inl.h.
+
+TPU-native notes:
+  * Convolutions keep the reference's NCHW *API* layout but are computed by
+    ``lax.conv_general_dilated``; on TPU, XLA's layout assignment retiles to
+    the MXU-preferred internal layout, so no hand-written im2col (the analog
+    of the MKLDNN layout trick noted at SURVEY §7 hard-part f).
+  * BatchNorm returns (out, mean, var) in training so the *caller* updates
+    running stats — keeps the op pure for XLA; the Gluon layer and CachedOp
+    thread aux state functionally.
+  * The fused RNN op is a ``lax.scan`` over time — the compiler pipelines the
+    per-step matmuls; weights stay resident in VMEM across steps.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        t = tuple(int(x) for x in v)
+        return t if len(t) == n else t * n
+    return (int(v),) * n
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected")
+def _fully_connected(attrs, data, weight, bias=None):
+    """y = x @ W^T + b  (src/operator/nn/fully_connected.cc:239-328)."""
+    jnp = _jnp()
+    flatten = bool(attrs.get("flatten", True))
+    if flatten and data.ndim > 2:
+        data = data.reshape((data.shape[0], -1))
+    out = jnp.matmul(data, weight.T)
+    if not attrs.get("no_bias", False) and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+def _conv_dims(ndim):
+    if ndim == 3:
+        return ("NCW", "OIW", "NCW")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register("Convolution")
+def _convolution(attrs, data, weight, bias=None):
+    """N-D convolution, NCHW/OIHW API layout (src/operator/nn/convolution.cc)."""
+    lax = _lax()
+    nd = data.ndim - 2
+    kernel = _pair(attrs["kernel"], nd)
+    stride = _pair(attrs.get("stride", (1,) * nd), nd)
+    pad = _pair(attrs.get("pad", (0,) * nd), nd)
+    dilate = _pair(attrs.get("dilate", (1,) * nd), nd)
+    num_group = int(attrs.get("num_group", 1))
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        lhs_dilation=(1,) * nd,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=None)
+    if not attrs.get("no_bias", False) and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution")
+def _deconvolution(attrs, data, weight, bias=None):
+    """Transposed convolution (src/operator/nn/deconvolution.cc)."""
+    lax = _lax()
+    jnp = _jnp()
+    nd = data.ndim - 2
+    kernel = _pair(attrs["kernel"], nd)
+    stride = _pair(attrs.get("stride", (1,) * nd), nd)
+    pad = _pair(attrs.get("pad", (0,) * nd), nd)
+    adj = _pair(attrs.get("adj", (0,) * nd), nd)
+    num_group = int(attrs.get("num_group", 1))
+    # weight layout (in_c, out_c/g, *kernel) per MXNet deconvolution
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
+    pads = [(k - 1 - p + a, k - 1 - p + a) for k, p, a in zip(kernel, pad, adj)]
+    w = jnp.swapaxes(weight, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    if num_group > 1:
+        # grouped transposed conv: split along channel groups
+        outs = []
+        xg = jnp.split(data, num_group, axis=1)
+        wg = jnp.split(weight, num_group, axis=0)
+        for xi, wi in zip(xg, wg):
+            wi = jnp.flip(jnp.swapaxes(wi, 0, 1), axis=tuple(range(2, 2 + nd)))
+            outs.append(lax.conv_general_dilated(
+                xi, wi, window_strides=(1,) * nd, padding=pads,
+                lhs_dilation=stride, rhs_dilation=(1,) * nd,
+                dimension_numbers=dn))
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = lax.conv_general_dilated(
+            data, w, window_strides=(1,) * nd, padding=pads,
+            lhs_dilation=stride, rhs_dilation=(1,) * nd,
+            dimension_numbers=dn)
+    if not attrs.get("no_bias", True) and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+@register("Pooling")
+def _pooling(attrs, data):
+    """max/avg/sum pooling via lax.reduce_window (src/operator/nn/pooling.cc)."""
+    lax = _lax()
+    jnp = _jnp()
+    nd = data.ndim - 2
+    pool_type = attrs.get("pool_type", "max")
+    global_pool = bool(attrs.get("global_pool", False))
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=axes, keepdims=True)
+        elif pool_type in ("avg", "sum"):
+            out = jnp.mean(data, axis=axes, keepdims=True) if pool_type == "avg" \
+                else jnp.sum(data, axis=axes, keepdims=True)
+        else:
+            raise ValueError(pool_type)
+        return out
+    kernel = _pair(attrs["kernel"], nd)
+    stride = _pair(attrs.get("stride", (1,) * nd), nd)
+    pad = _pair(attrs.get("pad", (0,) * nd), nd)
+    pooling_convention = attrs.get("pooling_convention", "valid")
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad right edge so ceil((x+2p-k)/s)+1 windows fit
+        extra = []
+        for i in range(nd):
+            x = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            rem = x % stride[i]
+            e = 0 if rem == 0 else stride[i] - rem
+            extra.append(e)
+        pads = [(0, 0), (0, 0)] + [(pad[i], pad[i] + extra[i]) for i in range(nd)]
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if bool(attrs.get("count_include_pad", True)):
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    raise ValueError("unsupported pool_type %s" % pool_type)
+
+
+@register("UpSampling")
+def _upsampling(attrs, *inputs):
+    jnp = _jnp()
+    scale = int(attrs["scale"])
+    sample_type = attrs.get("sample_type", "nearest")
+    x = inputs[0]
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        return out
+    if sample_type == "bilinear":
+        import jax
+        n, c, h, w = x.shape
+        out = jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+        return out
+    raise ValueError(sample_type)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", num_outputs=3, mode_dependent=True)
+def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
+    """Batch normalization (src/operator/nn/batch_norm.cc).
+
+    Returns (out, mean, var).  In training (and not use_global_stats) the
+    returned mean/var are the batch statistics; the caller folds them into the
+    running averages (functional aux-state update — see gluon/nn BatchNorm)."""
+    jnp = _jnp()
+    eps = float(attrs.get("eps", 1e-3))
+    axis = int(attrs.get("axis", 1))
+    fix_gamma = bool(attrs.get("fix_gamma", True))
+    use_global = bool(attrs.get("use_global_stats", False)) or not attrs.get("_training", False)
+    axes = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    bshape = tuple(bshape)
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if use_global:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+    inv = jnp.reshape(gamma, bshape) / jnp.sqrt(jnp.reshape(var, bshape) + eps)
+    out = (data - jnp.reshape(mean, bshape)) * inv + jnp.reshape(beta, bshape)
+    return out, mean, var
+
+
+@register("LayerNorm")
+def _layer_norm(attrs, data, gamma, beta):
+    jnp = _jnp()
+    axis = int(attrs.get("axis", -1))
+    eps = float(attrs.get("eps", 1e-5))
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) / jnp.sqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm")
+def _instance_norm(attrs, data, gamma, beta):
+    jnp = _jnp()
+    eps = float(attrs.get("eps", 1e-3))
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    out = (data - mean) / jnp.sqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def _l2_normalization(attrs, data):
+    jnp = _jnp()
+    eps = float(attrs.get("eps", 1e-10))
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("LRN")
+def _lrn(attrs, data):
+    jnp = _jnp()
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    knorm = float(attrs.get("knorm", 2.0))
+    nsize = int(attrs["nsize"])
+    sq = jnp.square(data)
+    pad = nsize // 2
+    sq_pad = jnp.pad(sq, [(0, 0), (pad, pad), (0, 0), (0, 0)])
+    acc = jnp.zeros_like(data)
+    for i in range(nsize):
+        acc = acc + sq_pad[:, i:i + data.shape[1], :, :]
+    return data / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations / softmax
+# ---------------------------------------------------------------------------
+
+@register("Activation")
+def _activation(attrs, data):
+    import jax
+    jnp = _jnp()
+    act = attrs.get("act_type", "relu")
+    if act == "relu":
+        return jnp.maximum(data, 0)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act == "tanh":
+        return jnp.tanh(data)
+    if act == "softrelu":
+        return jax.nn.softplus(data)
+    if act == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise ValueError("unknown act_type %s" % act)
+
+
+@register("LeakyReLU")
+def _leaky_relu(attrs, data, gamma=None):
+    import jax
+    jnp = _jnp()
+    act = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if act == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act == "elu":
+        return jnp.where(data >= 0, data, slope * (jnp.exp(data) - 1))
+    if act == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * (jnp.exp(data) - 1))
+    if act == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act == "gelu":
+        return jax.nn.gelu(data)
+    if act == "rrelu":  # eval-mode deterministic
+        lower = float(attrs.get("lower_bound", 0.125))
+        upper = float(attrs.get("upper_bound", 0.334))
+        return jnp.where(data >= 0, data, (lower + upper) / 2 * data)
+    raise ValueError("unknown act_type %s" % act)
+
+
+@register("softmax")
+def _softmax(attrs, data, length=None):
+    import jax
+    axis = int(attrs.get("axis", -1))
+    temperature = attrs.get("temperature")
+    if temperature:
+        data = data / float(temperature)
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax")
+def _log_softmax(attrs, data):
+    import jax
+    axis = int(attrs.get("axis", -1))
+    temperature = attrs.get("temperature")
+    if temperature:
+        data = data / float(temperature)
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register("softmin")
+def _softmin(attrs, data):
+    import jax
+    axis = int(attrs.get("axis", -1))
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(attrs, data):
+    import jax
+    mode = attrs.get("mode", "instance")
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("SoftmaxOutput")
+def _softmax_output(attrs, data, label):
+    """Softmax forward with implicit cross-entropy backward
+    (src/operator/softmax_output.cc): grad(data) = softmax - one_hot(label).
+    Implemented as a jax.custom_vjp so the tape's jax.vjp picks up the
+    reference's gradient semantics (incl. ignore_label / normalization)."""
+    import jax
+    jnp = _jnp()
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+    ignore_label = attrs.get("ignore_label")
+    use_ignore = bool(attrs.get("use_ignore", False))
+    multi_output = bool(attrs.get("multi_output", False))
+    normalization = attrs.get("normalization", "null")
+    preserve_shape = bool(attrs.get("preserve_shape", False))
+    axis = 1 if (multi_output or preserve_shape) else -1
+
+    @jax.custom_vjp
+    def f(d, l):
+        if not multi_output and not preserve_shape and d.ndim > 2:
+            d = d.reshape(d.shape[0], -1)
+        return jax.nn.softmax(d, axis=axis)
+
+    def f_fwd(d, l):
+        out = f(d, l)
+        return out, (out, l)
+
+    def f_bwd(res, g):
+        out, l = res
+        nclass = out.shape[axis]
+        oh = jax.nn.one_hot(l.astype(jnp.int32), nclass, axis=axis)
+        grad = out - oh
+        scale = grad_scale
+        if use_ignore and ignore_label is not None:
+            mask = (l != ignore_label).astype(out.dtype)
+            mask = jnp.expand_dims(mask, axis) if mask.ndim < out.ndim else mask
+            grad = grad * mask
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            if use_ignore and ignore_label is not None:
+                valid = jnp.maximum(jnp.sum(l != ignore_label), 1)
+            else:
+                valid = l.size
+            grad = grad / valid
+        return (scale * grad).astype(out.dtype), None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label)
+
+
+alias("Softmax", "SoftmaxOutput")
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(attrs, data, label):
+    import jax
+    jnp = _jnp()
+    logp = jax.nn.log_softmax(data, axis=-1)
+    oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1])
+    return -jnp.sum(oh * logp).reshape((1,))
+
+
+@register("LinearRegressionOutput")
+def _linear_regression_output(attrs, data, label):
+    import jax
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def f_fwd(d, l):
+        return d, (d, l)
+
+    def f_bwd(res, g):
+        d, l = res
+        return (grad_scale * (d - l.reshape(d.shape)) / d.shape[0], None)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label)
+
+
+@register("MAERegressionOutput")
+def _mae_regression_output(attrs, data, label):
+    import jax
+    jnp = _jnp()
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def f_fwd(d, l):
+        return d, (d, l)
+
+    def f_bwd(res, g):
+        d, l = res
+        return (grad_scale * jnp.sign(d - l.reshape(d.shape)) / d.shape[0], None)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label)
+
+
+@register("LogisticRegressionOutput")
+def _logistic_regression_output(attrs, data, label):
+    import jax
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.sigmoid(d)
+
+    def f_fwd(d, l):
+        out = jax.nn.sigmoid(d)
+        return out, (out, l)
+
+    def f_bwd(res, g):
+        out, l = res
+        return (grad_scale * (out - l.reshape(out.shape)) / out.shape[0], None)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label)
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+@register("Dropout", mode_dependent=True, needs_rng=True)
+def _dropout(attrs, data):
+    import jax
+    jnp = _jnp()
+    p = float(attrs.get("p", 0.5))
+    mode = attrs.get("mode", "training")
+    training = bool(attrs.get("_training", False))
+    axes = attrs.get("axes", ())
+    if (not training and mode != "always") or p <= 0:
+        return data
+    key = attrs["_rng_key"]
+    if axes:
+        shape = tuple(1 if i in tuple(axes) else s for i, s in enumerate(data.shape))
+    else:
+        shape = data.shape
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (src/operator/sequence_mask.cc, sequence_last.cc, sequence_reverse.cc)
+# ---------------------------------------------------------------------------
+
+@register("SequenceMask")
+def _sequence_mask(attrs, data, sequence_length=None):
+    jnp = _jnp()
+    use_len = bool(attrs.get("use_sequence_length", False))
+    value = float(attrs.get("value", 0.0))
+    axis = int(attrs.get("axis", 0))  # time axis
+    if not use_len or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    # data layout: (T, B, ...) for axis=0 or (B, T, ...) for axis=1
+    if axis == 0:
+        mask = pos[:, None] < sequence_length[None, :].astype(jnp.int32)
+    else:
+        mask = pos[None, :] < sequence_length[:, None].astype(jnp.int32)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def _sequence_last(attrs, data, sequence_length=None):
+    jnp = _jnp()
+    use_len = bool(attrs.get("use_sequence_length", False))
+    axis = int(attrs.get("axis", 0))
+    if not use_len or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, last.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(attrs, data, sequence_length=None):
+    jnp = _jnp()
+    use_len = bool(attrs.get("use_sequence_length", False))
+    if not use_len or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)
+    pos = jnp.arange(T)[:, None]
+    rev_idx = jnp.where(pos < lens[None, :], lens[None, :] - 1 - pos, pos)
+    return jnp.take_along_axis(data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (src/operator/rnn-inl.h:49) — lax.scan over time
+# ---------------------------------------------------------------------------
+
+def _rnn_num_outputs(attrs):
+    return 2 if attrs.get("mode") == "lstm" and attrs.get("state_outputs", False) \
+        else (2 if attrs.get("state_outputs", False) else 1)
+
+
+@register("RNN", num_outputs=lambda attrs: (3 if attrs.get("mode") == "lstm" else 2)
+         if attrs.get("state_outputs", False) else 1,
+         mode_dependent=True, needs_rng=True)
+def _rnn(attrs, data, parameters, state, state_cell=None):
+    """Fused multi-layer RNN/LSTM/GRU (reference src/operator/rnn-inl.h:49;
+    cudnn path cudnn_rnn-inl.h).  data: (T, B, I); packed parameters follow the
+    cudnn/MXNet canonical order: per layer/direction, i2h weights then h2h
+    weights, then all biases (i2h then h2h).  Computed as lax.scan over time;
+    each step's gate matmul hits the MXU with weights pinned on-chip."""
+    import jax
+    jnp = _jnp()
+    lax = _lax()
+    mode = attrs.get("mode", "lstm")
+    state_size = int(attrs["state_size"])
+    num_layers = int(attrs.get("num_layers", 1))
+    bidirectional = bool(attrs.get("bidirectional", False))
+    state_outputs = bool(attrs.get("state_outputs", False))
+    p_drop = float(attrs.get("p", 0.0))
+    training = bool(attrs.get("_training", False))
+    ndir = 2 if bidirectional else 1
+    ngates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+    T, B, I = data.shape
+    H = state_size
+
+    # --- unpack parameters ------------------------------------------------
+    offset = 0
+
+    def take(n, shape):
+        nonlocal offset
+        w = lax.dynamic_slice(parameters, (offset,), (n,)).reshape(shape)
+        offset += n
+        return w
+
+    Wx, Wh = [], []
+    for layer in range(num_layers):
+        in_size = I if layer == 0 else H * ndir
+        for d in range(ndir):
+            Wx.append(take(ngates * H * in_size, (ngates * H, in_size)))
+            Wh.append(take(ngates * H * H, (ngates * H, H)))
+    Bx, Bh = [], []
+    for layer in range(num_layers):
+        for d in range(ndir):
+            Bx.append(take(ngates * H, (ngates * H,)))
+            Bh.append(take(ngates * H, (ngates * H,)))
+
+    def cell_step(mode, x_proj, h, c, Whh, bh):
+        """One timestep given precomputed input projection."""
+        gates = x_proj + jnp.matmul(h, Whh.T) + bh
+        if mode == "rnn_relu":
+            return jnp.maximum(gates, 0), c
+        if mode == "rnn_tanh":
+            return jnp.tanh(gates), c
+        if mode == "lstm":
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            return o * jnp.tanh(c_new), c_new
+        if mode == "gru":
+            # cudnn GRU: r,z,n gating with separate h2h bias on n
+            xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+            hr, hz, hn = jnp.split(jnp.matmul(h, Whh.T), 3, axis=-1)
+            br, bz, bn = jnp.split(bh, 3)
+            r = jax.nn.sigmoid(xr + hr + br)
+            z = jax.nn.sigmoid(xz + hz + bz)
+            n = jnp.tanh(xn + r * (hn + bn))
+            return (1 - z) * n + z * h, c
+        raise ValueError(mode)
+
+    x = data
+    h_finals, c_finals = [], []
+    key = attrs.get("_rng_key")
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(ndir):
+            li = layer * ndir + d
+            h0 = state[li]
+            c0 = state_cell[li] if mode == "lstm" and state_cell is not None \
+                else jnp.zeros_like(h0)
+            xs = jnp.flip(x, axis=0) if d == 1 else x
+            # big batched input projection: (T*B, in) @ (in, G*H) on the MXU
+            x_proj = jnp.einsum("tbi,gi->tbg", xs, Wx[li]) + Bx[li]
+
+            def step(carry, xp, _Whh=Wh[li], _bh=Bh[li]):
+                h, c = carry
+                h2, c2 = cell_step(mode, xp, h, c, _Whh, _bh)
+                return (h2, c2), h2
+
+            (hT, cT), ys = lax.scan(step, (h0, c0), x_proj)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs_dir.append(ys)
+            h_finals.append(hT)
+            c_finals.append(cT)
+        x = jnp.concatenate(outs_dir, axis=-1) if ndir == 2 else outs_dir[0]
+        if p_drop > 0 and training and layer < num_layers - 1 and key is not None:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - p_drop, x.shape).astype(x.dtype)
+            x = x * mask / (1 - p_drop)
+
+    if not state_outputs:
+        return x
+    hs = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        cs = jnp.stack(c_finals, axis=0)
+        return x, hs, cs
+    return x, hs
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+@register("Correlation")
+def _correlation(attrs, data1, data2):
+    raise NotImplementedError("Correlation op: planned (optical-flow workloads)")
+
+
+@register("GridGenerator")
+def _grid_generator(attrs, data):
+    jnp = _jnp()
+    transform_type = attrs.get("transform_type", "affine")
+    target_shape = tuple(attrs.get("target_shape", (0, 0)))
+    if transform_type == "affine":
+        H, W = target_shape
+        ys = jnp.linspace(-1, 1, H)
+        xs = jnp.linspace(-1, 1, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        grid = jnp.stack([gx.reshape(-1), gy.reshape(-1), ones.reshape(-1)], axis=0)
+        theta = data.reshape((-1, 2, 3))
+        out = jnp.matmul(theta, grid)
+        return out.reshape((-1, 2, H, W))
+    # warp
+    flow = data
+    n, _, H, W = flow.shape
+    ys = jnp.arange(H, dtype=flow.dtype)
+    xs = jnp.arange(W, dtype=flow.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    gx2 = (gx[None] + flow[:, 0]) / max((W - 1) / 2.0, 1) - 1
+    gy2 = (gy[None] + flow[:, 1]) / max((H - 1) / 2.0, 1) - 1
+    return jnp.stack([gx2, gy2], axis=1)
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(attrs, data, grid):
+    jnp = _jnp()
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1) * (h - 1) / 2.0
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(xi, yi):
+        xi = jnp.clip(xi, 0, w - 1)
+        yi = jnp.clip(yi, 0, h - 1)
+        bidx = jnp.arange(n).reshape(n, 1, 1)
+        return data[bidx, :, yi, xi]  # (n, Ho, Wo, c)
+
+    v00 = gather(x0, y0)
+    v01 = gather(x1, y0)
+    v10 = gather(x0, y1)
+    v11 = gather(x1, y1)
+    wx_ = wx[..., None]
+    wy_ = wy[..., None]
+    out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+           + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(attrs, data, loc):
+    jnp = _jnp()
+    target_shape = tuple(attrs.get("target_shape", (0, 0)))
+    grid = _grid_generator({"transform_type": "affine", "target_shape": target_shape}, loc)
+    return _bilinear_sampler({}, data, grid)
+
+
+@register("IdentityAttachKLSparseReg")
+def _identity_attach_kl(attrs, data):
+    return data

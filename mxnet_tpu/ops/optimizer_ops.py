@@ -1,0 +1,171 @@
+"""Fused optimizer-update ops.
+
+Reference: src/operator/optimizer_op.cc — sgd_update, sgd_mom_update,
+mp_sgd_update (fp16 multi-precision with fp32 master weights), adam_update,
+rmsprop_update, rmspropalex_update, ftrl_update, signsgd_update, signum_update,
+ftml_update, nag updates.
+
+Each op returns the new weight (and new states); the Python Optimizer writes
+them back through ``invoke(..., out=...)`` — on TPU the whole update chain is
+one fused XLA kernel per (shape, dtype), and under a hybridized training step
+it fuses into the same module as the backward pass.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _common(attrs):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = attrs.get("clip_gradient", -1.0)
+    return lr, wd, rescale, (float(clip) if clip is not None else -1.0)
+
+
+def _prep_grad(jnp, grad, rescale, clip):
+    g = grad * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+@register("sgd_update")
+def _sgd_update(attrs, weight, grad):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    g = _prep_grad(jnp, grad, rescale, clip)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", num_outputs=2)
+def _sgd_mom_update(attrs, weight, grad, mom):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    momentum = float(attrs.get("momentum", 0.0))
+    g = _prep_grad(jnp, grad, rescale, clip)
+    mom_new = momentum * mom - lr * (g + wd * weight)
+    return weight + mom_new, mom_new
+
+
+@register("mp_sgd_update", num_outputs=2)
+def _mp_sgd_update(attrs, weight, grad, weight32):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    g = _prep_grad(jnp, grad.astype(jnp.float32), rescale, clip)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    momentum = float(attrs.get("momentum", 0.0))
+    g = _prep_grad(jnp, grad.astype(jnp.float32), rescale, clip)
+    mom_new = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@register("adam_update", num_outputs=3)
+def _adam_update(attrs, weight, grad, mean, var):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    beta1 = float(attrs.get("beta1", 0.9))
+    beta2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    lazy = bool(attrs.get("lazy_update", True))
+    g = _prep_grad(jnp, grad, rescale, clip) + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * m / (jnp.sqrt(v) + eps)
+    return w, m, v
+
+
+@register("rmsprop_update", num_outputs=2)
+def _rmsprop_update(attrs, weight, grad, n):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    gamma1 = float(attrs.get("gamma1", 0.95))
+    eps = float(attrs.get("epsilon", 1e-8))
+    clip_weights = attrs.get("clip_weights", -1.0)
+    g = _prep_grad(jnp, grad, rescale, clip) + wd * weight
+    n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(n_new + eps)
+    if clip_weights and float(clip_weights) > 0:
+        w = jnp.clip(w, -float(clip_weights), float(clip_weights))
+    return w, n_new
+
+
+@register("rmspropalex_update", num_outputs=4)
+def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    gamma1 = float(attrs.get("gamma1", 0.95))
+    gamma2 = float(attrs.get("gamma2", 0.9))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(jnp, grad, rescale, clip) + wd * weight
+    n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    g_new = (1 - gamma1) * g + gamma1 * g_state
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(n_new - jnp.square(g_new) + eps)
+    return weight + delta_new, n_new, g_new, delta_new
+
+
+@register("ftrl_update", num_outputs=3)
+def _ftrl_update(attrs, weight, grad, z, n):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    lamda1 = float(attrs.get("lamda1", 0.01))
+    beta = float(attrs.get("beta", 1.0))
+    g = _prep_grad(jnp, grad, rescale, clip)
+    sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    n_new = n + jnp.square(g)
+    w = jnp.where(
+        jnp.abs(z_new) > lamda1,
+        -(z_new - jnp.sign(z_new) * lamda1) / ((beta + jnp.sqrt(n_new)) / lr + wd),
+        0.0)
+    return w, z_new, n_new
+
+
+@register("signsgd_update")
+def _signsgd_update(attrs, weight, grad):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    g = _prep_grad(jnp, grad, rescale, clip)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", num_outputs=2)
+def _signum_update(attrs, weight, grad, mom):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    momentum = float(attrs.get("momentum", 0.0))
+    wd_lh = float(attrs.get("wd_lh", 0.0))
+    g = _prep_grad(jnp, grad, rescale, clip)
+    mom_new = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new) - lr * wd * weight
+    return w, mom_new
+
+
+@register("ftml_update", num_outputs=4)
+def _ftml_update(attrs, weight, grad, d, v, z):
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    beta1 = float(attrs.get("beta1", 0.6))
+    beta2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    t = int(attrs.get("t", 1))
+    g = _prep_grad(jnp, grad, rescale, clip) + wd * weight
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = (1 - beta1 ** t) / lr * (jnp.sqrt(v_new / (1 - beta2 ** t)) + eps)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * g - sigma * weight
+    w = -z_new / d_new
+    return w, d_new, v_new, z_new

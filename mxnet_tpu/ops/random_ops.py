@@ -1,0 +1,199 @@
+"""Random sampling ops.
+
+Reference: src/operator/random/ (sample_op.cc uniform/normal/gamma/exponential/
+poisson/negative_binomial/generalized_negative_binomial, multisample_op.cc
+_sample_* with per-row parameters, sample_multinomial_op.cc, shuffle_op.cc,
+unique_sample_op.cc).
+
+TPU-native: counter-based stateless PRNG (jax.random) — the dispatch layer
+threads a split of the framework-global key into ``attrs['_rng_key']``
+(see mxnet_tpu/random.py for the seed state, the analog of
+src/resource.cc:160-174 global seeding).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _shape_dtype(attrs):
+    shape = attrs.get("shape", (1,))
+    if isinstance(shape, int):
+        shape = (shape,)
+    dtype = attrs.get("dtype") or "float32"
+    return tuple(shape), _np.dtype(dtype)
+
+
+@register("_random_uniform", needs_rng=True)
+def _random_uniform(attrs, *unused):
+    import jax
+    shape, dtype = _shape_dtype(attrs)
+    low = float(attrs.get("low", 0.0))
+    high = float(attrs.get("high", 1.0))
+    return jax.random.uniform(attrs["_rng_key"], shape, dtype=dtype,
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", needs_rng=True)
+def _random_normal(attrs, *unused):
+    import jax
+    shape, dtype = _shape_dtype(attrs)
+    loc = float(attrs.get("loc", 0.0))
+    scale = float(attrs.get("scale", 1.0))
+    return loc + scale * jax.random.normal(attrs["_rng_key"], shape, dtype=dtype)
+
+
+@register("_random_gamma", needs_rng=True)
+def _random_gamma(attrs, *unused):
+    import jax
+    shape, dtype = _shape_dtype(attrs)
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    return jax.random.gamma(attrs["_rng_key"], alpha, shape, dtype=dtype) * beta
+
+
+@register("_random_exponential", needs_rng=True)
+def _random_exponential(attrs, *unused):
+    import jax
+    shape, dtype = _shape_dtype(attrs)
+    lam = float(attrs.get("lam", 1.0))
+    return jax.random.exponential(attrs["_rng_key"], shape, dtype=dtype) / lam
+
+
+@register("_random_poisson", needs_rng=True)
+def _random_poisson(attrs, *unused):
+    import jax
+    shape, dtype = _shape_dtype(attrs)
+    lam = float(attrs.get("lam", 1.0))
+    return jax.random.poisson(attrs["_rng_key"], lam, shape).astype(dtype)
+
+
+@register("_random_negative_binomial", needs_rng=True)
+def _random_negative_binomial(attrs, *unused):
+    import jax
+    shape, dtype = _shape_dtype(attrs)
+    k = float(attrs.get("k", 1.0))
+    p = float(attrs.get("p", 0.5))
+    key1, key2 = jax.random.split(attrs["_rng_key"])
+    lam = jax.random.gamma(key1, k, shape) * (1 - p) / p
+    return jax.random.poisson(key2, lam, shape).astype(dtype)
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True)
+def _random_gen_negative_binomial(attrs, *unused):
+    import jax
+    shape, dtype = _shape_dtype(attrs)
+    mu = float(attrs.get("mu", 1.0))
+    alpha = float(attrs.get("alpha", 1.0))
+    k = 1.0 / max(alpha, 1e-12)
+    p = k / (k + mu)
+    key1, key2 = jax.random.split(attrs["_rng_key"])
+    lam = jax.random.gamma(key1, k, shape) * (1 - p) / p
+    return jax.random.poisson(key2, lam, shape).astype(dtype)
+
+
+@register("_random_randint", needs_rng=True)
+def _random_randint(attrs, *unused):
+    import jax
+    shape, _ = _shape_dtype(attrs)
+    dtype = _np.dtype(attrs.get("dtype") or "int32")
+    low = int(attrs.get("low", 0))
+    high = int(attrs.get("high", 1))
+    return jax.random.randint(attrs["_rng_key"], shape, low, high, dtype=dtype)
+
+
+# per-row-parameter variants (multisample_op.cc): params come as arrays
+@register("_sample_uniform", needs_rng=True)
+def _sample_uniform(attrs, low, high):
+    import jax
+    shape = tuple(attrs.get("shape", ()))
+    out_shape = low.shape + shape
+    u = jax.random.uniform(attrs["_rng_key"], out_shape)
+    bshape = low.shape + (1,) * len(shape)
+    return low.reshape(bshape) + u * (high - low).reshape(bshape)
+
+
+@register("_sample_normal", needs_rng=True)
+def _sample_normal(attrs, mu, sigma):
+    import jax
+    shape = tuple(attrs.get("shape", ()))
+    out_shape = mu.shape + shape
+    n = jax.random.normal(attrs["_rng_key"], out_shape)
+    bshape = mu.shape + (1,) * len(shape)
+    return mu.reshape(bshape) + n * sigma.reshape(bshape)
+
+
+@register("_sample_gamma", needs_rng=True)
+def _sample_gamma(attrs, alpha, beta):
+    import jax
+    shape = tuple(attrs.get("shape", ()))
+    out_shape = alpha.shape + shape
+    bshape = alpha.shape + (1,) * len(shape)
+    g = jax.random.gamma(attrs["_rng_key"], alpha.reshape(bshape), out_shape)
+    return g * beta.reshape(bshape)
+
+
+@register("_sample_exponential", needs_rng=True)
+def _sample_exponential(attrs, lam):
+    import jax
+    shape = tuple(attrs.get("shape", ()))
+    out_shape = lam.shape + shape
+    bshape = lam.shape + (1,) * len(shape)
+    return jax.random.exponential(attrs["_rng_key"], out_shape) / lam.reshape(bshape)
+
+
+@register("_sample_poisson", needs_rng=True)
+def _sample_poisson(attrs, lam):
+    import jax
+    shape = tuple(attrs.get("shape", ()))
+    out_shape = lam.shape + shape
+    bshape = lam.shape + (1,) * len(shape)
+    return jax.random.poisson(attrs["_rng_key"], lam.reshape(bshape), out_shape).astype(lam.dtype)
+
+
+@register("_sample_multinomial", needs_rng=True,
+          num_outputs=lambda attrs: 2 if attrs.get("get_prob", False) else 1)
+def _sample_multinomial(attrs, data):
+    import jax
+    jnp = _jnp()
+    shape = attrs.get("shape", ())
+    if isinstance(shape, int):
+        shape = (shape,)
+    shape = tuple(shape) or (1,)
+    get_prob = bool(attrs.get("get_prob", False))
+    dtype = _np.dtype(attrs.get("dtype", "int32"))
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = 1
+    for s in shape:
+        n *= s
+    if data.ndim == 1:
+        idx = jax.random.categorical(attrs["_rng_key"], logits, shape=(n,)).reshape(shape)
+    else:
+        idx = jax.random.categorical(attrs["_rng_key"], logits[:, None, :],
+                                     axis=-1, shape=(data.shape[0], n))
+        idx = idx.reshape((data.shape[0],) + shape)
+    idx = idx.astype(dtype)
+    if get_prob:
+        lp = jnp.log(jnp.maximum(data, 1e-37))
+        if data.ndim == 1:
+            p = lp[idx]
+        else:
+            p = jnp.take_along_axis(lp, idx.reshape(data.shape[0], -1).astype(jnp.int32),
+                                    axis=-1).reshape(idx.shape)
+        return idx, p
+    return idx
+
+
+@register("_shuffle", needs_rng=True)
+def _shuffle(attrs, data):
+    import jax
+    return jax.random.permutation(attrs["_rng_key"], data, axis=0)
+
+
+alias("shuffle", "_shuffle")

@@ -1,0 +1,132 @@
+"""Reduction ops.
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc — sum/mean/prod/
+nansum/nanprod/max/min/norm, argmax/argmin/argmax_channel, pick.
+
+MXNet 1.3 semantics preserved: reducing over all axes yields shape ``(1,)``
+(not a 0-d scalar); ``argmax`` returns a float-typed index array.
+"""
+from __future__ import annotations
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+def _make_reduce(name, fn):
+    @register(name)
+    def _op(attrs, x, _fn=fn):
+        jnp = _jnp()
+        axis = _norm_axis(attrs.get("axis"))
+        keepdims = bool(attrs.get("keepdims", False))
+        exclude = bool(attrs.get("exclude", False))
+        if exclude and axis is not None:
+            ax = (axis,) if isinstance(axis, int) else axis
+            axis = tuple(i for i in range(x.ndim) if i not in ax)
+        out = _fn(jnp, x, axis, keepdims)
+        if axis is None and not keepdims:
+            out = out.reshape((1,))
+        return out
+    return _op
+
+
+_REDUCE = {
+    "sum": lambda jnp, x, a, k: jnp.sum(x, axis=a, keepdims=k),
+    "mean": lambda jnp, x, a, k: jnp.mean(x, axis=a, keepdims=k),
+    "prod": lambda jnp, x, a, k: jnp.prod(x, axis=a, keepdims=k),
+    "nansum": lambda jnp, x, a, k: jnp.nansum(x, axis=a, keepdims=k),
+    "nanprod": lambda jnp, x, a, k: jnp.nanprod(x, axis=a, keepdims=k),
+    "max": lambda jnp, x, a, k: jnp.max(x, axis=a, keepdims=k),
+    "min": lambda jnp, x, a, k: jnp.min(x, axis=a, keepdims=k),
+}
+
+for _name, _fn in _REDUCE.items():
+    _make_reduce(_name, _fn)
+
+alias("sum_axis", "sum")
+alias("max_axis", "max")
+alias("min_axis", "min")
+
+
+@register("norm")
+def _norm(attrs, x):
+    jnp = _jnp()
+    ord_ = attrs.get("ord", 2)
+    axis = _norm_axis(attrs.get("axis"))
+    keepdims = bool(attrs.get("keepdims", False))
+    if ord_ == 1:
+        out = jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+    if axis is None and not keepdims:
+        out = out.reshape((1,))
+    return out
+
+
+@register("argmax")
+def _argmax(attrs, x):
+    jnp = _jnp()
+    axis = attrs.get("axis")
+    keepdims = bool(attrs.get("keepdims", False))
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1)).reshape((1,))
+    else:
+        out = jnp.argmax(x, axis=int(axis))
+        if keepdims:
+            out = jnp.expand_dims(out, int(axis))
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def _argmin(attrs, x):
+    jnp = _jnp()
+    axis = attrs.get("axis")
+    keepdims = bool(attrs.get("keepdims", False))
+    if axis is None:
+        out = jnp.argmin(x.reshape(-1)).reshape((1,))
+    else:
+        out = jnp.argmin(x, axis=int(axis))
+        if keepdims:
+            out = jnp.expand_dims(out, int(axis))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def _argmax_channel(attrs, x):
+    jnp = _jnp()
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("pick")
+def _pick(attrs, x, index):
+    jnp = _jnp()
+    axis = attrs.get("axis", -1)
+    keepdims = bool(attrs.get("keepdims", False))
+    mode = attrs.get("mode", "clip")
+    if axis is None:
+        flat = x.reshape(-1)
+        idx = index.astype(jnp.int32).reshape(-1)
+        out = flat[idx]
+        return out
+    axis = int(axis) % x.ndim
+    idx = index.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, x.shape[axis] - 1)
+    else:
+        idx = jnp.mod(idx, x.shape[axis])
+    idx_exp = jnp.expand_dims(idx, axis)
+    out = jnp.take_along_axis(x, idx_exp, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out

@@ -1,0 +1,137 @@
+"""Operator registry — the single source of truth for every op.
+
+Reference design: ops register into the NNVM registry via ``NNVM_REGISTER_OP``
+with attributes FCompute/FInferShape/FGradient (include/mxnet/op_attr_types.h:198-309;
+pattern at src/operator/nn/fully_connected.cc:239-328), and the Python frontend
+generates one function per op at import time (python/mxnet/base.py:578-645
+``_init_op_module``).
+
+TPU-native redesign: an op is a *pure JAX-traceable function*
+``fcompute(attrs, *arrays) -> array | tuple`` registered here.  There is no
+separate shape/type inference pass — XLA's tracing performs it — and no
+hand-written FGradient: gradients come from ``jax.vjp`` over the same fcompute
+(the autograd tape replays it).  Eager dispatch JIT-compiles each (op, attrs)
+pair once and lets jax's own cache key on shapes/dtypes after that, which is the
+analog of the reference engine's cached kernel dispatch: first call pays a
+trace, subsequent calls are a dictionary hit + XLA executable launch.
+
+The registry is also the source for the generated ``nd.*`` and ``sym.*``
+namespaces (ndarray/register.py), exactly like ``_init_op_module``.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import attrs_key, MXNetError
+
+__all__ = ["Op", "register", "get_op", "list_ops", "alias"]
+
+_OP_REGISTRY = {}
+
+
+class Op:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical (MXNet-compatible) op name, e.g. ``FullyConnected``.
+    fcompute : callable(attrs_dict, *jax_arrays) -> jax array or tuple of arrays.
+        Must be jax-traceable (pure; no data-dependent python control flow).
+    num_outputs : int or callable(attrs)->int.
+    needs_rng : if True, dispatch threads a fresh jax PRNG key through
+        ``attrs['_rng_key']`` (the analog of the reference's kRandom resource
+        request, include/mxnet/resource.h:38-66).
+    mode_dependent : if True, ``attrs['_training']`` is injected from the
+        autograd train/predict scope (used by dropout/batchnorm).
+    no_jit : skip jit for this op (e.g. ops that return python values).
+    """
+
+    def __init__(self, name, fcompute, num_outputs=1, needs_rng=False,
+                 mode_dependent=False, no_jit=False, doc=None):
+        self.name = name
+        self.fcompute = fcompute
+        self.num_outputs = num_outputs
+        self.needs_rng = needs_rng
+        self.mode_dependent = mode_dependent
+        self.no_jit = no_jit
+        self.__doc__ = doc or (fcompute.__doc__ if fcompute else None)
+        self._jit_cache = {}
+
+    def n_outputs(self, attrs):
+        no = self.num_outputs
+        return no(attrs) if callable(no) else no
+
+    def _traceable(self, attrs):
+        """A positional-arg closure over attrs, suitable for jax.jit / jax.vjp."""
+        fcompute = self.fcompute
+
+        def fn(*arrays):
+            out = fcompute(attrs, *arrays)
+            return out
+        fn.__name__ = self.name
+        return fn
+
+    def apply(self, attrs, *arrays):
+        """Eagerly apply, with per-(op, attrs) jit caching.
+
+        The PRNG key (attrs['_rng_key']) is threaded as a traced argument so
+        random ops compile once and draw fresh randomness per call."""
+        if self.no_jit:
+            return self.fcompute(attrs, *arrays)
+        rng_key = attrs.get("_rng_key")
+        key = attrs_key({k: v for k, v in attrs.items() if k != "_rng_key"})
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            import jax
+            fcompute = self.fcompute
+            static_attrs = {k: v for k, v in attrs.items() if k != "_rng_key"}
+            if self.needs_rng:
+                def traced(key_arr, *arrs):
+                    a = dict(static_attrs)
+                    a["_rng_key"] = key_arr
+                    return fcompute(a, *arrs)
+            else:
+                def traced(*arrs):
+                    return fcompute(static_attrs, *arrs)
+            traced.__name__ = self.name
+            fn = jax.jit(traced)
+            self._jit_cache[key] = fn
+        if self.needs_rng:
+            return fn(rng_key, *arrays)
+        return fn(*arrays)
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+def register(name, **kwargs):
+    """Decorator: register ``fcompute`` under ``name``."""
+    def deco(fcompute):
+        if name in _OP_REGISTRY:
+            raise MXNetError("op %s already registered" % name)
+        _OP_REGISTRY[name] = Op(name, fcompute, **kwargs)
+        return fcompute
+    return deco
+
+
+def register_op(op):
+    if op.name in _OP_REGISTRY:
+        raise MXNetError("op %s already registered" % op.name)
+    _OP_REGISTRY[op.name] = op
+    return op
+
+
+def alias(new_name, existing_name):
+    """Register an alias (MXNet exposes many ops under several names)."""
+    _OP_REGISTRY[new_name] = _OP_REGISTRY[existing_name]
+
+
+def get_op(name):
+    op = _OP_REGISTRY.get(name)
+    if op is None:
+        raise MXNetError("operator %s is not registered" % name)
+    return op
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY.keys())

@@ -1,0 +1,574 @@
+"""Shape-manipulation, indexing, joining, ordering and linear-algebra-entry ops.
+
+Reference: src/operator/tensor/matrix_op.cc (Reshape/Flatten/transpose/slice/
+clip/repeat/tile/reverse/stack/squeeze...), indexing_op.cc (take/one_hot/
+gather_nd/scatter_nd/Embedding), ordering_op.cc (sort/argsort/topk),
+dot.cc, concat.cc, diag_op.cc, init_op.cc (_arange/_zeros/_ones/_eye).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+@register("Reshape")
+def _reshape(attrs, x):
+    jnp = _jnp()
+    shape = attrs.get("shape")
+    reverse = attrs.get("reverse", False)
+    if shape is None:
+        return x
+    shape = list(shape)
+    # MXNet special codes: 0 copy dim, -1 infer, -2 copy rest, -3 merge two,
+    # -4 split (src/operator/tensor/matrix_op.cc Reshape docs)
+    in_shape = list(x.shape)
+    if reverse:
+        in_shape = in_shape[::-1]
+        shape = shape[::-1]
+    out = []
+    src = 0
+    i = 0
+    while i < len(shape):
+        s = shape[i]
+        if s == 0:
+            out.append(in_shape[src]); src += 1
+        elif s == -1:
+            out.append(-1); src += 1
+        elif s == -2:
+            out.extend(in_shape[src:]); src = len(in_shape)
+        elif s == -3:
+            out.append(in_shape[src] * in_shape[src + 1]); src += 2
+        elif s == -4:
+            d1, d2 = shape[i + 1], shape[i + 2]
+            if d1 == -1:
+                d1 = in_shape[src] // d2
+            if d2 == -1:
+                d2 = in_shape[src] // d1
+            out.extend([d1, d2]); src += 1; i += 2
+        else:
+            out.append(s); src += 1
+        i += 1
+    if reverse:
+        out = out[::-1]
+    return x.reshape(tuple(out))
+
+
+alias("reshape", "Reshape")
+
+
+@register("Flatten")
+def _flatten(attrs, x):
+    return x.reshape((x.shape[0], -1))
+
+
+alias("flatten", "Flatten")
+
+
+@register("transpose")
+def _transpose(attrs, x):
+    axes = attrs.get("axes")
+    if not axes:
+        axes = None
+    return _jnp().transpose(x, axes=axes)
+
+
+@register("expand_dims")
+def _expand_dims(attrs, x):
+    return _jnp().expand_dims(x, int(attrs["axis"]))
+
+
+@register("squeeze")
+def _squeeze(attrs, x):
+    axis = attrs.get("axis")
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    elif axis is not None:
+        axis = int(axis)
+    return _jnp().squeeze(x, axis=axis)
+
+
+@register("reshape_like")
+def _reshape_like(attrs, x, y):
+    return x.reshape(y.shape)
+
+
+@register("shape_array", no_jit=True)
+def _shape_array(attrs, x):
+    return _jnp().asarray(_np.array(x.shape, dtype=_np.int64))
+
+
+@register("size_array", no_jit=True)
+def _size_array(attrs, x):
+    n = 1
+    for s in x.shape:
+        n *= s
+    return _jnp().asarray(_np.array([n], dtype=_np.int64))
+
+
+@register("broadcast_to")
+def _broadcast_to(attrs, x):
+    jnp = _jnp()
+    shape = tuple(attrs["shape"])
+    # MXNet: 0 means keep input dim
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_like")
+def _broadcast_like(attrs, x, y):
+    return _jnp().broadcast_to(x, y.shape)
+
+
+@register("broadcast_axis")
+def _broadcast_axis(attrs, x):
+    jnp = _jnp()
+    axis = attrs.get("axis", ())
+    size = attrs.get("size", ())
+    if isinstance(axis, int):
+        axis = (axis,)
+    if isinstance(size, int):
+        size = (size,)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+alias("broadcast_axes", "broadcast_axis")
+
+
+# ---------------------------------------------------------------------------
+# slicing
+# ---------------------------------------------------------------------------
+
+def _expand_slice_spec(shape, begin, end, step=None):
+    nd = len(shape)
+    begin = list(begin) + [None] * (nd - len(begin))
+    end = list(end) + [None] * (nd - len(end))
+    if step is None or (isinstance(step, (list, tuple)) and len(step) == 0):
+        step = [None] * nd
+    else:
+        step = list(step) + [None] * (nd - len(step))
+    slices = []
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return tuple(slices)
+
+
+@register("slice")
+def _slice(attrs, x):
+    spec = _expand_slice_spec(x.shape, attrs.get("begin", ()),
+                              attrs.get("end", ()), attrs.get("step"))
+    return x[spec]
+
+
+alias("crop", "slice")
+
+
+@register("slice_axis")
+def _slice_axis(attrs, x):
+    axis = int(attrs["axis"]) % x.ndim
+    begin = attrs.get("begin", 0)
+    end = attrs.get("end")
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(attrs, x, y):
+    axes = attrs.get("axes", ())
+    if not axes:
+        axes = tuple(range(min(x.ndim, y.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        a = int(a) % x.ndim
+        idx[a] = slice(0, y.shape[a])
+    return x[tuple(idx)]
+
+
+@register("SliceChannel", num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)))
+def _slice_channel(attrs, x):
+    jnp = _jnp()
+    num = int(attrs.get("num_outputs", 1))
+    axis = int(attrs.get("axis", 1))
+    squeeze_axis = bool(attrs.get("squeeze_axis", False))
+    outs = jnp.split(x, num, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+alias("split", "SliceChannel")
+
+
+@register("reverse")
+def _reverse(attrs, x):
+    axis = attrs.get("axis", 0)
+    if isinstance(axis, int):
+        axis = (axis,)
+    return _jnp().flip(x, axis=tuple(axis))
+
+
+alias("flip", "reverse")
+
+
+# ---------------------------------------------------------------------------
+# joining
+# ---------------------------------------------------------------------------
+
+@register("Concat")
+def _concat(attrs, *arrays):
+    dim = int(attrs.get("dim", 1))
+    return _jnp().concatenate(arrays, axis=dim)
+
+
+alias("concat", "Concat")
+
+
+@register("stack")
+def _stack(attrs, *arrays):
+    axis = int(attrs.get("axis", 0))
+    return _jnp().stack(arrays, axis=axis)
+
+
+@register("repeat")
+def _repeat(attrs, x):
+    repeats = int(attrs["repeats"])
+    axis = attrs.get("axis")
+    return _jnp().repeat(x, repeats, axis=axis if axis is None else int(axis))
+
+
+@register("tile")
+def _tile(attrs, x):
+    return _jnp().tile(x, tuple(attrs["reps"]))
+
+
+@register("Pad")
+def _pad(attrs, x):
+    jnp = _jnp()
+    mode = attrs.get("mode", "constant")
+    pad_width = attrs["pad_width"]
+    cval = attrs.get("constant_value", 0.0)
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=cval)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise ValueError("unknown pad mode %s" % mode)
+
+
+alias("pad", "Pad")
+
+
+# ---------------------------------------------------------------------------
+# clip / misc
+# ---------------------------------------------------------------------------
+
+@register("clip")
+def _clip(attrs, x):
+    return _jnp().clip(x, attrs.get("a_min"), attrs.get("a_max"))
+
+
+@register("where")
+def _where(attrs, cond, a, b):
+    return _jnp().where(cond != 0, a, b)
+
+
+@register("diag")
+def _diag(attrs, x):
+    jnp = _jnp()
+    k = int(attrs.get("k", 0))
+    if x.ndim == 1:
+        return jnp.diag(x, k=k)
+    return jnp.diagonal(x, offset=k, axis1=0, axis2=1)
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+@register("take")
+def _take(attrs, x, indices):
+    jnp = _jnp()
+    axis = int(attrs.get("axis", 0))
+    mode = attrs.get("mode", "clip")
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, x.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, x.shape[axis])
+    return jnp.take(x, idx, axis=axis)
+
+
+@register("batch_take")
+def _batch_take(attrs, x, indices):
+    jnp = _jnp()
+    idx = indices.astype(jnp.int32)
+    return x[jnp.arange(x.shape[0]), idx]
+
+
+@register("Embedding")
+def _embedding(attrs, data, weight):
+    """Embedding lookup (src/operator/tensor/indexing_op.cc Embedding).
+
+    On TPU a gather from an HBM-resident table; XLA lowers jnp.take to a
+    dynamic-gather that the MXU-adjacent sparsecore handles on newer gens."""
+    jnp = _jnp()
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot")
+def _one_hot(attrs, indices):
+    import jax
+    jnp = _jnp()
+    depth = int(attrs["depth"])
+    on_value = attrs.get("on_value", 1.0)
+    off_value = attrs.get("off_value", 0.0)
+    dtype = attrs.get("dtype", "float32")
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    out = oh * (on_value - off_value) + off_value
+    return out.astype(jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype))
+
+
+@register("gather_nd")
+def _gather_nd(attrs, data, indices):
+    jnp = _jnp()
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(attrs, data, indices):
+    jnp = _jnp()
+    shape = tuple(attrs["shape"])
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(attrs, lhs, indices, rhs):
+    jnp = _jnp()
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+# ---------------------------------------------------------------------------
+# ordering
+# ---------------------------------------------------------------------------
+
+@register("sort")
+def _sort(attrs, x):
+    jnp = _jnp()
+    axis = attrs.get("axis", -1)
+    is_ascend = bool(attrs.get("is_ascend", True))
+    if axis is None:
+        out = jnp.sort(x.reshape(-1))
+        axis_ = 0
+    else:
+        out = jnp.sort(x, axis=int(axis))
+        axis_ = int(axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis_)
+    return out
+
+
+@register("argsort")
+def _argsort(attrs, x):
+    jnp = _jnp()
+    axis = attrs.get("axis", -1)
+    is_ascend = bool(attrs.get("is_ascend", True))
+    dtype = attrs.get("dtype", "float32")
+    if axis is None:
+        out = jnp.argsort(x.reshape(-1))
+        axis_ = 0
+    else:
+        out = jnp.argsort(x, axis=int(axis))
+        axis_ = int(axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis_)
+    return out.astype(_np.dtype(dtype))
+
+
+@register("topk", num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1)
+def _topk(attrs, x):
+    import jax
+    jnp = _jnp()
+    axis = attrs.get("axis", -1)
+    k = int(attrs.get("k", 1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    is_ascend = bool(attrs.get("is_ascend", False))
+    dtype = attrs.get("dtype", "float32")
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    axis = int(axis) % x.ndim
+    xs = jnp.moveaxis(x, axis, -1)
+    if is_ascend:
+        vals, idxs = jax.lax.top_k(-xs, k)
+        vals = -vals
+    else:
+        vals, idxs = jax.lax.top_k(xs, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(_np.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    if ret_typ == "mask":
+        mask = jnp.zeros(xs.shape, dtype=x.dtype)
+        mask = mask.at[..., :1].set(0)  # placeholder; mask built from idxs below
+        oh = jax.nn.one_hot(idxs.astype(jnp.int32) if False else 0, 1)
+        raise NotImplementedError("topk ret_typ=mask")
+    return idxs
+
+
+# ---------------------------------------------------------------------------
+# dot products
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def _dot(attrs, a, b):
+    """Generalized dot (src/operator/tensor/dot.cc): contract last axis of lhs
+    with first axis of rhs.  Lowers to a single MXU matmul via reshape."""
+    jnp = _jnp()
+    ta = bool(attrs.get("transpose_a", False))
+    tb = bool(attrs.get("transpose_b", False))
+    if ta:
+        a = jnp.transpose(a)
+    if tb:
+        b = jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b).reshape((1,))
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(attrs, a, b):
+    jnp = _jnp()
+    ta = bool(attrs.get("transpose_a", False))
+    tb = bool(attrs.get("transpose_b", False))
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def _khatri_rao(attrs, *mats):
+    jnp = _jnp()
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape((-1,) + out.shape[1:])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# init-style ops (used by the symbolic path & generated namespaces)
+# ---------------------------------------------------------------------------
+
+@register("_zeros", no_jit=True)
+def _zeros_op(attrs, *unused):
+    jnp = _jnp()
+    dtype = attrs.get("dtype", "float32")
+    return jnp.zeros(tuple(attrs["shape"]),
+                     dtype=jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype))
+
+
+@register("_ones", no_jit=True)
+def _ones_op(attrs, *unused):
+    jnp = _jnp()
+    dtype = attrs.get("dtype", "float32")
+    return jnp.ones(tuple(attrs["shape"]),
+                    dtype=jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype))
+
+
+@register("_full", no_jit=True)
+def _full_op(attrs, *unused):
+    jnp = _jnp()
+    dtype = attrs.get("dtype", "float32")
+    return jnp.full(tuple(attrs["shape"]), attrs.get("value", 0.0),
+                    dtype=_np.dtype(dtype))
+
+
+@register("_arange", no_jit=True)
+def _arange_op(attrs, *unused):
+    jnp = _jnp()
+    dtype = attrs.get("dtype", "float32")
+    start = attrs.get("start", 0)
+    stop = attrs.get("stop")
+    step = attrs.get("step", 1.0)
+    repeat = int(attrs.get("repeat", 1))
+    v = jnp.arange(start, stop, step, dtype=_np.dtype(dtype))
+    if repeat > 1:
+        v = jnp.repeat(v, repeat)
+    return v
+
+
+@register("_eye", no_jit=True)
+def _eye_op(attrs, *unused):
+    jnp = _jnp()
+    dtype = attrs.get("dtype", "float32")
+    N = int(attrs["N"])
+    M = int(attrs.get("M", 0)) or N
+    k = int(attrs.get("k", 0))
+    return jnp.eye(N, M, k=k, dtype=_np.dtype(dtype))
+
+
+@register("space_to_depth")
+def _space_to_depth(attrs, x):
+    jnp = _jnp()
+    bs = int(attrs["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@register("depth_to_space")
+def _depth_to_space(attrs, x):
+    jnp = _jnp()
+    bs = int(attrs["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, bs, bs, c // (bs * bs), h, w)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(n, c // (bs * bs), h * bs, w * bs)
+
+
+@register("ravel_multi_index")
+def _ravel_multi_index(attrs, indices):
+    jnp = _jnp()
+    shape = tuple(attrs["shape"])
+    idx = indices.astype(jnp.int64)
+    out = jnp.zeros(idx.shape[1:], dtype=jnp.int64)
+    for i, s in enumerate(shape):
+        out = out * s + idx[i]
+    return out.astype(jnp.float32)
+
+
+@register("unravel_index")
+def _unravel_index(attrs, indices):
+    jnp = _jnp()
+    shape = tuple(attrs["shape"])
+    idx = indices.astype(jnp.int64)
+    outs = []
+    rem = idx
+    for s in reversed(shape):
+        outs.append(rem % s)
+        rem = rem // s
+    return jnp.stack(outs[::-1], axis=0).astype(jnp.float32)

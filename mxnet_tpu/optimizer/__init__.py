@@ -1,0 +1,5 @@
+from .optimizer import (Optimizer, SGD, NAG, Signum, FTML, LBSGD, DCASGD, SGLD,
+                        Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam,
+                        Updater, get_updater, create, register, Test)
+
+opt = create  # reference alias mx.optimizer.opt

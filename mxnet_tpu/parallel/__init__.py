@@ -1,0 +1,24 @@
+"""Parallelism layer: device meshes, sharding rules, and collectives.
+
+This is the TPU-native replacement for the reference's entire distribution
+stack (src/kvstore comm hierarchy + ps-lite + NCCL): instead of explicit
+push/pull between processes, training steps are compiled over a
+``jax.sharding.Mesh`` and XLA inserts the collectives (psum/all_gather/
+reduce_scatter/ppermute) over ICI/DCN.
+
+The mesh axes convention used across the framework:
+  * ``dp`` — data parallel (batch sharding; gradient psum)
+  * ``tp`` — tensor parallel (weight sharding within a layer)
+  * ``pp`` — pipeline parallel (layer sharding across stages)
+  * ``sp`` — sequence/context parallel (ring attention over the seq axis)
+  * ``ep`` — expert/embedding parallel (row-sparse tables)
+
+The reference only ships DP + manual model parallelism + sparse-PS semantics
+(SURVEY §2.5); the extra axes come "for free" from this layer's design.
+"""
+from .mesh import (make_mesh, default_mesh, data_parallel_spec, replicated_spec,
+                   local_device_count, MeshConfig)
+from .collectives import (allreduce, allgather, reduce_scatter, ppermute_ring,
+                          barrier_sync)
+from .data_parallel import make_data_parallel_train_step, shard_batch
+from .ring_attention import ring_attention, sequence_parallel_attention

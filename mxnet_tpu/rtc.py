@@ -1,0 +1,44 @@
+"""Runtime kernel compilation.
+
+Reference: python/mxnet/rtc.py ``CudaModule`` over NVRTC (src/common/rtc.cc:35-69)
+— compile CUDA C at runtime and launch as kernels.
+
+TPU-native: runtime kernels are **Pallas** functions.  ``PallasModule`` wraps a
+user kernel function into a launchable with the same get_kernel/launch shape as
+the reference's CudaModule, compiled by XLA on first call."""
+from __future__ import annotations
+
+from .ndarray import NDArray, _wrap
+
+
+class PallasModule:
+    """Wrap pallas kernels for launch on NDArrays.
+
+    Parameters
+    ----------
+    kernels : dict name -> callable(*jax_arrays) -> jax array
+        Each callable is typically a ``pl.pallas_call`` wrapper.
+    """
+
+    def __init__(self, kernels):
+        self._kernels = dict(kernels)
+
+    def get_kernel(self, name, signature=None):
+        fn = self._kernels[name]
+
+        class _Kernel:
+            def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+                       shared_mem=0):
+                vals = [a._data if isinstance(a, NDArray) else a for a in args]
+                out = fn(*vals)
+                return _wrap(out)
+        return _Kernel()
+
+
+# Compatibility name: reference scripts do mx.rtc.CudaModule(source). There is
+# no CUDA on TPU; raise with guidance at use.
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "CudaModule is CUDA-specific; on TPU write a Pallas kernel and wrap "
+            "it with mx.rtc.PallasModule")

@@ -1,0 +1,26 @@
+"""``mx.sym`` — the symbolic graph package."""
+import sys as _sys
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     zeros, ones, arange)
+from . import register as _register
+
+_register.install_ops(_sys.modules[__name__])
+
+# sym.random / sym.linalg namespaces
+from types import ModuleType as _Mod
+
+random = _Mod("mxnet_tpu.symbol.random")
+linalg = _Mod("mxnet_tpu.symbol.linalg")
+contrib = _Mod("mxnet_tpu.symbol.contrib")
+
+for _name in ("_random_uniform", "_random_normal", "_random_gamma",
+              "_random_exponential", "_random_poisson", "_random_randint"):
+    _short = _name.replace("_random_", "")
+    setattr(random, _short, _register.make_sym_func(_name))
+
+for _name in ("_linalg_gemm", "_linalg_gemm2", "_linalg_potrf", "_linalg_potri",
+              "_linalg_trsm", "_linalg_trmm", "_linalg_syrk", "_linalg_gelqf",
+              "_linalg_syevd", "_linalg_sumlogdiag"):
+    _short = _name.replace("_linalg_", "")
+    setattr(linalg, _short, _register.make_sym_func(_name))

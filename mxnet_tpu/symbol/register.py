@@ -1,0 +1,34 @@
+"""Generate the ``sym.*`` op namespace from the registry (analog of
+python/mxnet/symbol/register.py)."""
+from __future__ import annotations
+
+from ..ops.registry import get_op, list_ops
+from .symbol import Symbol, _create
+
+
+def make_sym_func(op_name):
+    def op_func(*args, name=None, attr=None, **kwargs):
+        inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
+                inputs.extend(a)
+            else:
+                raise TypeError("positional arguments to sym.%s must be Symbol"
+                                % op_name)
+        attrs = dict(attr) if attr else {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                inputs.append(v)
+            elif v is not None:
+                attrs[k] = v
+        return _create(op_name, inputs, attrs, name=name)
+    op_func.__name__ = op_name
+    op_func.__doc__ = get_op(op_name).__doc__
+    return op_func
+
+
+def install_ops(module, names=None):
+    for name in (names or list_ops()):
+        setattr(module, name, make_sym_func(name))

@@ -1,0 +1,30 @@
+"""Misc utilities (reference: python/mxnet/util.py, libinfo.py)."""
+from __future__ import annotations
+
+import os
+
+
+def is_np_array():
+    return False
+
+
+def makedirs(d):
+    os.makedirs(d, exist_ok=True)
+
+
+def getenv(name, default=None):
+    return os.environ.get(name, default)
+
+
+def get_gpu_count():
+    from .context import num_tpus
+    return num_tpus()
+
+
+def get_gpu_memory(dev_id=0):
+    import jax
+    try:
+        stats = jax.devices()[dev_id].memory_stats()
+        return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
+    except Exception:
+        return 0, 0

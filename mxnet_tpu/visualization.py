@@ -1,0 +1,64 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+print_summary + plot_network graphviz rendering)."""
+from __future__ import annotations
+
+import json
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer-by-layer summary of a Symbol graph."""
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {t[0] for t in conf.get("heads", [])}
+
+    def print_row(fields, positions_):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions_[i]]
+            line += " " * (positions_[i] - len(line))
+        print(line)
+
+    positions_abs = [int(line_length * p) for p in positions]
+    print("_" * line_length)
+    print_row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"],
+              positions_abs)
+    print("=" * line_length)
+    for i, node in enumerate(nodes):
+        if node["op"] == "null" and i not in heads:
+            continue
+        pred = [nodes[e[0]]["name"] for e in node.get("inputs", [])]
+        print_row(["%s (%s)" % (node["name"], node["op"]), "", "",
+                   ",".join(pred[:2])], positions_abs)
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Emit a graphviz Digraph of the symbol graph (requires graphviz)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires graphviz") from e
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and not (name.endswith("data") or name.endswith("label")):
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op), shape="box")
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for e in node.get("inputs", []):
+            src = nodes[e[0]]
+            if src["op"] == "null" and hide_weights and not (
+                    src["name"].endswith("data") or src["name"].endswith("label")):
+                continue
+            dot.edge(src["name"], node["name"])
+    return dot

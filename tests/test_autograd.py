@@ -1,0 +1,158 @@
+"""Autograd (model: reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()), rtol=1e-4)
+
+
+def test_multiple_leaves():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert_almost_equal(a.grad.asnumpy(), [4.0])
+    assert_almost_equal(b.grad.asnumpy(), [2.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad.asnumpy(), [30.0, 60.0])
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [6.0])
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 10  # not recorded
+        w = y + 1
+    w.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2.0])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.relu(x * -1 + 2)  # relu(2-x) = [1, 0, 0] grads -1,0(edge),0
+    y.backward()
+    g = x.grad.asnumpy()
+    assert g[0] == -1.0
+    assert g[2] == 0.0
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    y = nd.array([3.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        z = x * x * y
+    gx, gy = autograd.grad(z, [x, y])
+    assert_almost_equal(gx.asnumpy(), [12.0])
+    assert_almost_equal(gy.asnumpy(), [4.0])
+
+
+def test_detach():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * 3 + y
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+
+def test_softmax_output_grad():
+    """SoftmaxOutput backward = softmax - onehot (reference semantics)."""
+    x = nd.array(np.random.uniform(-1, 1, (4, 5)))
+    label = nd.array([0, 1, 2, 3])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SoftmaxOutput(x, label)
+    y.backward()
+    sm = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    expected = sm.copy()
+    expected[np.arange(4), [0, 1, 2, 3]] -= 1
+    assert_almost_equal(x.grad.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_mutation_does_not_corrupt_tape():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    x[:] = 100.0  # mutate after recording
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2.0, 4.0])

@@ -1,0 +1,279 @@
+"""Operator correctness (model: reference tests/python/unittest/test_operator.py).
+
+Includes numeric-gradient checks against autodiff — the reference's
+check_numeric_gradient strategy (python/mxnet/test_utils.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_fully_connected():
+    x = nd.array(np.random.uniform(-1, 1, (4, 10)))
+    w = nd.array(np.random.uniform(-1, 1, (5, 10)))
+    b = nd.array(np.random.uniform(-1, 1, (5,)))
+    out = nd.FullyConnected(x, w, b, num_hidden=5)
+    expected = x.asnumpy().dot(w.asnumpy().T) + b.asnumpy()
+    assert_almost_equal(out.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+    out2 = nd.FullyConnected(x, w, num_hidden=5, no_bias=True)
+    assert_almost_equal(out2.asnumpy(), x.asnumpy().dot(w.asnumpy().T),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_fully_connected_flatten():
+    x = nd.array(np.random.uniform(-1, 1, (2, 3, 4)))
+    w = nd.array(np.random.uniform(-1, 1, (5, 12)))
+    b = nd.zeros((5,))
+    out = nd.FullyConnected(x, w, b, num_hidden=5)
+    assert out.shape == (2, 5)
+
+
+def test_convolution():
+    x = nd.array(np.random.uniform(-1, 1, (2, 3, 8, 8)))
+    w = nd.array(np.random.uniform(-1, 1, (4, 3, 3, 3)))
+    b = nd.zeros((4,))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    assert out.shape == (2, 4, 8, 8)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, stride=(2, 2),
+                         pad=(1, 1))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_convolution_vs_numpy():
+    """1x1 conv is a matmul over channels."""
+    x = np.random.uniform(-1, 1, (2, 3, 5, 5)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 3, 1, 1)).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(1, 1), num_filter=4,
+                         no_bias=True)
+    expected = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+    assert_almost_equal(out.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling():
+    x = nd.array(np.random.uniform(-1, 1, (2, 3, 8, 8)))
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert out.shape == (2, 3, 4, 4)
+    expected = x.asnumpy().reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+    assert_almost_equal(out.asnumpy(), expected)
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expected = x.asnumpy().reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    assert_almost_equal(out.asnumpy(), expected, rtol=1e-5)
+    out = nd.Pooling(x, global_pool=True, pool_type="max", kernel=(1, 1))
+    assert out.shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_inference():
+    x = nd.array(np.random.uniform(-1, 1, (2, 3, 4, 4)))
+    gamma = nd.ones((3,))
+    beta = nd.zeros((3,))
+    mean = nd.zeros((3,))
+    var = nd.ones((3,))
+    out, m, v = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    assert_almost_equal(out.asnumpy(), x.asnumpy() / np.sqrt(1 + 1e-3),
+                        rtol=1e-4)
+
+
+def test_batchnorm_training_stats():
+    x = nd.array(np.random.uniform(-1, 1, (8, 3, 4, 4)))
+    gamma = nd.ones((3,))
+    beta = nd.zeros((3,))
+    mean = nd.zeros((3,))
+    var = nd.ones((3,))
+    with autograd.record(train_mode=True):
+        out, m, v = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    xn = x.asnumpy()
+    assert_almost_equal(m.asnumpy(), xn.mean(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(v.asnumpy(), xn.var(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
+
+
+def test_activation_ops():
+    x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.relu(a).asnumpy(), np.maximum(x, 0))
+    assert_almost_equal(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-4)
+    assert_almost_equal(nd.tanh(a).asnumpy(), np.tanh(x), rtol=1e-4)
+    assert_almost_equal(nd.Activation(a, act_type="softrelu").asnumpy(),
+                        np.log1p(np.exp(x)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1).asnumpy(),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+
+
+def test_softmax():
+    x = np.random.uniform(-1, 1, (3, 5)).astype(np.float32)
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(1, keepdims=True))
+    assert_almost_equal(out.asnumpy(), e / e.sum(1, keepdims=True), rtol=1e-4)
+    lout = nd.log_softmax(nd.array(x))
+    assert_almost_equal(lout.asnumpy(), np.log(e / e.sum(1, keepdims=True)),
+                        rtol=1e-3, atol=1e-5)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    # inference: identity
+    out = nd.Dropout(x, p=0.5)
+    assert_almost_equal(out.asnumpy(), x.asnumpy())
+    # training: ~half zeroed, scaled
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    nz = out.asnumpy()[out.asnumpy() != 0]
+    assert_almost_equal(nz, np.full_like(nz, 2.0))
+
+
+def test_sequence_mask():
+    x = nd.ones((4, 2, 3))  # (T, B, ...)
+    lengths = nd.array([2, 3])
+    out = nd.SequenceMask(x, lengths, use_sequence_length=True, value=0.0)
+    out_np = out.asnumpy()
+    assert out_np[:2, 0].sum() == 6
+    assert out_np[2:, 0].sum() == 0
+    assert out_np[:3, 1].sum() == 9
+    assert out_np[3:, 1].sum() == 0
+
+
+def test_sequence_last_reverse():
+    x = nd.array(np.arange(24).reshape(4, 2, 3))
+    lengths = nd.array([2, 4])
+    last = nd.SequenceLast(x, lengths, use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x.asnumpy()[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x.asnumpy()[3, 1])
+    rev = nd.SequenceReverse(x, lengths, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+    assert_almost_equal(rev.asnumpy()[1, 0], x.asnumpy()[0, 0])
+    assert_almost_equal(rev.asnumpy()[2, 0], x.asnumpy()[2, 0])
+
+
+def test_embedding():
+    data = nd.array([[0, 2], [1, 3]], dtype="int32")
+    weight = nd.array(np.random.uniform(-1, 1, (4, 5)))
+    out = nd.Embedding(data, weight, input_dim=4, output_dim=5)
+    assert out.shape == (2, 2, 5)
+    assert_almost_equal(out.asnumpy()[0, 1], weight.asnumpy()[2])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0], [0.5, 2.5, 1.5]])
+    idx = nd.topk(x, k=2)
+    assert_almost_equal(idx.asnumpy(), [[0, 2], [1, 2]])
+    vals = nd.topk(x, k=2, ret_typ="value")
+    assert_almost_equal(vals.asnumpy(), [[3, 2], [2.5, 1.5]])
+    s = nd.sort(x, axis=1)
+    assert_almost_equal(s.asnumpy(), np.sort(x.asnumpy(), axis=1))
+    a = nd.argsort(x, axis=1)
+    assert_almost_equal(a.asnumpy(), np.argsort(x.asnumpy(), axis=1))
+
+
+def test_numeric_gradient_fc():
+    check_numeric_gradient(
+        lambda x, w: nd.FullyConnected(x, w, num_hidden=3, no_bias=True),
+        [np.random.uniform(-1, 1, (2, 4)), np.random.uniform(-1, 1, (3, 4))],
+        rtol=1e-2, atol=1e-3)
+
+
+def test_numeric_gradient_conv():
+    check_numeric_gradient(
+        lambda x, w: nd.Convolution(x, w, kernel=(2, 2), num_filter=2,
+                                    no_bias=True),
+        [np.random.uniform(-1, 1, (1, 2, 4, 4)),
+         np.random.uniform(-1, 1, (2, 2, 2, 2))],
+        rtol=2e-2, atol=1e-3)
+
+
+def test_numeric_gradient_elemwise():
+    check_numeric_gradient(lambda x: nd.tanh(x) * nd.sigmoid(x),
+                           [np.random.uniform(-1, 1, (3, 3))],
+                           rtol=1e-2, atol=1e-3)
+
+
+def test_layernorm():
+    x = np.random.uniform(-1, 1, (2, 5)).astype(np.float32)
+    g = np.random.uniform(0.5, 1.5, (5,)).astype(np.float32)
+    b = np.random.uniform(-0.5, 0.5, (5,)).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    mean = x.mean(-1, keepdims=True)
+    std = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out.asnumpy(), (x - mean) / std * g + b, rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_rnn_fused_shapes():
+    T, B, I, H = 5, 3, 4, 6
+    x = nd.array(np.random.uniform(-1, 1, (T, B, I)))
+    nparams = (I * 4 * H + H * 4 * H) + 2 * 4 * H
+    params = nd.array(np.random.uniform(-0.1, 0.1, (nparams,)))
+    h0 = nd.zeros((1, B, H))
+    c0 = nd.zeros((1, B, H))
+    out = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1, mode="lstm",
+                 state_outputs=True)
+    y, hT, cT = out
+    assert y.shape == (T, B, H)
+    assert hT.shape == (1, B, H)
+    assert cT.shape == (1, B, H)
+
+
+def test_optimizer_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.2])
+    out = nd.sgd_update(w, g, lr=0.5, wd=0.0)
+    assert_almost_equal(out.asnumpy(), [0.95, 1.9], rtol=1e-5)
+    mom = nd.zeros((2,))
+    w2, m2 = nd.sgd_mom_update(w, g, mom, lr=0.5, momentum=0.9, wd=0.0)
+    assert_almost_equal(w2.asnumpy(), [0.95, 1.9], rtol=1e-5)
+
+
+def test_linalg():
+    a = np.random.uniform(-1, 1, (3, 3)).astype(np.float32)
+    spd = a.dot(a.T) + 3 * np.eye(3, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd))
+    assert_almost_equal(L.asnumpy().dot(L.asnumpy().T), spd, rtol=1e-3, atol=1e-4)
+    g = nd.linalg.gemm2(nd.array(a), nd.array(a), transpose_b=True)
+    assert_almost_equal(g.asnumpy(), a.dot(a.T), rtol=1e-4, atol=1e-5)
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(1000,))
+    assert 0.4 < a.asnumpy().mean() < 0.6
+    b = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(b.asnumpy().mean()) < 0.2
+    mx.random.seed(42)
+    a2 = nd.random.uniform(0, 1, shape=(1000,))
+    assert_almost_equal(a.asnumpy(), a2.asnumpy())
+    c = nd.random.randint(0, 10, shape=(100,))
+    assert c.asnumpy().min() >= 0 and c.asnumpy().max() < 10
+
+
+def test_where_clip():
+    x = nd.array([-1.0, 0.5, 2.0])
+    assert_almost_equal(nd.clip(x, -0.5, 1.0).asnumpy(), [-0.5, 0.5, 1.0])
+    cond = nd.array([1.0, 0.0, 1.0])
+    out = nd.where(cond, x, nd.zeros((3,)))
+    assert_almost_equal(out.asnumpy(), [-1.0, 0.0, 2.0])
+
+
+def test_pick():
+    x = nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    idx = nd.array([0, 2])
+    out = nd.pick(x, idx, axis=1)
+    assert_almost_equal(out.asnumpy(), [1.0, 6.0])
+
+
+def test_upsampling():
+    x = nd.array(np.arange(4).reshape(1, 1, 2, 2))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    assert_almost_equal(out.asnumpy()[0, 0, :2, :2],
+                        [[0, 0], [0, 0]])
+
+
+def test_deconvolution_shape():
+    x = nd.array(np.random.uniform(-1, 1, (1, 3, 4, 4)))
+    w = nd.array(np.random.uniform(-1, 1, (3, 2, 3, 3)))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=2, stride=(2, 2))
+    assert out.shape == (1, 2, 9, 9)
