@@ -1,0 +1,100 @@
+"""Benchmark: ResNet-50 training throughput on one TPU chip.
+
+Baseline (BASELINE.md): MXNet 1.2 trains ResNet-50 bs=32 fp32 at 298.51
+img/s on 1x V100 (docs/faq/perf.md:208-217).  vs_baseline is images/sec
+relative to that number.
+
+The measured step is the full compiled training iteration — forward + backward
++ SGD-momentum update as ONE XLA module with donated buffers (the analog of
+train_imagenet.py's per-batch forward_backward+update), bf16 compute with fp32
+params (TPU-native dtype policy; the reference's fp16 path is the analog).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BATCH = 32
+BASELINE_IMGS_PER_SEC = 298.51  # V100 fp32 train, docs/faq/perf.md:208-217
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.block import functional_call, param_values
+    from mxnet_tpu import nd
+
+    dtype = jnp.bfloat16
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 3, 224, 224)))  # materialize deferred shapes
+    params = param_values(net)
+
+    aux_names = {n for n, p in net.collect_params().items()
+                 if p.grad_req == "null"}
+    train_names = sorted(n for n in params if n not in aux_names)
+
+    def loss_fn(train_params, aux_params, x, y):
+        p = dict(aux_params)
+        p.update({n: v.astype(dtype) for n, v in train_params.items()})
+        outs, new_aux = functional_call(net, p, x.astype(dtype), training=True)
+        logits = outs[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, new_aux
+
+    lr = 0.05
+    momentum = 0.9
+
+    @jax.jit
+    def train_step(train_params, momenta, aux_params, x, y):
+        (loss, new_aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            train_params, aux_params, x, y)
+        new_m = {n: momentum * momenta[n] + grads[n] for n in train_params}
+        new_p = {n: train_params[n] - lr * new_m[n] for n in train_params}
+        aux = dict(aux_params)
+        aux.update(new_aux)
+        return new_p, new_m, aux, loss
+
+    train_params = {n: params[n] for n in train_names}
+    momenta = {n: jnp.zeros_like(params[n]) for n in train_names}
+    aux_params = {n: params[n] for n in params if n in aux_names}
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1, 1, (BATCH, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, BATCH).astype(np.int32))
+
+    # compile + warmup
+    train_params, momenta, aux_params, loss = train_step(
+        train_params, momenta, aux_params, x, y)
+    loss.block_until_ready()
+    for _ in range(2):
+        train_params, momenta, aux_params, loss = train_step(
+            train_params, momenta, aux_params, x, y)
+    loss.block_until_ready()
+
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        train_params, momenta, aux_params, loss = train_step(
+            train_params, momenta, aux_params, x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_bs32",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Shared fit/data plumbing for the image-classification examples
+(reference: example/image-classification/common/{fit,data}.py)."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    parser.add_argument("--network", type=str, default="resnet50_v1")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--lr-factor", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", type=str, default="30,60,90")
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    parser.add_argument("--mom", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--kv-store", type=str, default="device",
+                        help="local|device|tpu_sync|dist_tpu_sync|dist_sync")
+    parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--model-prefix", type=str, default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    parser.add_argument("--dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--benchmark", type=int, default=0,
+                        help="use synthetic data")
+    parser.add_argument("--num-examples", type=int, default=1281167)
+    return parser
+
+
+def get_synthetic_iter(args, image_shape=(3, 224, 224)):
+    n = max(args.batch_size * 10, 320)
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (n,) + image_shape).astype(np.float32)
+    Y = rng.randint(0, args.num_classes, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True)
+
+
+def fit_gluon(args, net, train_iter, val_iter=None):
+    """Gluon training loop with kvstore-backed Trainer (the hybridized path)."""
+    import time
+    kv = mx.kvstore.create(args.kv_store) if "dist" in args.kv_store else args.kv_store
+    net.initialize(mx.init.Xavier())
+    # materialize deferred shapes
+    batch = next(iter(train_iter))
+    net(batch.data[0])
+    train_iter.reset()
+    net.hybridize()
+    if args.dtype == "bfloat16":
+        net.cast("bfloat16")
+    trainer = mx.gluon.Trainer(
+        net.collect_params(), args.optimizer,
+        {"learning_rate": args.lr, "momentum": args.mom, "wd": args.wd},
+        kvstore=kv)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.num_epochs):
+        metric.reset()
+        tic = time.time()
+        nsamples = 0
+        for i, batch in enumerate(train_iter):
+            x, y = batch.data[0], batch.label[0]
+            if args.dtype == "bfloat16":
+                x = x.astype("bfloat16")
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            nsamples += args.batch_size
+            if (i + 1) % args.disp_batches == 0:
+                name, acc = metric.get()
+                logging.info("Epoch[%d] Batch [%d] Speed: %.2f samples/sec %s=%f",
+                             epoch, i + 1, nsamples / (time.time() - tic),
+                             name, acc)
+        train_iter.reset()
+        logging.info("Epoch[%d] done in %.1fs", epoch, time.time() - tic)
+        if args.model_prefix:
+            net.export(args.model_prefix, epoch)
+    return net

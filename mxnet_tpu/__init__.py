@@ -46,7 +46,11 @@ from . import recordio
 from . import visualization
 from . import visualization as viz
 from . import attribute
+from .attribute import AttrScope
 from . import name
+from . import model
+from . import monitor
+from .monitor import Monitor
 from . import contrib
 from .executor import Executor
 from . import rtc  # compat shim: runtime kernels are Pallas on TPU

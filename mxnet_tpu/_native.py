@@ -1,0 +1,76 @@
+"""Native library loader: compiles src/*.cc into libmxtpu.so on first use
+(g++ is baked into the image; no pybind11 — plain C ABI via ctypes).
+
+Role: the reference keeps its runtime IO/parsing in C++ (dmlc-core recordio,
+src/io/); this module provides the same native fast path for the TPU build.
+Every consumer falls back to pure Python when compilation is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC_DIR = os.path.join(_REPO_ROOT, "src")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libmxtpu.so")
+
+_SOURCES = ["recordio.cc"]
+
+
+def _build():
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    newest_src = max((os.path.getmtime(s) for s in srcs if os.path.exists(s)),
+                     default=0)
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= newest_src:
+        return True
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB_PATH] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """Return the loaded ctypes library or None (python fallback)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        # signatures
+        lib.mxtpu_recio_reader_open.restype = ctypes.c_void_p
+        lib.mxtpu_recio_reader_open.argtypes = [ctypes.c_char_p]
+        lib.mxtpu_recio_reader_next.restype = ctypes.c_int64
+        lib.mxtpu_recio_reader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.mxtpu_recio_reader_seek.restype = ctypes.c_int64
+        lib.mxtpu_recio_reader_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.mxtpu_recio_reader_tell.restype = ctypes.c_int64
+        lib.mxtpu_recio_reader_tell.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_recio_reader_reset.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_recio_reader_close.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_recio_writer_open.restype = ctypes.c_void_p
+        lib.mxtpu_recio_writer_open.argtypes = [ctypes.c_char_p]
+        lib.mxtpu_recio_writer_write.restype = ctypes.c_int64
+        lib.mxtpu_recio_writer_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.mxtpu_recio_writer_tell.restype = ctypes.c_int64
+        lib.mxtpu_recio_writer_tell.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_recio_writer_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib

@@ -68,7 +68,6 @@ class _BlockScope:
             return self
         self._old_scope = getattr(_BlockScope._current, "value", None)
         _BlockScope._current.value = self
-        self._name_scope = NameManager._current.value.__class__()
         from ..name import Prefix
         self._name_scope = Prefix(self._block.prefix)
         self._name_scope.__enter__()
@@ -491,6 +490,45 @@ class SymbolBlock(HybridBlock):
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
+
+
+def functional_call(block, param_vals, *input_vals, training=False, rng_key=None):
+    """Run a Block's forward as a pure function of (param values, inputs).
+
+    param_vals: dict name -> jax array;  input_vals: jax arrays.
+    Returns (output jax values tuple, updated aux values dict).  Jittable —
+    this is the building block bench.py / __graft_entry__ use to compile whole
+    gluon models as single XLA modules."""
+    import jax
+    from .. import random as _random
+    from ..ndarray import NDArray
+    params = {p.name: p for p in block.collect_params().values()}
+    param_nds = {n: NDArray(v) for n, v in param_vals.items()}
+    input_nds = [NDArray(v) for v in input_vals]
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    with autograd._RecordingStateScope(False, training), \
+            _random.key_override(rng_key):
+        out = _with_param_override(block, params, param_nds,
+                                   lambda: block.hybrid_call(*input_nds)
+                                   if isinstance(block, HybridBlock)
+                                   else block.forward(*input_nds))
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    aux = {n: param_nds[n]._data for n in param_vals
+           if params[n].grad_req == "null"}
+    return tuple(o._data for o in outs), aux
+
+
+def param_values(block, dtype=None):
+    """Extract {name: jax array} from an initialized Block."""
+    import jax.numpy as jnp
+    vals = {}
+    for name, p in block.collect_params().items():
+        v = p.data()._data
+        if dtype is not None and jnp.issubdtype(v.dtype, jnp.floating):
+            v = v.astype(dtype)
+        vals[name] = v
+    return vals
 
 
 def _with_param_override(block, params, param_nds, thunk):

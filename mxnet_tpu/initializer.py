@@ -340,6 +340,11 @@ class Load:
             self.default_init(name, arr)
 
 
+# string aliases used across gluon layer definitions
+_INITIALIZER_REGISTRY["zeros"] = Zero
+_INITIALIZER_REGISTRY["ones"] = One
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name

@@ -1,0 +1,38 @@
+"""KVStore server bootstrap.
+
+Reference: python/mxnet/kvstore_server.py — when DMLC_ROLE=server, importing
+mxnet blocks in the server loop (the ps-lite server applies updates pushed by
+workers, kvstore_dist_server.h).
+
+TPU-native: there IS no server role — sync data parallelism is an in-graph
+allreduce and every process is a worker.  For compatibility with reference
+launch scripts that spawn server processes, this module accepts the role and
+parks the process in a barrier loop so old scripts don't crash; a warning
+documents the divergence (SURVEY §7 hard-part e: async PS has no TPU analog).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+
+def _init_server_module():
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server" or role == "scheduler":
+        logging.warning(
+            "mxnet_tpu: DMLC_ROLE=%s has no TPU analog (gradient aggregation "
+            "is an XLA collective between workers). This process will idle "
+            "until its process group exits.", role)
+        while True:
+            time.sleep(60)
+
+
+class KVStoreServer:
+    """API-compatible stub of the reference KVStoreServer."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        _init_server_module()

@@ -224,6 +224,10 @@ class Module(BaseModule):
             state_names=self._state_names)
         self.binded = True
 
+        if self.params_initialized:
+            # params were set before binding (e.g. Module.load)
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+
         if shared_module is not None and shared_module.params_initialized:
             self.set_params(*shared_module.get_params())
 

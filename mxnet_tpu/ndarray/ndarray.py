@@ -49,7 +49,9 @@ def _as_dtype(dtype):
             import jax.numpy as jnp
             return jnp.bfloat16
         return _np.dtype(dtype)
-    return dtype
+    if str(dtype) == "bfloat16":
+        return dtype
+    return _np.dtype(dtype)
 
 
 def _ctx_of(value, ctx=None):
@@ -256,8 +258,7 @@ class NDArray:
                 new = jnp.broadcast_to(jnp.asarray(v, dtype=self._data.dtype),
                                        self.shape).astype(self._data.dtype)
         else:
-            if not isinstance(v, (int, float)):
-                v = v.astype(self._data.dtype)
+            v = jnp.asarray(v).astype(self._data.dtype)
             new = self._data.at[idx].set(v)
         self._set_data(new)
 

@@ -589,7 +589,7 @@ def _rnn_num_outputs(attrs):
         else (2 if attrs.get("state_outputs", False) else 1)
 
 
-@register("RNN", num_outputs=lambda attrs: (3 if attrs.get("mode") == "lstm" else 2)
+@register("RNN", num_outputs=lambda attrs: (3 if attrs.get("mode", "lstm") == "lstm" else 2)
          if attrs.get("state_outputs", False) else 1,
          mode_dependent=True, needs_rng=True)
 def _rnn(attrs, data, parameters, state, state_cell=None):
@@ -774,3 +774,154 @@ def _spatial_transformer(attrs, data, loc):
 @register("IdentityAttachKLSparseReg")
 def _identity_attach_kl(attrs, data):
     return data
+
+
+# ---------------------------------------------------------------------------
+# symbolic-API input specs (the FListInputNames analog): ordered input names so
+# sym.* calls auto-create missing parameter/aux/label Variables like the
+# reference's NNVM binding does.
+# ---------------------------------------------------------------------------
+from .registry import get_op as _get_op
+
+_get_op("FullyConnected").arg_spec = lambda attrs: (
+    ["data", "weight"] + ([] if attrs.get("no_bias") else ["bias"]))
+_get_op("Convolution").arg_spec = lambda attrs: (
+    ["data", "weight"] + ([] if attrs.get("no_bias") else ["bias"]))
+_get_op("Deconvolution").arg_spec = lambda attrs: (
+    ["data", "weight"] + ([] if attrs.get("no_bias", True) else ["bias"]))
+_get_op("BatchNorm").arg_spec = ["data", "gamma", "beta",
+                                 "aux:moving_mean", "aux:moving_var"]
+_get_op("LayerNorm").arg_spec = ["data", "gamma", "beta"]
+_get_op("InstanceNorm").arg_spec = ["data", "gamma", "beta"]
+_get_op("Embedding").arg_spec = ["data", "weight"]
+_get_op("LeakyReLU").arg_spec = lambda attrs: (
+    ["data", "gamma"] if attrs.get("act_type") == "prelu" else ["data"])
+_get_op("SoftmaxOutput").arg_spec = ["data", "label:label"]
+_get_op("LinearRegressionOutput").arg_spec = ["data", "label:label"]
+_get_op("MAERegressionOutput").arg_spec = ["data", "label:label"]
+_get_op("LogisticRegressionOutput").arg_spec = ["data", "label:label"]
+_get_op("softmax_cross_entropy").arg_spec = ["data", "label:label"]
+_get_op("RNN").arg_spec = lambda attrs: (
+    ["data", "parameters", "state"]
+    + (["state_cell"] if attrs.get("mode", "lstm") == "lstm" else []))
+
+
+def _prod(t):
+    n = 1
+    for s in t:
+        n *= s
+    return n
+
+
+# param_shape_fn(attrs, in_shapes) -> {input_name: shape} for inputs whose
+# shapes are deducible from the data shape + attrs (the reference's bidirectional
+# shape inference, infer_graph_attr_pass.cc, restricted to the param slots).
+def _fc_param_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    nh = int(attrs["num_hidden"])
+    flatten = bool(attrs.get("flatten", True))
+    in_dim = _prod(data[1:]) if flatten else data[-1]
+    out = {"weight": (nh, in_dim)}
+    if not attrs.get("no_bias"):
+        out["bias"] = (nh,)
+    return out
+
+
+def _conv_param_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    nf = int(attrs["num_filter"])
+    ng = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs["kernel"]) if not isinstance(attrs["kernel"], int) \
+        else (attrs["kernel"],)
+    out = {"weight": (nf, data[1] // ng) + kernel}
+    if not attrs.get("no_bias"):
+        out["bias"] = (nf,)
+    return out
+
+
+def _deconv_param_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    nf = int(attrs["num_filter"])
+    ng = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs["kernel"]) if not isinstance(attrs["kernel"], int) \
+        else (attrs["kernel"],)
+    out = {"weight": (data[1], nf // ng) + kernel}
+    if not attrs.get("no_bias", True):
+        out["bias"] = (nf,)
+    return out
+
+
+def _bn_param_shapes(attrs, in_shapes):
+    axis = int(attrs.get("axis", 1))
+    c = in_shapes[0][axis]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _ln_param_shapes(attrs, in_shapes):
+    axis = int(attrs.get("axis", -1))
+    c = in_shapes[0][axis]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _in_param_shapes(attrs, in_shapes):
+    c = in_shapes[0][1]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _embedding_param_shapes(attrs, in_shapes):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _prelu_param_shapes(attrs, in_shapes):
+    if attrs.get("act_type") == "prelu":
+        return {"gamma": (in_shapes[0][1],)}
+    return {}
+
+
+def _softmax_output_label_shape(attrs, in_shapes):
+    data = in_shapes[0]
+    if attrs.get("multi_output"):
+        return {"label": (data[0],) + tuple(data[2:])}
+    if attrs.get("preserve_shape"):
+        return {"label": tuple(data[:-1])}
+    return {"label": (data[0],)}
+
+
+def _regression_label_shape(attrs, in_shapes):
+    return {"label": tuple(in_shapes[0])}
+
+
+def _rnn_param_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    T, B, I = data
+    H = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1))
+    D = 2 if attrs.get("bidirectional") else 1
+    G = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[attrs.get("mode", "lstm")]
+    total = 0
+    in_size = I
+    for layer in range(L):
+        for _ in range(D):
+            total += G * H * in_size + G * H * H
+        in_size = H * D
+    total += 2 * L * D * G * H
+    out = {"parameters": (total,), "state": (L * D, B, H)}
+    if attrs.get("mode") == "lstm":
+        out["state_cell"] = (L * D, B, H)
+    return out
+
+
+_get_op("FullyConnected").param_shape_fn = _fc_param_shapes
+_get_op("Convolution").param_shape_fn = _conv_param_shapes
+_get_op("Deconvolution").param_shape_fn = _deconv_param_shapes
+_get_op("BatchNorm").param_shape_fn = _bn_param_shapes
+_get_op("LayerNorm").param_shape_fn = _ln_param_shapes
+_get_op("InstanceNorm").param_shape_fn = _in_param_shapes
+_get_op("Embedding").param_shape_fn = _embedding_param_shapes
+_get_op("LeakyReLU").param_shape_fn = _prelu_param_shapes
+_get_op("SoftmaxOutput").param_shape_fn = _softmax_output_label_shape
+_get_op("LinearRegressionOutput").param_shape_fn = _regression_label_shape
+_get_op("MAERegressionOutput").param_shape_fn = _regression_label_shape
+_get_op("LogisticRegressionOutput").param_shape_fn = _regression_label_shape
+_get_op("softmax_cross_entropy").param_shape_fn = _softmax_output_label_shape
+_get_op("RNN").param_shape_fn = _rnn_param_shapes

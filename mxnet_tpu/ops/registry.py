@@ -56,6 +56,22 @@ class Op:
         self.no_jit = no_jit
         self.__doc__ = doc or (fcompute.__doc__ if fcompute else None)
         self._jit_cache = {}
+        # arg_spec: ordered input names for the symbolic API's auto-created
+        # parameter variables (reference: NNVM FListInputNames — e.g.
+        # FullyConnected lists [data, weight, bias] and binding creates the
+        # missing ones as Variables).  None = plain data inputs only.
+        # "aux:" prefix marks auxiliary state, "label:" marks label inputs.
+        self.arg_spec = None
+        # param_shape_fn(attrs, in_shapes) -> {input_name: shape}: deduce
+        # parameter-input shapes from the data shape (the NNVM InferShape
+        # bidirectional-propagation analog, used by simple_bind)
+        self.param_shape_fn = None
+
+    def input_names(self, attrs):
+        spec = self.arg_spec
+        if callable(spec):
+            return spec(attrs)
+        return spec
 
     def n_outputs(self, attrs):
         no = self.num_outputs

@@ -33,29 +33,56 @@ def _decode_lrec(data):
 
 
 class MXRecordIO:
-    """Sequential RecordIO reader/writer (reference recordio.py MXRecordIO)."""
+    """Sequential RecordIO reader/writer (reference recordio.py MXRecordIO).
+
+    Uses the native C++ fast path (src/recordio.cc via ctypes) when available;
+    transparently falls back to pure Python."""
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._native = None
+        self._native_handle = None
         self.is_open = False
         self.open()
 
     def open(self):
+        from . import _native
+        lib = _native.get_lib()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
+            if lib is not None:
+                h = lib.mxtpu_recio_writer_open(self.uri.encode())
+                if h:
+                    self._native, self._native_handle = lib, h
+                    self.is_open = True
+                    return
+            self.handle = open(self.uri, "wb")
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
+            if lib is not None and os.path.exists(self.uri):
+                h = lib.mxtpu_recio_reader_open(self.uri.encode())
+                if h:
+                    self._native, self._native_handle = lib, h
+                    self.is_open = True
+                    return
+            self.handle = open(self.uri, "rb")
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
 
     def close(self):
-        if self.is_open and self.handle:
-            self.handle.close()
+        if self.is_open:
+            if self._native is not None and self._native_handle:
+                if self.writable:
+                    self._native.mxtpu_recio_writer_close(self._native_handle)
+                else:
+                    self._native.mxtpu_recio_reader_close(self._native_handle)
+            elif self.handle:
+                self.handle.close()
+        self._native = None
+        self._native_handle = None
         self.is_open = False
 
     def reset(self):
@@ -70,27 +97,49 @@ class MXRecordIO:
         self.close()
         d = dict(self.__dict__)
         d["is_open"] = is_open
-        del d["handle"]
+        d.pop("handle", None)
+        d.pop("_native", None)
+        d.pop("_native_handle", None)
         return d
 
     def __setstate__(self, d):
         self.__dict__ = d
         self.handle = None
+        self._native = None
+        self._native_handle = None
         if self.is_open:
             self.is_open = False
             self.open()
 
     def write(self, buf):
+        """Write one record; returns its byte offset."""
         assert self.writable
+        if self._native is not None:
+            pos = self._native.mxtpu_recio_writer_write(
+                self._native_handle, bytes(buf), len(buf))
+            if pos < 0:
+                raise IOError("native recordio write failed for %s" % self.uri)
+            return pos
+        pos = self.handle.tell()
         # single record, cflag 0
         self.handle.write(struct.pack("<II", _MAGIC, _encode_lrec(0, len(buf))))
         self.handle.write(buf)
         pad = (4 - len(buf) % 4) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
+        return pos
 
     def read(self):
         assert not self.writable
+        if self._native is not None:
+            data_ptr = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._native.mxtpu_recio_reader_next(self._native_handle,
+                                                     ctypes.byref(data_ptr))
+            if n == -1:
+                return None
+            if n < 0:
+                raise IOError("corrupt RecordIO file %s" % self.uri)
+            return ctypes.string_at(data_ptr, n)
         hdr = self.handle.read(8)
         if len(hdr) < 8:
             return None
@@ -118,6 +167,10 @@ class MXRecordIO:
         return b"".join(parts)
 
     def tell(self):
+        if self._native is not None:
+            if self.writable:
+                return self._native.mxtpu_recio_writer_tell(self._native_handle)
+            return self._native.mxtpu_recio_reader_tell(self._native_handle)
         return self.handle.tell()
 
 
@@ -154,7 +207,11 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.handle.seek(self.idx[idx])
+        if self._native is not None:
+            self._native.mxtpu_recio_reader_seek(self._native_handle,
+                                                 self.idx[idx])
+        else:
+            self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
@@ -162,8 +219,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)
-        pos = self.tell()
-        self.write(buf)
+        pos = self.write(buf)
         self.keys.append(key)
         self.idx[key] = pos
 

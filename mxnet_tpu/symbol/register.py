@@ -18,12 +18,13 @@ def make_sym_func(op_name):
                 raise TypeError("positional arguments to sym.%s must be Symbol"
                                 % op_name)
         attrs = dict(attr) if attr else {}
+        kw_inputs = {}
         for k, v in kwargs.items():
             if isinstance(v, Symbol):
-                inputs.append(v)
+                kw_inputs[k] = v
             elif v is not None:
                 attrs[k] = v
-        return _create(op_name, inputs, attrs, name=name)
+        return _create(op_name, inputs, attrs, name=name, kw_inputs=kw_inputs)
     op_func.__name__ = op_name
     op_func.__doc__ = get_op(op_name).__doc__
     return op_func

@@ -358,11 +358,33 @@ def _infer_shapes(sym, specs, partial):
         if n.op is None:
             continue
         in_specs = [shape_env.get((id(inp), idx)) for (inp, idx) in n.inputs]
+        op = get_op(n.op)
+        # deduce parameter-input shapes from the data shape (NNVM InferShape
+        # analog): fills auto-created weight/bias/label variables
+        if any(s is None for s in in_specs) and op.param_shape_fn is not None \
+                and in_specs and in_specs[0] is not None:
+            names = [s.split(":", 1)[-1] for s in (op.input_names(n.attrs) or [])]
+            known = [tuple(s.shape) if s is not None else None for s in in_specs]
+            try:
+                deduced = op.param_shape_fn(n.attrs, known)
+            except Exception:
+                deduced = {}
+            for slot, shape in deduced.items():
+                if slot in names:
+                    pos = names.index(slot)
+                    if pos < len(n.inputs) and in_specs[pos] is None:
+                        try:
+                            spec = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                                        _np.float32)
+                        except (TypeError, ValueError):
+                            continue
+                        in_specs[pos] = spec
+                        inp_node, inp_idx = n.inputs[pos]
+                        shape_env[(id(inp_node), inp_idx)] = spec
         if any(s is None for s in in_specs):
             for i in range(n.num_outputs):
                 shape_env[(id(n), i)] = None
             continue
-        op = get_op(n.op)
         attrs = dict(n.attrs)
         if op.mode_dependent:
             attrs["_training"] = False
@@ -396,8 +418,12 @@ def _infer_shapes(sym, specs, partial):
     return arg_shapes, out_shapes, aux_shapes
 
 
-def _create(op_name, input_syms, attrs, name=None):
-    """Create a Symbol applying op to inputs (generated sym.* functions)."""
+def _create(op_name, input_syms, attrs, name=None, kw_inputs=None):
+    """Create a Symbol applying op to inputs (generated sym.* functions).
+
+    Auto-creates Variables for missing parameter/aux/label inputs per the
+    op's arg_spec — the reference's NNVM FListInputNames binding behavior
+    (e.g. ``sym.FullyConnected(data, num_hidden=k)`` grows fc_weight/fc_bias)."""
     hint = op_name.lower().strip("_")
     name = NameManager._current.value.get(name, hint)
     attr_scope = AttrScope._current.value.get()
@@ -412,6 +438,30 @@ def _create(op_name, input_syms, attrs, name=None):
             entries.extend(s._entries)
         else:
             entries.append(s._entries[0])
+
+    op = get_op(op_name)
+    spec = op.input_names(merged)
+    if spec is None and kw_inputs:
+        for s in kw_inputs.values():
+            entries.append(s._entries[0])
+    if spec is not None:
+        kw_inputs = kw_inputs or {}
+        full = []
+        pos = 0
+        for slot in spec:
+            aux = slot.startswith("aux:")
+            short = slot.split(":", 1)[-1]
+            if short in kw_inputs:
+                full.append(kw_inputs[short]._entries[0])
+            elif pos < len(entries):
+                full.append(entries[pos])
+                pos += 1
+            else:
+                var_name = "%s_%s" % (name, short)
+                var_attrs = {"__is_aux__": True} if aux else {}
+                vnode = _Node(None, var_name, var_attrs, [])
+                full.append((vnode, 0))
+        entries = full + entries[pos:]
     node = _Node(op_name, name, merged, entries)
     return Symbol([(node, i) for i in range(node.num_outputs)])
 
@@ -452,17 +502,30 @@ def load(fname):
 
 def load_json(json_str):
     conf = json.loads(json_str)
+    import ast
     nodes_conf = conf["nodes"]
     nodes = []
-    for nc in nodes_conf:
-        attrs = {}
-        for k, v in nc.get("attrs", nc.get("param", {})).items():
+
+    def parse_attr(v):
+        """Recover python-typed attrs.  Reference-MXNet JSON stores every attr
+        as a string ('False', '(3, 3)', '1'); parse those too so specs like
+        no_bias behave (legacy_json_util.cc upgrade-path analog)."""
+        if not isinstance(v, str):
+            return v
+        try:
+            out = json.loads(v)
+        except (json.JSONDecodeError, TypeError):
             try:
-                attrs[k] = json.loads(v)
-                if isinstance(attrs[k], list):
-                    attrs[k] = tuple(attrs[k])
-            except (json.JSONDecodeError, TypeError):
-                attrs[k] = v
+                out = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                return v
+        if isinstance(out, list):
+            out = tuple(out)
+        return out
+
+    for nc in nodes_conf:
+        attrs = {k: parse_attr(v)
+                 for k, v in nc.get("attrs", nc.get("param", {})).items()}
         op = nc["op"] if nc["op"] != "null" else None
         inputs = [(nodes[i], idx) for (i, idx, *_rest) in nc.get("inputs", [])]
         node = _Node.__new__(_Node)

@@ -17,6 +17,14 @@ import random as _pyrandom
 import numpy as _np
 import pytest
 
+# Run the suite on the virtual 8-device CPU mesh (context injection: set
+# MXNET_TEST_DEVICE=tpu to run the same tests against hardware).  jax_platforms
+# must be forced via config before any backend initializes, otherwise the axon
+# TPU plugin claims the backend (and hangs if the relay is down).
+if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(autouse=True)
 def with_seed(request):

@@ -1,0 +1,246 @@
+"""Gluon blocks/training (model: reference tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense():
+    net = nn.Dense(5, in_units=10)
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (2, 10)))
+    out = net(x)
+    assert out.shape == (2, 5)
+    w = net.weight.data()
+    assert w.shape == (5, 10)
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(4)
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (3, 7)))
+    out = net(x)
+    assert out.shape == (3, 4)
+    assert net.weight.shape == (4, 7)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (4, 10)))
+    out = net(x)
+    assert out.shape == (4, 2)
+    assert len(net.collect_params().keys()) == 6
+
+
+def test_hybridize():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (4, 10)))
+    out_eager = net(x)
+    net.hybridize()
+    out_hybrid = net(x)
+    assert_almost_equal(out_eager.asnumpy(), out_hybrid.asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+    # repeated call uses the cache
+    out2 = net(x)
+    assert_almost_equal(out2.asnumpy(), out_hybrid.asnumpy())
+
+
+def test_hybridize_training_grad():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(1))
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (4, 5)))
+
+    with autograd.record():
+        loss_eager = (net(x) ** 2).sum()
+    loss_eager.backward()
+    g_eager = {n: p.grad().asnumpy().copy()
+               for n, p in net.collect_params().items()}
+
+    net.hybridize()
+    for p in net.collect_params().values():
+        p.zero_grad()
+    with autograd.record():
+        loss_h = (net(x) ** 2).sum()
+    loss_h.backward()
+    for n, p in net.collect_params().items():
+        assert_almost_equal(p.grad().asnumpy(), g_eager[n], rtol=1e-3, atol=1e-4)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (2, 3, 8, 8)))
+    out = net(x)
+    assert out.shape == (2, 10)
+
+
+def test_batchnorm_layer():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (4, 3, 5, 5)))
+    rm_before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        out = net(x)
+    assert out.shape == x.shape
+    rm_after = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm_before, rm_after)  # stats updated in training
+    out_inf = net(x)  # inference path uses running stats
+    assert out_inf.shape == x.shape
+
+
+def test_trainer_sgd():
+    net = nn.Dense(1, in_units=2)
+    net.initialize(mx.init.Constant(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        loss = (net(x)).sum()
+    loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    assert_almost_equal(w_after, w_before - 0.1 * np.array([[1.0, 2.0]]),
+                        rtol=1e-4)
+
+
+def test_gluon_training_convergence():
+    """Tiny regression: y = 2x + 1 learned by a Dense(1)."""
+    np.random.seed(0)
+    net = nn.Dense(1, in_units=1)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    x = nd.array(np.random.uniform(-1, 1, (64, 1)))
+    y = x * 2 + 1
+    for _ in range(200):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(64)
+    w = net.weight.data().asscalar()
+    b = net.bias.data().asscalar()
+    assert abs(w - 2) < 0.1, w
+    assert abs(b - 1) < 0.1, b
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+        net.add(nn.Dense(2, in_units=4))
+    net.initialize()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+        net2.add(nn.Dense(2, in_units=4))
+    net2.load_parameters(fname)
+    x = nd.array(np.random.uniform(-1, 1, (2, 3)))
+    assert_almost_equal(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_embedding_block():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    x = nd.array([1, 2, 5], dtype="int32")
+    out = net(x)
+    assert out.shape == (3, 4)
+
+
+def test_dropout_block():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    x = nd.ones((10, 10))
+    out = net(x)
+    assert_almost_equal(out.asnumpy(), x.asnumpy())  # inference = identity
+    with autograd.record():
+        out = net(x)
+    assert (out.asnumpy() == 0).any()
+
+
+def test_lstm_layer():
+    net = gluon.rnn.LSTM(8, num_layers=2)
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (5, 3, 4)))  # TNC
+    out = net(x)
+    assert out.shape == (5, 3, 8)
+    states = net.begin_state(batch_size=3)
+    out, new_states = net(x, states)
+    assert out.shape == (5, 3, 8)
+    assert new_states[0].shape == (2, 3, 8)
+    assert new_states[1].shape == (2, 3, 8)
+
+
+def test_gru_rnn_layers():
+    for cls, nstates in ((gluon.rnn.GRU, 1), (gluon.rnn.RNN, 1)):
+        net = cls(6)
+        net.initialize()
+        x = nd.array(np.random.uniform(-1, 1, (4, 2, 3)))
+        out = net(x)
+        assert out.shape == (4, 2, 6)
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (2, 5, 4)))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_losses():
+    pred = nd.array(np.random.uniform(-1, 1, (4, 5)))
+    label = nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    expected = -np.log(np.exp(pred.asnumpy())
+                       / np.exp(pred.asnumpy()).sum(1, keepdims=True))
+    expected = expected[np.arange(4), [0, 1, 2, 3]]
+    assert_almost_equal(l.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, pred * 0)
+    assert_almost_equal(l2.asnumpy(), (pred.asnumpy() ** 2).mean(1) / 2,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_model_zoo_smoke():
+    net = gluon.model_zoo.vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = nd.array(np.random.uniform(-1, 1, (1, 3, 32, 32)))
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_dataset_dataloader():
+    X = np.random.uniform(size=(20, 3))
+    Y = np.arange(20, dtype=np.float32)
+    dataset = gluon.data.ArrayDataset(X.astype(np.float32), Y)
+    loader = gluon.data.DataLoader(dataset, batch_size=5, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (5, 3)
+    assert_almost_equal(yb.asnumpy(), [0, 1, 2, 3, 4])

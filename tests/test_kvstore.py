@@ -1,0 +1,107 @@
+"""KVStore (model: reference tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kv_type="local"):
+    kv = mx.kvstore.create(kv_type)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu_sync"])
+def test_single_kv_pair(kv_type):
+    kv = init_kv(kv_type)
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE))
+
+
+@pytest.mark.parametrize("kv_type", ["local", "tpu_sync"])
+def test_aggregator(kv_type):
+    """Push a list of per-device values: they reduce (CommDevice analog)."""
+    kv = init_kv(kv_type)
+    num_devs = 4
+    devs = [mx.cpu(0)] * num_devs
+    vals = [nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push(3, vals)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * num_devs)
+    # list of keys
+    kv.push(KEYS, [[v * 2 for v in vals]] * len(KEYS))
+    outs = [nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.ones(SHAPE) * 2 * num_devs)
+
+
+def test_updater():
+    kv = init_kv()
+
+    def update(key, grad, weight):
+        weight += grad * 2
+
+    kv._set_updater(update)
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 2)
+    kv.push(3, nd.ones(SHAPE))
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 4)
+
+
+def test_set_optimizer():
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, -0.1), rtol=1e-5)
+
+
+def test_row_sparse_pull():
+    kv = mx.kvstore.create("local")
+    w = np.random.uniform(size=(8, 3)).astype(np.float32)
+    kv.init("emb", nd.array(w))
+    out = nd.zeros((2, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 5], dtype="int32"))
+    assert_almost_equal(out.asnumpy(), w[[1, 5]])
+
+
+def test_string_keys():
+    kv = mx.kvstore.create("local")
+    kv.init("w0", nd.ones(SHAPE))
+    kv.push("w0", nd.ones(SHAPE) * 3)
+    out = nd.empty(SHAPE)
+    kv.pull("w0", out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 3)
+
+
+def test_rank_and_type():
+    kv = mx.kvstore.create("tpu_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    assert kv.type == "tpu_sync"
+    kv2 = mx.kvstore.create("dist_sync")
+    assert kv2.rank == 0
+    kv2.barrier()
+
+
+def test_gradient_compression():
+    kv = init_kv()
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert out.shape == SHAPE

@@ -1,0 +1,129 @@
+"""Module API end-to-end (model: reference tests/python/unittest/test_module.py
++ tests/python/train/test_mlp.py — the minimum slice: MNIST-style MLP/LeNet via
+Module.fit on synthetic data)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, io
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _make_mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    softmax = sym.SoftmaxOutput(fc2, name="softmax")
+    return softmax
+
+
+def _synthetic_blobs(n=256, seed=0):
+    """Linearly separable blobs so a few epochs converge."""
+    rng = np.random.RandomState(seed)
+    centers = rng.uniform(-3, 3, (10, 16))
+    labels = rng.randint(0, 10, n)
+    data = centers[labels] + rng.normal(0, 0.3, (n, 16))
+    return data.astype(np.float32), labels.astype(np.float32)
+
+
+def test_module_bind_forward():
+    net = _make_mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = io.DataBatch(data=[nd.ones((8, 16))], label=[nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (8, 10)
+    assert_almost_equal(outs[0].asnumpy().sum(axis=1), np.ones(8), rtol=1e-4)
+
+
+def test_module_fit_convergence():
+    data, labels = _synthetic_blobs(512)
+    train_iter = io.NDArrayIter(data, labels, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_make_mlp(), context=mx.cpu())
+    mod.fit(train_iter, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric="acc",
+            initializer=mx.init.Xavier())
+    train_iter.reset()
+    score = mod.score(train_iter, "acc")
+    assert score[0][1] > 0.9, "accuracy %s too low" % score[0][1]
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    data, labels = _synthetic_blobs(64)
+    train_iter = io.NDArrayIter(data, labels, batch_size=16)
+    mod = mx.mod.Module(_make_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(data_shapes=train_iter.provide_data,
+              label_shapes=train_iter.provide_label)
+    batch = next(iter(train_iter))
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    assert_almost_equal(mod.get_outputs()[0].asnumpy(),
+                        mod2.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_module_predict():
+    data, labels = _synthetic_blobs(64)
+    it = io.NDArrayIter(data, labels, batch_size=16)
+    mod = mx.mod.Module(_make_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 10)
+
+
+def test_module_lenet_conv():
+    """LeNet on image-shaped synthetic data (BASELINE.json config 1 analog)."""
+    data = sym.Variable("data")
+    conv1 = sym.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8)
+    act1 = sym.Activation(conv1, act_type="relu")
+    pool1 = sym.Pooling(act1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    flat = sym.Flatten(pool1)
+    fc1 = sym.FullyConnected(flat, name="fc1", num_hidden=10)
+    net = sym.SoftmaxOutput(fc1, name="softmax")
+
+    rng = np.random.RandomState(0)
+    X = rng.uniform(0, 1, (64, 1, 12, 12)).astype(np.float32)
+    Y = rng.randint(0, 10, 64).astype(np.float32)
+    it = io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    # just verify it ran and updated params
+    args, _ = mod.get_params()
+    assert not np.allclose(args["fc1_weight"].asnumpy(), 0)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+        out = sym.SoftmaxOutput(fc, name="softmax")
+        return out, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+    batch = io.DataBatch(data=[nd.ones((4, 8))], label=[nd.zeros((4,))],
+                         bucket_key=8,
+                         provide_data=[io.DataDesc("data", (4, 8))],
+                         provide_label=[io.DataDesc("softmax_label", (4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert mod.get_outputs()[0].shape == (4, 4)

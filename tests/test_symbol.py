@@ -1,0 +1,127 @@
+"""Symbol graph API (model: reference tests/python/unittest/test_symbol.py +
+test_infer_shape.py)."""
+import json
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=4)
+    return fc2
+
+
+def test_list_arguments():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert net.list_outputs() == ["fc2_output"]
+
+
+def test_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net2 = sym.FullyConnected(sym.Variable("data2"), name="fc2", num_hidden=5)
+    composed = net2(data2=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc2_weight" in args
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(4, 16))
+    assert dict(zip(net.list_arguments(), arg_shapes)) == {
+        "data": (4, 16), "fc1_weight": (8, 16), "fc1_bias": (8,),
+        "fc2_weight": (4, 8), "fc2_bias": (4,)}
+    assert out_shapes == [(4, 4)]
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="conv", kernel=(3, 3), num_filter=6,
+                           pad=(1, 1))
+    bn = sym.BatchNorm(conv, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(bn.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (6, 3, 3, 3)
+    assert d["bn_gamma"] == (6,)
+    assert dict(zip(bn.list_auxiliary_states(), aux_shapes)) == {
+        "bn_moving_mean": (6,), "bn_moving_var": (6,)}
+    assert out_shapes == [(2, 6, 8, 8), (6,), (6,)]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    parsed = json.loads(js)
+    assert "nodes" in parsed and "arg_nodes" in parsed and "heads" in parsed
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(2, 16))
+    assert out_shapes == [(2, 4)]
+
+
+def test_save_load_file(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "net-symbol.json")
+    net.save(fname)
+    net2 = sym.load(fname)
+    assert net2.list_outputs() == net.list_outputs()
+
+
+def test_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2
+    out = c.eval(a=nd.ones((2, 2)), b=nd.ones((2, 2)))
+    assert_almost_equal(out[0].asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_bind_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    ex = c.bind(mx.cpu(), {"a": nd.array([1.0, 2.0]), "b": nd.array([3.0, 4.0])},
+                args_grad={"a": nd.zeros((2,)), "b": nd.zeros((2,))})
+    out = ex.forward(is_train=True)
+    assert_almost_equal(out[0].asnumpy(), [3.0, 8.0])
+    ex.backward(nd.ones((2,)))
+    assert_almost_equal(ex.grad_dict["a"].asnumpy(), [3.0, 4.0])
+    assert_almost_equal(ex.grad_dict["b"].asnumpy(), [1.0, 2.0])
+
+
+def test_simple_bind():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 16))
+    assert ex.arg_dict["fc1_weight"].shape == (8, 16)
+    ex.arg_dict["data"][:] = 1.0
+    out = ex.forward()
+    assert out[0].shape == (4, 4)
+
+
+def test_internals_group():
+    net = _mlp()
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1_out = internals["fc1_output"]
+    assert fc1_out.list_outputs() == ["fc1_output"]
+    grp = sym.Group([net, fc1_out])
+    assert len(grp.list_outputs()) == 2
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = sym.Variable("x")
+    assert v.attr("ctx_group") == "dev1"
+
+
+def test_symbol_arith_ops():
+    a = sym.Variable("a")
+    out = (a * 2 + 1) / 2
+    res = out.eval(a=nd.array([1.0, 3.0]))
+    assert_almost_equal(res[0].asnumpy(), [1.5, 3.5])

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO (reference: tools/im2rec.py/.cc).
+
+Makes .lst (listing) and .rec/.idx (packed records) files readable by
+mx.io.ImageRecordIter / gluon ImageRecordDataset, using the native C++
+recordio writer when available."""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def list_images(root, recursive, exts):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def make_list(args):
+    image_list = list(list_images(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = "_%d" % i if args.chunks > 1 else ""
+        sep = int(len(chunk) * args.train_ratio)
+        sep_test = int(len(chunk) * args.test_ratio)
+        splits = [("_test", chunk[:sep_test]),
+                  ("_train", chunk[sep_test:sep_test + sep]),
+                  ("_val", chunk[sep_test + sep:])] \
+            if args.train_ratio + args.test_ratio < 1.0 or args.test_ratio > 0 \
+            else [("", chunk)]
+        if args.train_ratio == 1.0 and args.test_ratio == 0:
+            splits = [("", chunk)]
+        for suffix, part in splits:
+            if not part:
+                continue
+            fname = args.prefix + str_chunk + suffix + ".lst"
+            with open(fname, "w") as fout:
+                for item in part:
+                    fout.write("%d\t%f\t%s\n" % (item[0], float(item[2]), item[1]))
+
+
+def write_record(args):
+    from mxnet_tpu import recordio
+    lst = args.prefix + ".lst"
+    frec = args.prefix + ".rec"
+    fidx = args.prefix + ".idx"
+    record = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    with open(lst) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            path = os.path.join(args.root, parts[-1])
+            with open(path, "rb") as f:
+                img = f.read()
+            header = recordio.IRHeader(0, label[0] if len(label) == 1 else label,
+                                       idx, 0)
+            record.write_idx(idx, recordio.pack(header, img))
+    record.close()
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO file")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root dir")
+    parser.add_argument("--list", action="store_true",
+                        help="make list instead of record")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--chunks", type=int, default=1)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0)
+    parser.add_argument("--recursive", action="store_true")
+    parser.add_argument("--shuffle", type=bool, default=True)
+    args = parser.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        if not os.path.isfile(args.prefix + ".lst"):
+            make_list(args)
+        write_record(args)
+
+
+if __name__ == "__main__":
+    main()
