@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Factorization machine on libsvm data (reference:
+example/sparse/factorization_machine/train.py)."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd, nd
+
+
+class FMBlock(gluon.HybridBlock):
+    """y = w0 + <w, x> + 0.5 * sum((Vx)^2 - (V^2)(x^2))."""
+
+    def __init__(self, num_features, factor_size, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.w = self.params.get("w_weight", shape=(num_features, 1))
+            self.v = self.params.get("v_weight", shape=(num_features, factor_size))
+            self.w0 = self.params.get("w0_bias", shape=(1,))
+
+    def hybrid_forward(self, F, x, w, v, w0):
+        linear = F.dot(x, w).reshape((-1,))
+        vx = F.dot(x, v)
+        v2x2 = F.dot(x * x, v * v)
+        pairwise = 0.5 * F.sum(vx * vx - v2x2, axis=1)
+        return linear + pairwise + w0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-features", type=int, default=64)
+    parser.add_argument("--factor-size", type=int, default=8)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--data", default=None, help="libsvm file (synthetic if absent)")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    if args.data:
+        it = mx.io.LibSVMIter(data_libsvm=args.data,
+                              data_shape=(args.num_features,),
+                              batch_size=args.batch_size)
+        batches = list(it)
+    else:
+        w_true = rng.normal(0, 1, args.num_features)
+        X = (rng.uniform(0, 1, (2048, args.num_features)) < 0.1).astype(np.float32) \
+            * rng.normal(1, 0.3, (2048, args.num_features)).astype(np.float32)
+        y = (X.dot(w_true) > 0).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                               label_name="label")
+        batches = None
+
+    net = FMBlock(args.num_features, args.factor_size)
+    net.initialize(mx.init.Normal(0.05))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    metric = mx.metric.create(lambda label, pred: ((pred > 0.5) == label).mean())
+    for epoch in range(args.num_epochs):
+        it.reset()
+        metric.reset()
+        total, count = 0.0, 0
+        for batch in it:
+            x = batch.data[0]
+            if x.stype != "default":
+                x = x.todense()
+            yb = batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.mean().asscalar())
+            count += 1
+            metric.update([yb], [out.sigmoid()])
+        logging.info("Epoch %d loss %.4f acc %.3f", epoch, total / count,
+                     metric.get()[1])
+    print("final acc:", metric.get()[1])
+
+
+if __name__ == "__main__":
+    main()

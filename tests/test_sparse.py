@@ -1,0 +1,118 @@
+"""Sparse NDArray (model: reference tests/python/unittest/test_sparse_ndarray.py
+/ test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_csr_creation():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], dtype=np.float32)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.todense().asnumpy(), dense)
+    assert_almost_equal(csr.data.asnumpy(), [1, 2, 3])
+    assert_almost_equal(csr.indices.asnumpy(), [1, 0, 2])
+    assert_almost_equal(csr.indptr.asnumpy(), [0, 1, 3])
+
+
+def test_csr_from_triple():
+    csr = sparse.csr_matrix((np.array([1.0, 2.0]), np.array([0, 2]),
+                             np.array([0, 1, 2])), shape=(2, 3))
+    expected = np.array([[1, 0, 0], [0, 0, 2]], dtype=np.float32)
+    assert_almost_equal(csr.todense().asnumpy(), expected)
+
+
+def test_row_sparse_creation():
+    dense = np.zeros((5, 3), dtype=np.float32)
+    dense[1] = 1.0
+    dense[3] = 2.0
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert_almost_equal(rsp.indices.asnumpy(), [1, 3])
+    assert_almost_equal(rsp.todense().asnumpy(), dense)
+
+
+def test_row_sparse_retain():
+    dense = np.arange(15).reshape(5, 3).astype(np.float32)
+    rsp = sparse.row_sparse_array(dense)
+    ret = rsp.retain(nd.array([0, 3], dtype="int32"))
+    out = ret.todense().asnumpy()
+    assert_almost_equal(out[0], dense[0])
+    assert_almost_equal(out[3], dense[3])
+    assert out[1].sum() == 0
+
+
+def test_cast_storage():
+    dense = nd.array(np.array([[0, 2.0], [3.0, 0]]))
+    csr = dense.tostype("csr")
+    assert csr.stype == "csr"
+    back = csr.tostype("default")
+    assert back.stype == "default"
+    assert_almost_equal(back.asnumpy(), dense.asnumpy())
+
+
+def test_sparse_dot():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], dtype=np.float32)
+    rhs = np.random.uniform(size=(3, 4)).astype(np.float32)
+    csr = sparse.csr_matrix(dense)
+    out = nd.dot(csr, nd.array(rhs))
+    assert_almost_equal(out.asnumpy(), dense.dot(rhs), rtol=1e-5)
+
+
+def test_sparse_arithmetic_densifies():
+    csr = sparse.csr_matrix(np.array([[0, 1.0], [2.0, 0]]))
+    out = csr * 2 + 1
+    assert_almost_equal(out.asnumpy(), [[1, 3], [5, 1]])
+
+
+def test_rand_sparse():
+    arr, dense = sparse.rand_sparse_ndarray((10, 8), "csr", density=0.3)
+    assert_almost_equal(arr.todense().asnumpy(), dense)
+    arr, dense = sparse.rand_sparse_ndarray((10, 8), "row_sparse", density=0.3)
+    assert_almost_equal(arr.todense().asnumpy(), dense)
+
+
+def test_libsvm_iter(tmp_path):
+    fname = str(tmp_path / "data.libsvm")
+    with open(fname, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:3.0\n")
+        f.write("1 2:1.0 3:4.0\n")
+        f.write("0 0:0.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=fname, data_shape=(4,), batch_size=2)
+    batch = next(iter(it))
+    assert batch.data[0].stype == "csr"
+    assert batch.data[0].shape == (2, 4)
+    assert_almost_equal(batch.data[0].todense().asnumpy(),
+                        [[1.5, 0, 0, 2.0], [0, 3.0, 0, 0]])
+    assert_almost_equal(batch.label[0].asnumpy(), [1, 0])
+
+
+def test_kvstore_row_sparse_weight():
+    kv = mx.kvstore.create("local")
+    w = np.random.uniform(size=(6, 2)).astype(np.float32)
+    kv.init("emb", nd.array(w))
+    out = nd.zeros((3, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([0, 2, 5], dtype="int32"))
+    assert_almost_equal(out.asnumpy(), w[[0, 2, 5]])
+
+
+def test_sparse_embedding_grad():
+    """Embedding gradient flows (dense grad; row-sparse is a storage
+    optimization the TPU build folds into XLA gather/scatter)."""
+    from mxnet_tpu import autograd
+    weight = nd.array(np.random.uniform(-1, 1, (10, 4)))
+    weight.attach_grad()
+    idx = nd.array([1, 3, 1], dtype="int32")
+    with autograd.record():
+        emb = nd.Embedding(idx, weight, input_dim=10, output_dim=4)
+        loss = emb.sum()
+    loss.backward()
+    g = weight.grad.asnumpy()
+    assert g[1].sum() == 8.0  # row 1 gathered twice
+    assert g[3].sum() == 4.0
+    assert g[0].sum() == 0.0
