@@ -12,3 +12,5 @@ from . import nn_ops          # noqa: F401
 from . import random_ops      # noqa: F401
 from . import optimizer_ops   # noqa: F401
 from . import linalg_ops      # noqa: F401
+from . import contrib_ops     # noqa: F401
+from . import quantization_ops  # noqa: F401

@@ -1,0 +1,375 @@
+"""Contrib ops: detection, bounding boxes, ROI ops, attention.
+
+Reference: src/operator/contrib/ — multibox_prior/detection/target.cc (SSD),
+bounding_box.cc (box_nms/box_iou), roi_align.cc, psroi_pooling,
+proposal.cc (RCNN), deformable convolution, transformer.cc (multi-head
+attention helpers), count_sketch/fft; plus src/operator/roi_pooling.cc.
+
+TPU-native notes: NMS/proposal are compiled with fixed-size outputs (XLA
+static shapes — scores padded with -1 like the reference's invalid entries);
+ROI pooling/align vectorize over boxes with gather arithmetic instead of the
+reference's per-box CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# SSD: MultiBoxPrior / MultiBoxTarget / MultiBoxDetection
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior")
+def _multibox_prior(attrs, data):
+    """Generate SSD anchor boxes (src/operator/contrib/multibox_prior.cc).
+    data: (N, C, H, W) feature map; returns (1, H*W*num_anchors, 4)."""
+    jnp = _jnp()
+    sizes = tuple(attrs.get("sizes", (1.0,)))
+    ratios = tuple(attrs.get("ratios", (1.0,)))
+    steps = attrs.get("steps", (-1.0, -1.0))
+    offsets = attrs.get("offsets", (0.5, 0.5))
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    # anchors: first size with each ratio=1? MXNet: sizes[0] with all ratios +
+    # remaining sizes with ratios[0]
+    whs = []
+    for r in ratios:
+        s = sizes[0]
+        sr = _np.sqrt(r)
+        whs.append((s * sr, s / sr))
+    for s in sizes[1:]:
+        r = ratios[0]
+        sr = _np.sqrt(r)
+        whs.append((s * sr, s / sr))
+    boxes = []
+    for (w, h) in whs:
+        xmin = cxg - w / 2
+        ymin = cyg - h / 2
+        xmax = cxg + w / 2
+        ymax = cyg + h / 2
+        boxes.append(jnp.stack([xmin, ymin, xmax, ymax], axis=-1))
+    out = jnp.stack(boxes, axis=2)  # (H, W, A, 4)
+    return out.reshape(1, -1, 4)
+
+
+def _box_iou_xyxy(jnp, a, b):
+    """IoU between (..., 4) boxes, broadcasting."""
+    ix1 = jnp.maximum(a[..., 0], b[..., 0])
+    iy1 = jnp.maximum(a[..., 1], b[..., 1])
+    ix2 = jnp.minimum(a[..., 2], b[..., 2])
+    iy2 = jnp.minimum(a[..., 3], b[..., 3])
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(b[..., 3] - b[..., 1], 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_iou")
+def _box_iou(attrs, lhs, rhs):
+    jnp = _jnp()
+    fmt = attrs.get("format", "corner")
+    a, b = lhs, rhs
+    if fmt == "center":
+        def to_corner(x):
+            cx, cy, w, h = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        a, b = to_corner(a), to_corner(b)
+    return _box_iou_xyxy(jnp, a[..., :, None, :], b[..., None, :, :])
+
+
+@register("_contrib_MultiBoxTarget", num_outputs=3)
+def _multibox_target(attrs, anchors, labels, cls_preds):
+    """Assign ground truth to anchors (multibox_target.cc): returns
+    (loc_target, loc_mask, cls_target).  labels: (N, M, 5) [cls, 4 box]."""
+    import jax
+    jnp = _jnp()
+    iou_thresh = float(attrs.get("overlap_threshold", 0.5))
+    variances = tuple(attrs.get("variances", (0.1, 0.1, 0.2, 0.2)))
+    A = anchors.shape[1]
+    N = labels.shape[0]
+    anc = anchors[0]  # (A, 4)
+
+    def per_sample(lab):
+        valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _box_iou_xyxy(jnp, anc[:, None, :], gt_boxes[None, :, :])  # (A, M)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= iou_thresh
+        # ensure each valid gt gets its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)   # (M,)
+        forced = jnp.zeros((A,), bool).at[best_anchor].set(valid)
+        matched = matched | forced
+        gt = gt_boxes[best_gt]
+        # encode: (center offset / variance)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+        gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+        gcx = (gt[:, 0] + gt[:, 2]) / 2
+        gcy = (gt[:, 1] + gt[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+        loc = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc = jnp.where(matched[:, None], loc, 0.0)
+        mask = jnp.where(matched[:, None], 1.0, 0.0)
+        mask = jnp.broadcast_to(mask, (A, 4))
+        cls_t = jnp.where(matched, lab[best_gt, 0] + 1, 0.0)
+        return loc.reshape(-1), mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(labels)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection")
+def _multibox_detection(attrs, cls_prob, loc_pred, anchors):
+    """Decode + NMS (multibox_detection.cc): returns (N, A, 6)
+    [cls_id, score, xmin, ymin, xmax, ymax], invalid entries cls_id=-1."""
+    import jax
+    jnp = _jnp()
+    nms_thresh = float(attrs.get("nms_threshold", 0.5))
+    score_thresh = float(attrs.get("threshold", 0.01))
+    variances = tuple(attrs.get("variances", (0.1, 0.1, 0.2, 0.2)))
+    topk = int(attrs.get("nms_topk", -1))
+    anc = anchors[0]
+    A = anc.shape[0]
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+
+    def per_sample(probs, loc):
+        loc = loc.reshape(A, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        # skip background class 0
+        scores = probs[1:, :]             # (C-1, A)
+        cls_id = jnp.argmax(scores, axis=0).astype(jnp.float32)
+        score = jnp.max(scores, axis=0)
+        valid = score > score_thresh
+        order = jnp.argsort(-score)
+        boxes_s = boxes[order]
+        score_s = score[order]
+        cls_s = cls_id[order]
+        valid_s = valid[order]
+
+        iou = _box_iou_xyxy(jnp, boxes_s[:, None, :], boxes_s[None, :, :])
+        same_cls = cls_s[:, None] == cls_s[None, :]
+        sup = (iou > nms_thresh) & same_cls
+        tri = jnp.triu(jnp.ones((A, A), bool), 1)  # tri[j,i]: j scored higher than i
+
+        def body(i, keep):
+            sup_i = sup[:, i] & tri[:, i] & keep  # kept higher-scored boxes that overlap i
+            return keep.at[i].set(keep[i] & ~jnp.any(sup_i))
+
+        keep = jax.lax.fori_loop(0, A, body, valid_s)
+        out_cls = jnp.where(keep, cls_s, -1.0)
+        out = jnp.concatenate([out_cls[:, None], score_s[:, None], boxes_s],
+                              axis=1)
+        return out
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+@register("_contrib_box_nms")
+def _box_nms(attrs, data):
+    """NMS over (..., N, K>=6) [id, score, x1,y1,x2,y2] (bounding_box.cc).
+    Suppressed entries get id=-1."""
+    import jax
+    jnp = _jnp()
+    thresh = float(attrs.get("overlap_thresh", 0.5))
+    valid_thresh = float(attrs.get("valid_thresh", 0))
+    score_index = int(attrs.get("score_index", 1))
+    id_index = int(attrs.get("id_index", 0))
+    coord_start = int(attrs.get("coord_start", 2))
+    force = bool(attrs.get("force_suppress", False))
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    N = shape[-2]
+
+    def per(sample):
+        score = sample[:, score_index]
+        ids = sample[:, id_index]
+        boxes = sample[:, coord_start:coord_start + 4]
+        valid = score > valid_thresh
+        order = jnp.argsort(-score)
+        s = sample[order]
+        score_s = score[order]
+        ids_s = ids[order]
+        boxes_s = boxes[order]
+        valid_s = valid[order]
+        iou = _box_iou_xyxy(jnp, boxes_s[:, None, :], boxes_s[None, :, :])
+        same = jnp.ones((N, N), bool) if force else \
+            (ids_s[:, None] == ids_s[None, :])
+        sup = (iou > thresh) & same
+        tri = jnp.triu(jnp.ones((N, N), bool), 1)
+
+        def body(i, keep):
+            return keep.at[i].set(keep[i] & ~jnp.any(sup[:, i] & tri[:, i] & keep))
+
+        keep = jax.lax.fori_loop(0, N, body, valid_s)
+        out = s.at[:, id_index].set(jnp.where(keep, ids_s, -1.0))
+        return out
+
+    out = jax.vmap(per)(flat)
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling")
+def _roi_pooling(attrs, data, rois):
+    """Max-pool each ROI to a fixed grid (src/operator/roi_pooling.cc).
+    rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image coords."""
+    import jax
+    jnp = _jnp()
+    ph, pw = tuple(attrs["pooled_size"])
+    spatial_scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = data.shape
+
+    def per_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = data[b]  # (C, H, W)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        outs = []
+        for py in range(ph):
+            for px in range(pw):
+                y_lo = y1 + py * bin_h
+                y_hi = y1 + (py + 1) * bin_h
+                x_lo = x1 + px * bin_w
+                x_hi = x1 + (px + 1) * bin_w
+                my = (ys >= jnp.floor(y_lo)) & (ys < jnp.ceil(y_hi))
+                mx = (xs >= jnp.floor(x_lo)) & (xs < jnp.ceil(x_hi))
+                mask = my[:, None] & mx[None, :]
+                vals = jnp.where(mask[None], img, -jnp.inf)
+                m = jnp.max(vals, axis=(1, 2))
+                outs.append(jnp.where(jnp.isfinite(m), m, 0.0))
+        return jnp.stack(outs, axis=-1).reshape(C, ph, pw)
+
+    return jax.vmap(per_roi)(rois)
+
+
+@register("_contrib_ROIAlign")
+def _roi_align(attrs, data, rois):
+    """Bilinear ROI align (src/operator/contrib/roi_align.cc)."""
+    import jax
+    jnp = _jnp()
+    ph, pw = tuple(attrs["pooled_size"])
+    spatial_scale = float(attrs.get("spatial_scale", 1.0))
+    sample_ratio = int(attrs.get("sample_ratio", 2))
+    if sample_ratio <= 0:
+        sample_ratio = 2
+    N, C, H, W = data.shape
+
+    def bilinear(img, y, x):
+        y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = y - y0
+        wx = x - x0
+        return (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                + img[:, y0, x1] * (1 - wy) * wx
+                + img[:, y1, x0] * wy * (1 - wx)
+                + img[:, y1, x1] * wy * wx)
+
+    def per_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = data[b]
+        out = jnp.zeros((C, ph, pw))
+        for py in range(ph):
+            for px in range(pw):
+                acc = jnp.zeros((C,))
+                for sy in range(sample_ratio):
+                    for sx in range(sample_ratio):
+                        y = y1 + (py + (sy + 0.5) / sample_ratio) * bin_h
+                        x = x1 + (px + (sx + 0.5) / sample_ratio) * bin_w
+                        acc = acc + bilinear(img, y, x)
+                out = out.at[:, py, px].set(acc / (sample_ratio * sample_ratio))
+        return out
+
+    return jax.vmap(per_roi)(rois)
+
+
+@register("_contrib_Proposal")
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    raise NotImplementedError("Proposal op: RCNN stage widening item")
+
+
+# ---------------------------------------------------------------------------
+# Attention (transformer.cc analog, TPU-first: one fused softmax(QK^T)V)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def _selfatt_qk(attrs, queries_keys_values):
+    """(T, B, 3*H*D) interleaved qkv -> (B*H, T, T) attention scores."""
+    jnp = _jnp()
+    heads = int(attrs["heads"])
+    T, B, _ = queries_keys_values.shape
+    qkv = queries_keys_values.reshape(T, B, heads, 3, -1)
+    q = qkv[:, :, :, 0]
+    k = qkv[:, :, :, 1]
+    D = q.shape[-1]
+    q = jnp.transpose(q, (1, 2, 0, 3)).reshape(B * heads, T, D)
+    k = jnp.transpose(k, (1, 2, 0, 3)).reshape(B * heads, T, D)
+    return jnp.matmul(q, jnp.swapaxes(k, -1, -2)) / jnp.sqrt(D).astype(q.dtype)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def _selfatt_valatt(attrs, queries_keys_values, attention):
+    jnp = _jnp()
+    heads = int(attrs["heads"])
+    T, B, _ = queries_keys_values.shape
+    qkv = queries_keys_values.reshape(T, B, heads, 3, -1)
+    v = qkv[:, :, :, 2]
+    D = v.shape[-1]
+    v = jnp.transpose(v, (1, 2, 0, 3)).reshape(B * heads, T, D)
+    out = jnp.matmul(attention, v)  # (B*H, T, D)
+    out = out.reshape(B, heads, T, D)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(T, B, heads * D)
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(attrs, data):
+    jnp = _jnp()
+    return data / jnp.sqrt(float(data.shape[-1])).astype(data.dtype)

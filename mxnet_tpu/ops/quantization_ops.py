@@ -1,0 +1,99 @@
+"""Quantization ops.
+
+Reference: src/operator/quantization/ — quantize/dequantize/requantize,
+quantized_conv/quantized_fully_connected/quantized_pooling, and the
+calibration graph pass (quantize_graph_pass.cc).
+
+TPU-native: int8 tensors with per-tensor scales; the quantized matmul/conv
+lower to XLA int8 dots (MXU native int8 throughput) with fp32 accumulation,
+requantization fused into the same module.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("_contrib_quantize", num_outputs=3)
+def _quantize(attrs, data, min_range, max_range):
+    jnp = _jnp()
+    out_type = attrs.get("out_type", "uint8")
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-12)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0, 255).astype(jnp.uint8)
+    else:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        scale = 127.0 / jnp.maximum(amax, 1e-12)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, mn.reshape((1,)), mx.reshape((1,))
+
+
+@register("_contrib_quantize_v2", num_outputs=3)
+def _quantize_v2(attrs, data):
+    jnp = _jnp()
+    mn = jnp.min(data)
+    mx = jnp.max(data)
+    return _quantize({"out_type": attrs.get("out_type", "int8")},
+                     data, mn.reshape((1,)), mx.reshape((1,)))
+
+
+@register("_contrib_dequantize")
+def _dequantize(attrs, data, min_range, max_range):
+    jnp = _jnp()
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / 255.0
+        return data.astype(jnp.float32) * scale + mn
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return data.astype(jnp.float32) * (amax / 127.0)
+
+
+@register("_contrib_requantize", num_outputs=3)
+def _requantize(attrs, data, min_range, max_range):
+    """int32 accumulators -> int8 with recalibrated range."""
+    jnp = _jnp()
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    real = data.astype(jnp.float32) * (jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+                                       / (1 << 30))
+    new_mn = jnp.min(real)
+    new_mx = jnp.max(real)
+    amax = jnp.maximum(jnp.abs(new_mn), jnp.abs(new_mx))
+    q = jnp.clip(jnp.round(real * 127.0 / jnp.maximum(amax, 1e-12)),
+                 -127, 127).astype(jnp.int8)
+    return q, new_mn.reshape((1,)), new_mx.reshape((1,))
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3)
+def _quantized_fc(attrs, data, weight, bias, min_data, max_data, min_w, max_w,
+                  min_b=None, max_b=None):
+    """int8 x int8 -> fp32 FC (quantized_fully_connected.cc).  The int8 dot
+    hits the MXU's native int8 path (preferred_element_type=int32)."""
+    import jax
+    jnp = _jnp()
+    num_hidden = int(attrs["num_hidden"])
+    d_scale = jnp.maximum(jnp.abs(min_data.reshape(())),
+                          jnp.abs(max_data.reshape(()))) / 127.0
+    w_scale = jnp.maximum(jnp.abs(min_w.reshape(())),
+                          jnp.abs(max_w.reshape(()))) / 127.0
+    acc = jax.lax.dot_general(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (d_scale * w_scale)
+    if bias is not None and not attrs.get("no_bias", False):
+        b_scale = jnp.maximum(jnp.abs(min_b.reshape(())),
+                              jnp.abs(max_b.reshape(()))) / 127.0
+        out = out + bias.astype(jnp.float32) * b_scale
+    out_min = jnp.min(out).reshape((1,))
+    out_max = jnp.max(out).reshape((1,))
+    return out, out_min, out_max

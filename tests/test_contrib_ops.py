@@ -1,0 +1,127 @@
+"""Contrib op correctness: detection, ROI, attention, quantization
+(model: reference tests/python/unittest/test_contrib_operator.py +
+tests/python/quantization/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd._wrap if False else None
+    out = nd.invoke("_contrib_MultiBoxPrior", [x],
+                    {"sizes": (0.5,), "ratios": (1.0, 2.0)}) \
+        if hasattr(nd, "invoke") else None
+    from mxnet_tpu.ndarray import invoke
+    out = invoke("_contrib_MultiBoxPrior", [x], {"sizes": (0.5,),
+                                                 "ratios": (1.0, 2.0)})
+    assert out.shape == (1, 4 * 4 * 2, 4)
+    a = out.asnumpy()[0, 0]
+    # first anchor centered at (0.125, 0.125), size 0.5
+    assert_almost_equal([a[2] - a[0]], [0.5], rtol=1e-5)
+
+
+def test_box_iou():
+    from mxnet_tpu.ndarray import invoke
+    a = nd.array([[0.0, 0, 2, 2]])
+    b = nd.array([[1.0, 1, 3, 3], [0, 0, 2, 2]])
+    iou = invoke("_contrib_box_iou", [a, b], {})
+    assert_almost_equal(iou.asnumpy(), [[1.0 / 7.0, 1.0]], rtol=1e-5)
+
+
+def test_box_nms():
+    from mxnet_tpu.ndarray import invoke
+    boxes = nd.array([[[0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                       [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # overlaps first
+                       [0, 0.7, 2.0, 2.0, 3.0, 3.0]]])   # separate
+    out = invoke("_contrib_box_nms", [boxes], {"overlap_thresh": 0.5})
+    ids = out.asnumpy()[0, :, 0]
+    assert ids[0] == 0          # best kept
+    assert ids[1] == -1         # suppressed
+    assert ids[2] == 0          # kept (no overlap)
+
+
+def test_multibox_target_detection_roundtrip():
+    from mxnet_tpu.ndarray import invoke
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0]]])
+    labels = nd.array([[[1.0, 0.45, 0.45, 1.0, 1.0]]])  # gt near 2nd anchor
+    cls_preds = nd.zeros((1, 3, 2))
+    loc_t, loc_m, cls_t = invoke("_contrib_MultiBoxTarget",
+                                 [anchors, labels, cls_preds], {})
+    assert cls_t.shape == (1, 2)
+    assert cls_t.asnumpy()[0, 1] == 2.0  # class 1 -> target 2 (bg=0)
+    assert loc_m.asnumpy()[0, 4:].sum() == 4.0  # 2nd anchor mask on
+
+    # detection decode: feed perfect predictions back
+    cls_prob = nd.array([[[0.1, 0.9], [0.1, 0.9]]]).transpose((0, 2, 1))
+    cls_prob = nd.array(np.array([[[0.1, 0.1], [0.9, 0.9]]], dtype=np.float32))
+    loc_pred = nd.zeros((1, 8))
+    out = invoke("_contrib_MultiBoxDetection", [cls_prob, loc_pred, anchors], {})
+    assert out.shape == (1, 2, 6)
+
+
+def test_roi_pooling():
+    from mxnet_tpu.ndarray import invoke
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array([[0.0, 0, 0, 3, 3]])
+    out = invoke("ROIPooling", [data, rois],
+                 {"pooled_size": (2, 2), "spatial_scale": 1.0})
+    assert out.shape == (1, 1, 2, 2)
+    assert_almost_equal(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_roi_align_shape():
+    from mxnet_tpu.ndarray import invoke
+    data = nd.array(np.random.uniform(size=(2, 3, 8, 8)).astype(np.float32))
+    rois = nd.array([[0.0, 0, 0, 4, 4], [1.0, 2, 2, 6, 6]])
+    out = invoke("_contrib_ROIAlign", [data, rois],
+                 {"pooled_size": (2, 2), "spatial_scale": 1.0})
+    assert out.shape == (2, 3, 2, 2)
+
+
+def test_interleaved_selfatt():
+    from mxnet_tpu.ndarray import invoke
+    T, B, H, D = 4, 2, 2, 3
+    qkv = nd.array(np.random.uniform(-1, 1, (T, B, 3 * H * D)).astype(np.float32))
+    att = invoke("_contrib_interleaved_matmul_selfatt_qk", [qkv], {"heads": H})
+    assert att.shape == (B * H, T, T)
+    probs = nd.softmax(att, axis=-1)
+    out = invoke("_contrib_interleaved_matmul_selfatt_valatt", [qkv, probs],
+                 {"heads": H})
+    assert out.shape == (T, B, H * D)
+
+
+def test_quantize_dequantize_roundtrip():
+    from mxnet_tpu.ndarray import invoke
+    x = nd.array(np.random.uniform(-3, 3, (4, 5)).astype(np.float32))
+    q, mn, mx_ = invoke("_contrib_quantize_v2", [x], {"out_type": "int8"})
+    assert str(q.dtype) == "int8"
+    back = invoke("_contrib_dequantize", [q, mn, mx_], {})
+    assert_almost_equal(back.asnumpy(), x.asnumpy(), rtol=0.05, atol=0.05)
+
+
+def test_quantized_fc():
+    from mxnet_tpu.ndarray import invoke
+    rng = np.random.RandomState(0)
+    xf = rng.uniform(-1, 1, (2, 8)).astype(np.float32)
+    wf = rng.uniform(-1, 1, (4, 8)).astype(np.float32)
+    xq, xmn, xmx = invoke("_contrib_quantize_v2", [nd.array(xf)],
+                          {"out_type": "int8"})
+    wq, wmn, wmx = invoke("_contrib_quantize_v2", [nd.array(wf)],
+                          {"out_type": "int8"})
+    out, omn, omx = invoke("_contrib_quantized_fully_connected",
+                           [xq, wq, nd.zeros((4,)), xmn, xmx, wmn, wmx,
+                            nd.array([-1.0]), nd.array([1.0])],
+                           {"num_hidden": 4, "no_bias": True})
+    assert_almost_equal(out.asnumpy(), xf.dot(wf.T), rtol=0.1, atol=0.1)
+
+
+def test_fft_roundtrip():
+    from mxnet_tpu import contrib
+    x = nd.array(np.random.uniform(-1, 1, (2, 8)).astype(np.float32))
+    f = contrib.ndarray.fft(x)
+    assert f.shape == (2, 16)
