@@ -72,8 +72,18 @@ class Initializer:
             self._init_zero(name, arr)
         elif name.endswith("min") or name.endswith("max"):
             self._init_zero(name, arr)
+        elif name.endswith("parameters"):
+            # fused-RNN packed blob; FusedRNN initializer does the structured
+            # per-matrix init, any other initializer gets a flat uniform
+            self._init_rnn_packed(name, arr)
         else:
             self._init_default(name, arr)
+
+    def _init_rnn_packed(self, name, arr):
+        if isinstance(self, FusedRNN):
+            self._init_weight(name, arr)
+        else:
+            self._set(arr, _np.random.uniform(-0.07, 0.07, arr.shape))
 
     def _set(self, arr, np_value):
         arr[:] = np_value.astype(_np.float32) if np_value.dtype == _np.float64 else np_value

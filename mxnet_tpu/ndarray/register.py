@@ -43,6 +43,8 @@ def make_op_func(op_name):
         inputs = []
         trailing = []
         for a in args:
+            if a is None:
+                continue
             if isinstance(a, NDArray):
                 if trailing:
                     raise TypeError("NDArray argument after scalar argument in %s"

@@ -14,3 +14,4 @@ from . import optimizer_ops   # noqa: F401
 from . import linalg_ops      # noqa: F401
 from . import contrib_ops     # noqa: F401
 from . import quantization_ops  # noqa: F401
+from . import pallas_ops      # noqa: F401

@@ -802,8 +802,8 @@ _get_op("MAERegressionOutput").arg_spec = ["data", "label:label"]
 _get_op("LogisticRegressionOutput").arg_spec = ["data", "label:label"]
 _get_op("softmax_cross_entropy").arg_spec = ["data", "label:label"]
 _get_op("RNN").arg_spec = lambda attrs: (
-    ["data", "parameters", "state"]
-    + (["state_cell"] if attrs.get("mode", "lstm") == "lstm" else []))
+    ["data", "parameters", "zero:state"]
+    + (["zero:state_cell"] if attrs.get("mode", "lstm") == "lstm" else []))
 
 
 def _prod(t):

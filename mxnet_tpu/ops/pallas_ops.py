@@ -1,0 +1,147 @@
+"""Pallas TPU kernels for the hot ops XLA fusion can't produce by itself.
+
+Reference counterpart: the CUDA kernels under src/operator/ (and the
+transformer attention helpers in src/operator/contrib/transformer.cc).  Here
+the accelerator kernels are Pallas: tiled flash attention with the streaming
+log-sum-exp softmax, keeping the working set in VMEM and the QK^T / PV matmuls
+on the MXU.
+
+Every kernel has a pure-XLA fallback (used on CPU and as the vjp path);
+``_use_pallas()`` picks the implementation by backend.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from .registry import register
+
+
+def _use_pallas():
+    import jax
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+def _attention_reference(q, k, v, causal, scale):
+    import jax
+    import jax.numpy as jnp
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _flash_attention_pallas(q, k, v, causal, scale, block_q=128, block_k=128,
+                            interpret=False):
+    """Tiled attention: grid over (batch*heads, q blocks); inner fori_loop
+    streams K/V blocks through VMEM with the online-softmax accumulator."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    n_k_blocks = (Tk + block_k - 1) // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        q_blk = q_ref[...].astype(jnp.float32) * scale        # (bq, D)
+        m = jnp.full((block_q,), -1e30, jnp.float32)
+        l = jnp.zeros((block_q,), jnp.float32)
+        acc = jnp.zeros((block_q, D), jnp.float32)
+
+        def body(ki, carry):
+            m_, l_, acc_ = carry
+            k_blk = k_ref[pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
+            s = q_blk @ k_blk.T                               # MXU
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, -1e30)
+            m_cur = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_, m_cur)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_ - m_new)
+            l_new = alpha * l_ + jnp.sum(p, axis=1)
+            acc_new = acc_ * alpha[:, None] + p @ v_blk       # MXU
+            return m_new, l_new, acc_new
+
+        upper = n_k_blocks if not causal else \
+            jax.lax.min(n_k_blocks, (qi + 1) * block_q // block_k + 1)
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+        o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
+    """Fused attention entry: Pallas kernel on TPU, XLA reference elsewhere.
+
+    q/k/v: (B, H, T, D).  Differentiable: custom_vjp with the reference
+    backward (recompute-based, XLA-fused)."""
+    import jax
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = 1.0 / _np.sqrt(q.shape[-1])
+    use_pallas = _use_pallas() if interpret is None else True
+
+    @jax.custom_vjp
+    def f(q_, k_, v_):
+        if use_pallas and q_.shape[2] % 128 == 0 or interpret:
+            try:
+                return _flash_attention_pallas(q_, k_, v_, causal, scale,
+                                               interpret=bool(interpret))
+            except Exception:
+                return _attention_reference(q_, k_, v_, causal, scale)
+        return _attention_reference(q_, k_, v_, causal, scale)
+
+    def f_fwd(q_, k_, v_):
+        return f(q_, k_, v_), (q_, k_, v_)
+
+    def f_bwd(res, g):
+        q_, k_, v_ = res
+        _, vjp = jax.vjp(lambda a, b, c: _attention_reference(a, b, c, causal,
+                                                              scale), q_, k_, v_)
+        return vjp(g)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(q, k, v)
+
+
+@register("_contrib_flash_attention")
+def _flash_attention_op(attrs, q, k, v):
+    return flash_attention(q, k, v, causal=bool(attrs.get("causal", False)),
+                           scale=attrs.get("scale"))

@@ -450,6 +450,7 @@ def _create(op_name, input_syms, attrs, name=None, kw_inputs=None):
         pos = 0
         for slot in spec:
             aux = slot.startswith("aux:")
+            zero = slot.startswith("zero:")
             short = slot.split(":", 1)[-1]
             if short in kw_inputs:
                 full.append(kw_inputs[short]._entries[0])
@@ -458,7 +459,11 @@ def _create(op_name, input_syms, attrs, name=None, kw_inputs=None):
                 pos += 1
             else:
                 var_name = "%s_%s" % (name, short)
-                var_attrs = {"__is_aux__": True} if aux else {}
+                var_attrs = {}
+                if aux:
+                    var_attrs["__is_aux__"] = True
+                if zero:
+                    var_attrs["__init__"] = json.dumps(["zero", {}])
                 vnode = _Node(None, var_name, var_attrs, [])
                 full.append((vnode, 0))
         entries = full + entries[pos:]
