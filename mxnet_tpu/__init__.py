@@ -33,6 +33,7 @@ from . import util
 
 init = initializer  # mx.init.Xavier() style access
 kvstore = kvs
+kv = kvs            # mx.kv.create(...) (reference python/mxnet/__init__.py)
 
 from . import symbol
 from . import symbol as sym

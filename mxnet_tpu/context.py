@@ -64,31 +64,22 @@ class Context:
 
     # --- JAX resolution -------------------------------------------------
     def jax_device(self):
-        """Resolve to a concrete jax.Device (lazily; may fall back to cpu)."""
+        """Resolve to a concrete jax.Device (lazily; may fall back to cpu).
+
+        Only ADDRESSABLE devices are eligible: under multi-process
+        jax.distributed, jax.devices() includes other workers' devices and
+        placing an array there raises (each process owns its local shard —
+        the reference's one-Context-per-worker model, kvstore_dist.h:50)."""
         import jax
+        local = jax.local_devices()
         if self.device_type == "cpu" or self.device_typeid in (3, 5):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = [d for d in local if d.platform == "cpu"] or local
             return devs[min(self.device_id, len(devs) - 1)]
         # accelerator ('tpu' or legacy 'gpu' alias)
-        accel = _accel_devices()
-        if not accel:  # no accelerator present (test / CI): fall back to default
-            devs = jax.devices()
-            return devs[min(self.device_id, len(devs) - 1)]
+        accel = [d for d in local if d.platform != "cpu"]
+        if not accel:  # no accelerator present (test / CI): fall back
+            return local[min(self.device_id, len(local) - 1)]
         return accel[min(self.device_id, len(accel) - 1)]
-
-
-def _has_platform(name):
-    import jax
-    try:
-        return bool(jax.devices(name))
-    except RuntimeError:
-        return False
-
-
-def _accel_devices():
-    import jax
-    devs = jax.devices()
-    return [d for d in devs if d.platform != "cpu"]
 
 
 Context._default_ctx.value = Context("cpu", 0)

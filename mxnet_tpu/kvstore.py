@@ -217,12 +217,18 @@ class KVStoreDist(KVStoreTPUSync):
         import jax
         coord = os.environ.get("MX_KV_ROOT_URI", os.environ.get("DMLC_PS_ROOT_URI"))
         port = os.environ.get("MX_KV_ROOT_PORT", os.environ.get("DMLC_PS_ROOT_PORT", "9876"))
-        if coord is not None:
-            jax.distributed.initialize(
-                coordinator_address="%s:%s" % (coord, port),
-                num_processes=self._num_workers,
-                process_id=self._rank)
-            self._initialized_dist = True
+        if coord is None:
+            # silently skipping would leave every worker training a
+            # diverging model with no cross-host reduce
+            raise MXNetError(
+                "dist kvstore with %d workers but no coordinator address: "
+                "set MX_KV_ROOT_URI (or DMLC_PS_ROOT_URI), e.g. via "
+                "tools/launch.py" % self._num_workers)
+        jax.distributed.initialize(
+            coordinator_address="%s:%s" % (coord, port),
+            num_processes=self._num_workers,
+            process_id=self._rank)
+        self._initialized_dist = True
 
     @property
     def rank(self):
@@ -232,15 +238,40 @@ class KVStoreDist(KVStoreTPUSync):
     def num_workers(self):
         return self._num_workers
 
+    def _global_mesh(self):
+        """1-D 'host' mesh with one device per worker process."""
+        if getattr(self, "_mesh", None) is None:
+            import numpy as np
+            import jax
+            from jax.sharding import Mesh
+            devs = np.array(jax.devices())
+            devs = devs.reshape(self._num_workers, -1)[:, :1].reshape(-1)
+            self._mesh = Mesh(devs, ("host",))
+        return self._mesh
+
     def _allreduce_across_hosts(self, merged):
-        if self._num_workers <= 1:
+        """In-graph cross-host reduce: one jitted sum over the 'host'-sharded
+        axis — XLA lowers it to an allreduce over ICI/DCN (the TPU answer to
+        the reference's worker→server ZPush aggregation,
+        kvstore_dist_server.h:346-358).  No host-side gather: O(1) memory per
+        worker and the collective runs on the interconnect."""
+        if self._num_workers <= 1 or not self._initialized_dist:
             return merged
         import jax
-        import numpy as _np_
+        from jax.sharding import NamedSharding, PartitionSpec as P
         from jax.experimental import multihost_utils
-        v = multihost_utils.process_allgather(merged._data)
+        mesh = self._global_mesh()
+        if getattr(self, "_jit_cross_reduce", None) is None:
+            self._jit_cross_reduce = jax.jit(
+                lambda a: a.sum(axis=0),
+                out_shardings=NamedSharding(mesh, P()))
+        g = multihost_utils.host_local_array_to_global_array(
+            merged._data[None], mesh, P("host"))
+        out = self._jit_cross_reduce(g)
+        local = multihost_utils.global_array_to_host_local_array(
+            out, mesh, P())
         from .ndarray import _wrap
-        return _wrap(v.sum(axis=0), ctx=merged.context)
+        return _wrap(local, ctx=merged.context)
 
     def push(self, key, value, priority=0):
         keys, _ = _key_list(key)

@@ -22,23 +22,30 @@ def shard_batch(mesh, batch):
 
 
 def make_data_parallel_train_step(loss_fn, optimizer_update, mesh,
-                                  donate_params=True):
+                                  donate_params=True, param_shardings=None,
+                                  opt_state_shardings=None):
     """Build a pjit'ed step: (params, opt_state, batch) -> (params, opt_state, loss).
 
     loss_fn(params, batch) -> scalar loss (jax-traceable).
     optimizer_update(grads, opt_state, params) -> (new_params, new_opt_state).
 
-    Parameters are replicated; the batch is dp-sharded; XLA inserts one
-    gradient psum per parameter (fused into large allreduce buckets on ICI).
+    By default parameters are replicated, the batch is dp-sharded, and XLA
+    inserts one gradient psum per parameter (fused into large allreduce
+    buckets on ICI).  ``param_shardings`` overrides the replicated default
+    per-parameter (a pytree prefix of NamedShardings matching ``params``) —
+    this is how tensor-parallel weight sharding composes with the dp axis:
+    tp-sharded params get tp-sharded grads and updates with no resharding.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     repl = NamedSharding(mesh, P())
+    p_shard = param_shardings if param_shardings is not None else repl
+    s_shard = opt_state_shardings if opt_state_shardings is not None else repl
 
     @functools.partial(jax.jit,
-                       in_shardings=(repl, repl, None),
-                       out_shardings=(repl, repl, repl),
+                       in_shardings=(p_shard, s_shard, None),
+                       out_shardings=(p_shard, s_shard, repl),
                        donate_argnums=(0, 1) if donate_params else ())
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

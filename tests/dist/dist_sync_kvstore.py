@@ -1,0 +1,63 @@
+"""N-process synchronous kvstore test (reference
+tests/nightly/dist_sync_kvstore.py:25-38, launched as N local processes via
+tools/launch.py — ci/docker/runtime_functions.sh:911-941).
+
+Run:  python tools/launch.py -n 4 --launcher local \
+          python tests/dist/dist_sync_kvstore.py
+
+Every worker pushes a rank-dependent value for each key; after the in-graph
+cross-host reduce each worker must pull the bitwise-identical global sum.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SHAPE = (3, 4)
+BIG_SHAPE = (50, 10)  # > one "server shard" in the reference's key-split test
+
+
+def check_diff(nd_arr, expected):
+    np.testing.assert_allclose(nd_arr.asnumpy(), expected, rtol=0, atol=0)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker > 1, "run through tools/launch.py -n <N>"
+
+    kv.init("3", mx.nd.ones(SHAPE))
+    kv.init("99", mx.nd.ones(BIG_SHAPE))
+    kv.barrier()
+
+    # repeated sync push/pull: result must equal the exact global sum
+    for it in range(3):
+        kv.push("3", mx.nd.ones(SHAPE) * (rank + 1))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull("3", out=out)
+        check_diff(out, float(sum(range(1, nworker + 1))))
+
+        kv.push("99", mx.nd.ones(BIG_SHAPE) * 2 * (rank + 1))
+        out = mx.nd.zeros(BIG_SHAPE)
+        kv.pull("99", out=out)
+        check_diff(out, float(2 * sum(range(1, nworker + 1))))
+
+    # all ranks see the same store state after a barrier
+    kv.barrier()
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("3", out=out)
+    check_diff(out, float(sum(range(1, nworker + 1))))
+
+    print("dist_sync_kvstore rank %d/%d: OK" % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Multi-process distributed kvstore tests.
+
+Launches N real worker processes on localhost through tools/launch.py (the
+reference's dmlc-tracker 'local' mode, used by
+tests/nightly/dist_sync_kvstore.py + ci/docker/runtime_functions.sh:911-941)
+and checks they complete with the expected reduced values."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(n, script, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # each worker is its own process with its own (single) cpu device;
+    # the conftest's 8-device XLA flag must not leak in
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+           "-n", str(n), "--launcher", "local",
+           "--env-server-port", str(_free_port()),
+           sys.executable, os.path.join(REPO, script)]
+    return subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def test_dist_sync_kvstore_4_workers():
+    res = _launch(4, "tests/dist/dist_sync_kvstore.py")
+    assert res.returncode == 0, \
+        "launcher failed\nstdout:\n%s\nstderr:\n%s" % (res.stdout, res.stderr)
+    for rank in range(4):
+        assert "dist_sync_kvstore rank %d/4: OK" % rank in res.stdout

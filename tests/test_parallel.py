@@ -1,0 +1,231 @@
+"""Tests for mxnet_tpu.parallel — the distribution layer that replaces the
+reference's kvstore comm hierarchy (src/kvstore/comm.h) + ps-lite + NCCL
+(SURVEY §2.5, §5).  Runs on the 8-device virtual CPU mesh from conftest."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (
+    make_mesh, MeshConfig, data_parallel_spec, replicated_spec,
+    allreduce, allgather, reduce_scatter, ppermute_ring,
+    make_data_parallel_train_step, shard_batch,
+    ring_attention, sequence_parallel_attention)
+
+
+def _ndev():
+    return len(jax.devices())
+
+
+# ---------------------------------------------------------------- mesh
+
+def test_make_mesh_default_dp():
+    mesh = make_mesh()
+    assert mesh.axis_names == ("dp",)
+    assert mesh.devices.size == _ndev()
+
+
+def test_make_mesh_config_2d():
+    n = _ndev()
+    assert n >= 8, "conftest should provide 8 virtual devices"
+    mesh = make_mesh(MeshConfig(dp=n // 2, tp=2))
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] == n // 2
+
+
+def test_data_parallel_spec_places_batch_axis():
+    mesh = make_mesh()
+    sharding = data_parallel_spec(mesh)
+    assert sharding.spec == P("dp")
+    assert replicated_spec(mesh).spec == P()
+
+
+# ---------------------------------------------------------- collectives
+
+def _shmap(mesh, fn, in_spec, out_spec, *args):
+    from jax.experimental.shard_map import shard_map
+    import functools
+    wrapped = functools.partial(
+        shard_map, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+        check_rep=False)(fn)
+    return wrapped(*args)
+
+
+def test_allreduce_matches_sum_over_shards():
+    n = _ndev()
+    mesh = make_mesh()
+    x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    out = _shmap(mesh, lambda s: allreduce(s, "dp"), P("dp"), P("dp"), x)
+    expected = np.tile(x.sum(axis=0), (n, 1))
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_allgather_reconstructs_global():
+    n = _ndev()
+    mesh = make_mesh()
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    out = _shmap(mesh, lambda s: allgather(s, "dp", axis=0), P("dp"),
+                 P("dp"), x)
+    # each shard gathers the full array -> global result is n copies
+    assert out.shape == (n * n, 2)
+    np.testing.assert_allclose(np.asarray(out)[:n], x)
+
+
+def test_reduce_scatter_is_sum_shard():
+    n = _ndev()
+    mesh = make_mesh()
+    # each rank holds a full row of length n; psum_scatter leaves rank i with
+    # element i of the sum
+    x = np.ones((n, n), dtype=np.float32) * np.arange(n)[:, None]
+    out = _shmap(mesh, lambda s: reduce_scatter(s[0], "dp")[None],
+                 P("dp"), P("dp"), x)
+    total = x.sum(axis=0)  # == arange-sum per column? rows identical: sum rows
+    np.testing.assert_allclose(np.asarray(out).ravel(), total)
+
+
+def test_ppermute_ring_rotates():
+    n = _ndev()
+    mesh = make_mesh()
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    out = _shmap(mesh, lambda s: ppermute_ring(s, "dp", shift=1),
+                 P("dp"), P("dp"), x)
+    # rank r receives the value of rank r-1
+    np.testing.assert_allclose(np.asarray(out).ravel(),
+                               np.roll(np.arange(n), 1))
+
+
+# ------------------------------------------------------- data parallel
+
+def test_shard_batch_shards_leading_axis():
+    mesh = make_mesh()
+    n = _ndev()
+    batch = (np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+             np.arange(n, dtype=np.int32))
+    x, y = shard_batch(mesh, batch)
+    assert isinstance(x.sharding, NamedSharding)
+    assert x.sharding.spec == P("dp", None)
+    np.testing.assert_allclose(np.asarray(x), batch[0])
+
+
+def test_data_parallel_step_matches_single_device():
+    """The compiled dp step must produce the same params as the plain
+    single-device step on the same global batch (the reference's multi-GPU
+    consistency property, tests/nightly/multi_lenet.py)."""
+    n = _ndev()
+    mesh = make_mesh()
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.normal(0, 0.1, (6, 4)).astype(np.float32)),
+              "b": jnp.zeros((4,), jnp.float32)}
+    batch_np = (rng.normal(0, 1, (n * 2, 6)).astype(np.float32),
+                rng.normal(0, 1, (n * 2, 4)).astype(np.float32))
+
+    def loss_fn(p, batch):
+        x, y = batch
+        pred = x @ p["w"] + p["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    def sgd(grads, state, p):
+        return ({k: p[k] - 0.1 * grads[k] for k in p}, state)
+
+    step = make_data_parallel_train_step(loss_fn, sgd, mesh,
+                                         donate_params=False)
+    with mesh:
+        new_p, _, loss = step(params, {}, shard_batch(mesh, batch_np))
+
+    # single-device reference
+    g = jax.grad(loss_fn)(params, batch_np)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_p[k]),
+                                   np.asarray(params[k] - 0.1 * g[k]),
+                                   rtol=1e-5, atol=1e-6)
+    assert np.isfinite(float(loss))
+
+
+def test_data_parallel_step_with_tp_shardings():
+    """param_shardings keeps a tp-sharded weight sharded through the step."""
+    n = _ndev()
+    mesh = make_mesh(MeshConfig(dp=n // 2, tp=2))
+    rng = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rng.normal(0, 0.1, (6, 4)).astype(np.float32))}
+    shardings = {"w": NamedSharding(mesh, P(None, "tp"))}
+    params = {"w": jax.device_put(params["w"], shardings["w"])}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    def sgd(grads, state, p):
+        return ({k: p[k] - 0.1 * grads[k] for k in p}, state)
+
+    step = make_data_parallel_train_step(loss_fn, sgd, mesh,
+                                         donate_params=False,
+                                         param_shardings=shardings)
+    batch = shard_batch(mesh, (
+        rng.normal(0, 1, (n, 6)).astype(np.float32),
+        rng.normal(0, 1, (n, 4)).astype(np.float32)))
+    with mesh:
+        new_p, _, loss = step(params, {}, batch)
+    assert new_p["w"].sharding.spec == P(None, "tp")
+    assert np.isfinite(float(loss))
+
+
+def test_data_parallel_loss_is_global_mean():
+    """Loss returned equals the loss over the full (global) batch, not a
+    single shard's."""
+    n = _ndev()
+    mesh = make_mesh()
+    params = {"w": jnp.ones((1,), jnp.float32)}
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+
+    def loss_fn(p, batch):
+        return jnp.mean(batch * p["w"])
+
+    def noop(grads, state, p):
+        return p, state
+
+    step = make_data_parallel_train_step(loss_fn, noop, mesh,
+                                         donate_params=False)
+    with mesh:
+        _, _, loss = step(params, {}, shard_batch(mesh, x))
+    np.testing.assert_allclose(float(loss), x.mean(), rtol=1e-6)
+
+
+# ------------------------------------------------------ ring attention
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sequence_parallel_attention_matches_dense(causal):
+    n = _ndev()
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    rng = np.random.RandomState(2)
+    B, H, T, D = 2, 2, 4 * n, 8
+    q, k, v = [jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+               for _ in range(3)]
+    with mesh:
+        out = sequence_parallel_attention(mesh, q, k, v, causal=causal)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ----------------------------------------------------- kvstore tpu_sync
+
+def test_kvstore_tpu_sync_multi_value_push():
+    """tpu_sync push of an N-value list reduces across all of them (the
+    NCCL-kvstore semantics, kvstore_nccl.h:285)."""
+    kv = mx.kv.create("tpu_sync")
+    shape = (4, 3)
+    kv.init("9", mx.nd.zeros(shape))
+    vals = [mx.nd.ones(shape) * (i + 1) for i in range(_ndev())]
+    kv.push("9", vals)
+    out = mx.nd.zeros(shape)
+    kv.pull("9", out=out)
+    expected = sum(range(1, _ndev() + 1))
+    np.testing.assert_allclose(out.asnumpy(), expected)
