@@ -29,8 +29,15 @@ METRIC = ("resnet50_train_imgs_per_sec_bs32" if IS_HEADLINE
 
 
 def _init_backend():
-    """Initialize the JAX backend, reporting what we got."""
+    """Initialize the JAX backend, reporting what we got.
+
+    The env var JAX_PLATFORMS alone does not stop this image's axon site
+    hook from initializing the TPU plugin — only the config update does, so
+    honor an explicit platform request through the config."""
     import jax
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     devs = jax.devices()
     print("backend: %s x%d" % (devs[0].platform, len(devs)), file=sys.stderr)
     return devs

@@ -37,6 +37,8 @@ kv = kvs            # mx.kv.create(...) (reference python/mxnet/__init__.py)
 
 from . import symbol
 from . import symbol as sym
+from . import operator
+operator._install()
 from . import module
 from . import module as mod
 from . import gluon

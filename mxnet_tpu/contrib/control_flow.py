@@ -4,11 +4,16 @@ Reference: src/operator/control_flow.cc — ``_foreach``/``_while_loop``/``_cond
 run Symbol subgraphs as stateful ops (:35-63); python front-ends in
 mxnet/ndarray/contrib.py and symbol/contrib.py.
 
-TPU-native: in eager mode these run as Python loops over NDArrays (matching
-the reference's imperative fallback); under CachedOp/hybridize the SAME
-user code traces into ``lax.scan``/``lax.while_loop``/``lax.cond`` because the
-body functions are jax-traceable — giving compiled control flow with gradient
-support (scan differentiates; while_loop forward-only, as in the reference).
+TPU-native: two execution modes, selected by whether the inputs are backed by
+concrete arrays or jax tracers:
+
+  * eager (concrete NDArrays): Python loops, matching the reference's
+    imperative fallback — each step records on the autograd tape;
+  * traced (under CachedOp/hybridize/jit): the SAME user code lowers to
+    ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` — ONE compiled loop node,
+    no unrolling.  ``foreach``/``cond`` differentiate through the traced path
+    (scan has a native VJP); ``while_loop`` is forward-only, as in the
+    reference.
 """
 from __future__ import annotations
 
@@ -16,27 +21,35 @@ from ..ndarray import NDArray, _wrap
 from ..base import MXNetError
 
 
-def _is_tracing():
-    """True when called under jax tracing (hybridized path)."""
-    import jax.core
-    try:
-        return bool(jax.core.trace_state_clean() is False)
-    except Exception:
-        return False
+def _tracer_backed(*vals):
+    """True if any NDArray in vals is backed by a jax tracer (i.e. we are
+    inside a jit/grad/CachedOp trace and must emit lax control flow)."""
+    import jax
+    for v in vals:
+        if isinstance(v, (list, tuple)):
+            if _tracer_backed(*v):
+                return True
+        elif isinstance(v, NDArray) and isinstance(v._data, jax.core.Tracer):
+            return True
+    return False
+
+
+def _as_list(x):
+    return [x] if isinstance(x, NDArray) else list(x)
 
 
 def foreach(body, data, init_states):
     """Run body over the leading axis of data, threading states.
 
-    body(item, states) -> (out, new_states).  Returns (stacked_outs, final_states).
-    Eager: python loop.  Traced: lax.scan (the compiled-RNN path)."""
-    import jax
-    import jax.numpy as jnp
-
+    body(item, states) -> (out, new_states).  Returns (stacked_outs,
+    final_states).  Eager: python loop.  Traced: one ``lax.scan``."""
     single_data = isinstance(data, NDArray)
     single_state = isinstance(init_states, NDArray)
-    datas = [data] if single_data else list(data)
-    states = [init_states] if single_state else list(init_states)
+    datas = _as_list(data)
+    states = _as_list(init_states)
+
+    if _tracer_backed(*datas) or _tracer_backed(*states):
+        return _foreach_scan(body, datas, states, single_data, single_state)
 
     # eager python loop (records on autograd tape per step)
     T = datas[0].shape[0]
@@ -46,27 +59,63 @@ def foreach(body, data, init_states):
         item = items[0] if single_data else items
         st = states[0] if single_state else states
         out, new_states = body(item, st)
-        states = [new_states] if isinstance(new_states, NDArray) else list(new_states)
+        states = _as_list(new_states)
         outs.append(out)
+    from ..ndarray import stack as nd_stack
     if isinstance(outs[0], (list, tuple)):
-        from ..ndarray import stack as nd_stack
         stacked = [nd_stack(*[o[i] for o in outs], axis=0)
                    for i in range(len(outs[0]))]
     else:
-        from ..ndarray import stack as nd_stack
         stacked = nd_stack(*outs, axis=0)
     return stacked, (states[0] if single_state else states)
 
 
+def _foreach_scan(body, datas, states, single_data, single_state):
+    """Traced path: lower the whole loop to one lax.scan node."""
+    from jax import lax
+
+    n_state = len(states)
+    # the body's output structure (bare NDArray vs list) must round-trip
+    # exactly as in the eager path; captured during the scan trace
+    structure = {}
+
+    def scan_body(carry, xs):
+        item_nd = [_wrap(x) for x in xs]
+        st_nd = [_wrap(c) for c in carry]
+        item = item_nd[0] if single_data else item_nd
+        st = st_nd[0] if single_state else st_nd
+        out, new_states = body(item, st)
+        structure["single_out"] = isinstance(out, NDArray)
+        new_l = _as_list(new_states)
+        out_l = _as_list(out)
+        assert len(new_l) == n_state, \
+            "foreach body changed the number of states"
+        return (tuple(s._data for s in new_l),
+                tuple(o._data for o in out_l))
+
+    carry, ys = lax.scan(scan_body,
+                         tuple(s._data for s in states),
+                         tuple(d._data for d in datas))
+    final = [_wrap(c) for c in carry]
+    outs = [_wrap(y) for y in ys]
+    stacked = outs[0] if structure["single_out"] else outs
+    return stacked, (final[0] if single_state else final)
+
+
 def while_loop(cond, func, loop_vars, max_iterations=None):
-    """Reference _while_loop semantics: iterate func while cond; outputs are
-    stacked per step up to max_iterations (padded)."""
-    import numpy as _np
+    """Reference _while_loop semantics: iterate func while cond holds, up to
+    max_iterations; per-step outputs are stacked into a max_iterations-long
+    buffer (zero-padded past the final step — XLA needs static shapes, and
+    the reference pads identically).  Traced: one ``lax.while_loop``."""
     if max_iterations is None:
         raise MXNetError("max_iterations is required")
+    vars_ = list(loop_vars) if isinstance(loop_vars, (list, tuple)) else [loop_vars]
+
+    if _tracer_backed(*vars_):
+        return _while_loop_traced(cond, func, vars_, max_iterations)
+
     steps = 0
     outputs = []
-    vars_ = list(loop_vars) if isinstance(loop_vars, (list, tuple)) else [loop_vars]
     while steps < max_iterations and bool(cond(*vars_).asscalar()):
         out, new_vars = func(*vars_)
         outputs.append(out if isinstance(out, (list, tuple)) else [out])
@@ -77,20 +126,85 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         n_out = len(outputs[0])
         stacked = []
         for i in range(n_out):
-            s = nd_stack(*[o[i] for o in outputs], axis=0)
+            cols = [o[i] for o in outputs]
             if steps < max_iterations:
-                pad_shape = (max_iterations - steps,) + s.shape[1:]
-                s = nd_stack(*([o[i] for o in outputs] +
-                               [nd_zeros(s.shape[1:]) for _ in
-                                range(max_iterations - steps)]), axis=0)
-            stacked.append(s)
+                cols = cols + [nd_zeros(cols[0].shape)
+                               for _ in range(max_iterations - steps)]
+            stacked.append(nd_stack(*cols, axis=0))
     else:
         stacked = []
     return stacked, vars_
 
 
+def _while_loop_traced(cond, func, vars_, max_iterations):
+    """Traced path: lax.while_loop with pre-allocated output buffers.
+
+    The first step runs once outside the loop to learn the output shapes
+    (XLA requires static buffers); forward-only, like the reference."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    # probe output structure via abstract evaluation of one step
+    import jax
+
+    def _probe(*vs):
+        out, _ = func(*[_wrap(v) for v in vs])
+        return tuple(o._data for o in _as_list(out))
+
+    probe_l = jax.eval_shape(_probe, *[v._data for v in vars_])
+
+    bufs = tuple(jnp.zeros((max_iterations,) + tuple(p.shape),
+                           dtype=p.dtype)
+                 for p in probe_l)
+
+    def loop_cond(carry):
+        step, vs, _ = carry
+        keep = cond(*[_wrap(v) for v in vs])._data
+        return jnp.logical_and(step < max_iterations,
+                               keep.astype(bool).reshape(()))
+
+    def loop_body(carry):
+        step, vs, out_bufs = carry
+        out, new_vs = func(*[_wrap(v) for v in vs])
+        out_l = _as_list(out)
+        new_vs_l = _as_list(new_vs)
+        new_bufs = tuple(
+            lax.dynamic_update_index_in_dim(b, o._data.astype(b.dtype),
+                                            step, axis=0)
+            for b, o in zip(out_bufs, out_l))
+        return (step + 1, tuple(v._data for v in new_vs_l), new_bufs)
+
+    step0 = jnp.array(0, jnp.int32)
+    _, final_vs, out_bufs = lax.while_loop(
+        loop_cond, loop_body,
+        (step0, tuple(v._data for v in vars_), bufs))
+    stacked = [_wrap(b) for b in out_bufs]
+    return stacked, [_wrap(v) for v in final_vs]
+
+
 def cond(pred, then_func, else_func):
-    """Reference _cond: eager dispatch on the predicate value."""
-    if bool(pred.asscalar()):
-        return then_func()
-    return else_func()
+    """Reference _cond.  Eager: dispatch on the concrete predicate.
+    Traced: one ``lax.cond`` node (both branches compiled, XLA selects)."""
+    if not _tracer_backed(pred):
+        if bool(pred.asscalar()):
+            return then_func()
+        return else_func()
+
+    import jax
+    from jax import lax
+
+    structure = {}
+
+    def _then(_):
+        out = then_func()
+        structure["single_out"] = isinstance(out, NDArray)
+        return tuple(o._data for o in _as_list(out))
+
+    def _else(_):
+        out = else_func()
+        return tuple(o._data for o in _as_list(out))
+
+    outs = lax.cond(pred._data.astype(bool).reshape(()), _then, _else,
+                    operand=None)
+    wrapped = [_wrap(o) for o in outs]
+    return wrapped[0] if structure["single_out"] else wrapped

@@ -1,0 +1,59 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Reference: ``src/kvstore/gradient_compression.cc:44-113`` (quantize_2bit
+kernel in ``gradient_compression-inl.h``): per element,
+``residual += grad``; emit +threshold if ``residual >= threshold`` (subtract
+it from the residual), -threshold if ``residual <= -threshold`` (add it),
+else 0 — the residual carries the quantization error into the next step.
+
+TPU-native: the quantizer is one jitted elementwise kernel producing int8
+codes in {-1, 0, +1} (2 useful bits — the reference packs 16 values/float,
+we ship one int8 code/value over the collective, a 4x wire saving vs fp32).
+The cross-host reduce sums CODES (cast to int32 in-graph to avoid overflow)
+and multiplies by the threshold afterwards, matching the reference's
+server-side sum of dequantized workers' values."""
+from __future__ import annotations
+
+import functools
+
+
+class TwoBitCompression:
+    """Stateless quantizer; callers keep the per-key residual."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        if self.threshold <= 0:
+            raise ValueError("threshold must be greater than 0")
+        self._jit_quantize = None
+
+    def quantize(self, grad, residual):
+        """(grad, residual) -> (int8 codes, new residual).  jax arrays."""
+        import jax
+        import jax.numpy as jnp
+        if self._jit_quantize is None:
+            t = self.threshold
+
+            def q(g, r):
+                acc = r + g
+                codes = jnp.where(acc >= t, jnp.int8(1),
+                                  jnp.where(acc <= -t, jnp.int8(-1),
+                                            jnp.int8(0)))
+                new_r = acc - codes.astype(acc.dtype) * t
+                return codes, new_r
+
+            self._jit_quantize = jax.jit(q)
+        return self._jit_quantize(grad, residual)
+
+    def dequantize(self, codes, dtype=None):
+        """codes (possibly summed over workers) -> float gradient."""
+        import jax.numpy as jnp
+        return codes.astype(dtype or jnp.float32) * self.threshold
+
+
+def create(compression_params):
+    """Factory from the kvstore set_gradient_compression params dict."""
+    params = dict(compression_params)
+    ctype = params.pop("type", "2bit")
+    if ctype != "2bit":
+        raise ValueError("unknown gradient compression type %r" % ctype)
+    return TwoBitCompression(threshold=float(params.pop("threshold", 0.5)))

@@ -63,21 +63,47 @@ def _ctx_of(value, ctx=None):
 class NDArray:
     """Mutable multi-dimensional array handle on a device context."""
 
-    __slots__ = ("_data", "_ctx", "grad", "_ag_entry", "_ag_is_leaf",
-                 "_ag_grad_req", "_base", "_base_index", "_stype",
-                 "__weakref__")
+    __slots__ = ("_data_buf", "_version", "_base_version", "_ctx", "grad",
+                 "_ag_entry", "_ag_is_leaf", "_ag_grad_req", "_base",
+                 "_base_index", "_stype", "__weakref__")
 
     # numpy should defer to our reflected operators
     __array_priority__ = 100.0
 
+    # _data is a property so that basic-index views observe later mutation
+    # of their base (the reference NDArray's bidirectional aliasing through
+    # the shared Chunk, include/mxnet/ndarray.h:98): reads re-slice from the
+    # base whenever the base's version counter moved — the same version-
+    # counted Var discipline as the reference engine (engine.h:45-62).
+    @property
+    def _data(self):
+        b = self._base
+        if b is not None:
+            # touch the base's property FIRST: a stale chain refreshes
+            # root-down, bumping each version, before we compare ours
+            base_data = b._data
+            if b._version != self._base_version:
+                # assign through the setter so our own version bumps and
+                # views-of-this-view refresh transitively
+                self._data = base_data[self._base_index]
+                self._base_version = b._version
+        return self._data_buf
+
+    @_data.setter
+    def _data(self, value):
+        self._data_buf = value
+        self._version = getattr(self, "_version", 0) + 1
+
     def __init__(self, data, ctx=None):
+        self._version = 0
+        self._base = None           # view write-back target
+        self._base_version = 0
         self._data = data
         self._ctx = _ctx_of(None, ctx)
         self.grad = None
         self._ag_entry = None
         self._ag_is_leaf = False
         self._ag_grad_req = "null"
-        self._base = None           # view write-back target
         self._base_index = None
         self._stype = "default"
 
@@ -193,10 +219,7 @@ class NDArray:
         if self._base is not None:
             b = self._base
             b._set_data(b._data.at[self._base_index].set(value.astype(b._data.dtype)))
-
-    def _refresh_from_base(self):
-        if self._base is not None:
-            self._data = self._base._data[self._base_index]
+            self._base_version = b._version  # our buffer already matches
 
     # ------------------------------------------------------------------
     # autograd
@@ -235,6 +258,7 @@ class NDArray:
                 and not isinstance(key, (list, _np.ndarray)):
             out._base = self
             out._base_index = key_c
+            out._base_version = self._version
         if autograd.is_recording():
             autograd.record_op(lambda v: v[key_c], [self], [out], name="slice")
         return out
@@ -444,8 +468,16 @@ class NDArray:
 # dispatch
 # ---------------------------------------------------------------------------
 
+import weakref as _weakref
+
+# live-array registry for waitall's WaitForAll semantics
+_LIVE_ARRAYS = _weakref.WeakSet()
+
+
 def _wrap(jax_value, ctx=None):
-    return NDArray(jax_value, ctx=ctx)
+    arr = NDArray(jax_value, ctx=ctx)
+    _LIVE_ARRAYS.add(arr)
+    return arr
 
 
 def from_jax(jax_value, ctx=None):
@@ -489,9 +521,21 @@ def invoke(op_name, inputs, attrs, out=None):
 
 
 def waitall():
-    """Block until all pending computation completes (Engine::WaitForAll)."""
+    """Block until all pending computation completes (Engine::WaitForAll).
+
+    XLA dispatch is async; fencing means blocking on every live array's
+    pending computation.  We track live NDArrays weakly and
+    block_until_ready each — plus an effects barrier for callbacks."""
     import jax
-    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    if hasattr(jax, "effects_barrier"):
+        jax.effects_barrier()
+    for arr in list(_LIVE_ARRAYS):
+        data = getattr(arr, "_data_buf", None)
+        if data is not None and hasattr(data, "block_until_ready"):
+            try:
+                data.block_until_ready()
+            except Exception:
+                pass  # deleted buffers (donated args) are already settled
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,222 @@
+"""Reference-compatible binary NDArray serialization.
+
+Implements the exact on-disk format of the reference's
+``src/ndarray/ndarray.cc`` Save/Load (V2 magic 0xF993fac9, V1 0xF993fac8,
+plus the pre-V1 legacy layout) and the list container written by
+``NDArray::Save(fo, data, names)`` (kMXAPINDArrayListMagic 0x112) — so
+checkpoints written by the reference (``prefix-0000.params``) load here
+unchanged, and files we write load in the reference.
+
+Layout (little-endian):
+  file   := uint64 0x112 | uint64 0 | vec<array> | vec<string>
+  vec<T> := uint64 count | T*count
+  string := uint64 len | bytes
+  array  := uint32 V2_MAGIC | int32 stype
+          | [storage_shape  (sparse only)]
+          | shape | int32 dev_type | int32 dev_id | int32 type_flag
+          | [int32 aux_type | aux_shape, per aux field]
+          | raw data bytes | [raw aux bytes]
+  shape  := uint32 ndim | int64*ndim          (V2/V1; legacy: uint32 dims)
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+NDARRAY_V1_MAGIC = 0xF993FAC8
+NDARRAY_V2_MAGIC = 0xF993FAC9
+LIST_MAGIC = 0x112
+
+# mshadow type flags (mshadow/base.h)
+_TYPE_FLAG_TO_DTYPE = {
+    0: _np.float32, 1: _np.float64, 2: _np.float16,
+    3: _np.uint8, 4: _np.int32, 5: _np.int8, 6: _np.int64,
+}
+_DTYPE_TO_TYPE_FLAG = {_np.dtype(v): k for k, v in _TYPE_FLAG_TO_DTYPE.items()}
+# bfloat16 has no reference type code: checkpoint as float32
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+
+
+def _write_shape(out, shape):
+    out.append(struct.pack("<I", len(shape)))
+    out.append(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _read(buf, off, fmt):
+    vals = struct.unpack_from("<" + fmt, buf, off)
+    return vals, off + struct.calcsize("<" + fmt)
+
+
+def _read_shape(buf, off, int64=True):
+    (ndim,), off = _read(buf, off, "I")
+    if ndim == 0:
+        return (), off
+    fmt = "%dq" % ndim if int64 else "%dI" % ndim
+    dims, off = _read(buf, off, fmt)
+    return tuple(int(d) for d in dims), off
+
+
+def _np_of(arr):
+    """numpy view of an NDArray (handles jax backing)."""
+    return _np.asarray(arr.asnumpy())
+
+
+def _type_flag(np_dtype):
+    dt = _np.dtype(np_dtype)
+    if dt.name == "bfloat16":
+        return 0  # stored as float32
+    flag = _DTYPE_TO_TYPE_FLAG.get(dt)
+    if flag is None:
+        raise ValueError("dtype %s has no reference serialization code" % dt)
+    return flag
+
+
+def serialize_ndarray(arr):
+    """One NDArray -> bytes in the reference V2 layout."""
+    out = []
+    stype = getattr(arr, "stype", "default")
+    if stype == "default":
+        if len(arr.shape) == 0:
+            # the reference TShape cannot express 0-d: ndim==0 on the wire
+            # means "empty array" and carries no data (ndarray.cc Save)
+            raise ValueError("0-d arrays cannot be serialized in the "
+                             "reference format; reshape to (1,) first")
+        data = _np_of(arr)
+        if data.dtype.name == "bfloat16":
+            data = data.astype(_np.float32)
+        out.append(struct.pack("<I", NDARRAY_V2_MAGIC))
+        out.append(struct.pack("<i", _STYPE_DEFAULT))
+        _write_shape(out, data.shape)
+        out.append(struct.pack("<ii", 1, 0))  # Context: cpu, id 0
+        out.append(struct.pack("<i", _type_flag(data.dtype)))
+        out.append(_np.ascontiguousarray(data).tobytes())
+        return b"".join(out)
+
+    if stype == "row_sparse":
+        data = _np_of(arr.data)
+        indices = _np_of(arr.indices).astype(_np.int64)
+        aux = [indices]
+    elif stype == "csr":
+        data = _np_of(arr.data)
+        indptr = _np_of(arr.indptr).astype(_np.int64)
+        indices = _np_of(arr.indices).astype(_np.int64)
+        aux = [indptr, indices]  # kIndPtr=0, kIdx=1
+    else:
+        raise ValueError("cannot serialize storage type %r" % stype)
+    out.append(struct.pack("<I", NDARRAY_V2_MAGIC))
+    out.append(struct.pack("<i", _STYPE_ROW_SPARSE if stype == "row_sparse"
+                           else _STYPE_CSR))
+    _write_shape(out, data.shape)          # storage shape
+    _write_shape(out, arr.shape)           # logical shape
+    out.append(struct.pack("<ii", 1, 0))
+    out.append(struct.pack("<i", _type_flag(data.dtype)))
+    for a in aux:
+        out.append(struct.pack("<i", _type_flag(a.dtype)))
+        _write_shape(out, a.shape)
+    out.append(_np.ascontiguousarray(data).tobytes())
+    for a in aux:
+        out.append(_np.ascontiguousarray(a).tobytes())
+    return b"".join(out)
+
+
+def deserialize_ndarray(buf, off):
+    """bytes -> (NDArray, new offset).  Accepts V2, V1, and legacy layouts."""
+    from . import array as nd_array
+    from . import sparse as nd_sparse
+
+    (magic,), off = _read(buf, off, "I")
+    stype = _STYPE_DEFAULT
+    storage_shape = None
+    if magic == NDARRAY_V2_MAGIC:
+        (stype,), off = _read(buf, off, "i")
+        nad = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}[stype]
+        if nad > 0:
+            storage_shape, off = _read_shape(buf, off)
+        shape, off = _read_shape(buf, off)
+    elif magic == NDARRAY_V1_MAGIC:
+        nad = 0
+        shape, off = _read_shape(buf, off)
+    else:
+        # legacy: magic is ndim, dims are uint32
+        nad = 0
+        ndim = magic
+        dims, off = _read(buf, off, "%dI" % ndim) if ndim else ((), off)
+        shape = tuple(int(d) for d in dims)
+    if len(shape) == 0:
+        return nd_array(_np.zeros((), _np.float32)), off
+
+    (_dev_type, _dev_id), off = _read(buf, off, "ii")
+    (type_flag,), off = _read(buf, off, "i")
+    dtype = _TYPE_FLAG_TO_DTYPE[type_flag]
+
+    aux_types, aux_shapes = [], []
+    for _ in range(nad):
+        (aflag,), off = _read(buf, off, "i")
+        ashape, off = _read_shape(buf, off)
+        aux_types.append(_TYPE_FLAG_TO_DTYPE[aflag])
+        aux_shapes.append(ashape)
+
+    data_shape = storage_shape if storage_shape is not None else shape
+    count = int(_np.prod(data_shape)) if data_shape else 1
+    itemsize = _np.dtype(dtype).itemsize
+    data = _np.frombuffer(buf, dtype=dtype, count=count, offset=off)
+    data = data.reshape(data_shape).copy()
+    off += count * itemsize
+
+    aux_data = []
+    for at, ash in zip(aux_types, aux_shapes):
+        cnt = int(_np.prod(ash)) if ash else 1
+        a = _np.frombuffer(buf, dtype=at, count=cnt, offset=off)
+        aux_data.append(a.reshape(ash).copy())
+        off += cnt * _np.dtype(at).itemsize
+
+    if stype == _STYPE_DEFAULT:
+        return nd_array(data), off
+    if stype == _STYPE_ROW_SPARSE:
+        return nd_sparse.row_sparse_array((data, aux_data[0]), shape=shape), off
+    return nd_sparse.csr_matrix((data, aux_data[1], aux_data[0]),
+                                shape=shape), off
+
+
+def save_list(fname, arrays, names):
+    """Write the 0x112 list container (NDArray::Save list form)."""
+    out = [struct.pack("<QQ", LIST_MAGIC, 0),
+           struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        out.append(serialize_ndarray(a))
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+def load_list(buf):
+    """Parse the 0x112 list container -> (arrays, names)."""
+    (magic, _reserved), off = _read(buf, 0, "QQ")
+    if magic != LIST_MAGIC:
+        raise ValueError("not a reference NDArray file (bad magic 0x%x)" % magic)
+    (n,), off = _read(buf, off, "Q")
+    arrays = []
+    for _ in range(n):
+        arr, off = deserialize_ndarray(buf, off)
+        arrays.append(arr)
+    (n_names,), off = _read(buf, off, "Q")
+    names = []
+    for _ in range(n_names):
+        (ln,), off = _read(buf, off, "Q")
+        names.append(buf[off:off + ln].decode("utf-8"))
+        off += ln
+    return arrays, names
+
+
+def is_reference_format(buf_or_path):
+    if isinstance(buf_or_path, (bytes, bytearray, memoryview)):
+        head = bytes(buf_or_path[:8])
+    else:
+        with open(buf_or_path, "rb") as f:
+            head = f.read(8)
+    return len(head) == 8 and struct.unpack("<Q", head)[0] == LIST_MAGIC

@@ -1,44 +1,58 @@
 """NDArray save/load.
 
 Reference: python/mxnet/ndarray/utils.py:149,222 → src/ndarray/ndarray.cc
-Save/Load (binary dmlc format with magic number, name→array dicts).
+Save/Load (binary dmlc format with magic 0x112, name→array dicts).
 
-TPU-native: a portable ``.npz``-based container with the same surface —
-``save(fname, list-or-dict)`` / ``load(fname)`` round-trips lists and
-name→NDArray dicts.  (Orbax handles sharded checkpoints at the gluon/module
-layer; this is the single-host array container.)
-"""
+Writes the reference's exact binary format (serialization.py) so ``.params``
+files interchange with the reference in both directions; ``load`` sniffs the
+magic and also accepts the ``.npz`` container earlier versions of this
+framework wrote."""
 from __future__ import annotations
 
 import numpy as _np
 
 from .ndarray import NDArray, array
+from . import serialization as _ser
 
 _LIST_PREFIX = "__mx_list__:"
 
 
 def save(fname, data):
+    """Save NDArrays in the reference binary format
+    (src/ndarray/ndarray.cc NDArray::Save list form)."""
     if isinstance(data, NDArray):
         data = [data]
-    payload = {}
     if isinstance(data, dict):
-        for k, v in data.items():
-            if not isinstance(v, NDArray):
-                raise TypeError("save only supports NDArray values")
-            payload[k] = v.asnumpy()
+        names = list(data.keys())
+        arrays = list(data.values())
     elif isinstance(data, (list, tuple)):
-        for i, v in enumerate(data):
-            if not isinstance(v, NDArray):
-                raise TypeError("save only supports NDArray values")
-            payload["%s%d" % (_LIST_PREFIX, i)] = v.asnumpy()
+        names = []
+        arrays = list(data)
     else:
         raise TypeError("data must be NDArray, list of NDArray, or dict of NDArray")
-    with open(fname, "wb") as f:
-        _np.savez(f, **payload)
+    for v in arrays:
+        if not isinstance(v, NDArray):
+            raise TypeError("save only supports NDArray values")
+    _ser.save_list(fname, arrays, names)
 
 
 def load(fname):
-    with _np.load(fname, allow_pickle=False) as npz:
+    """Load ``.params`` written by the reference or by this framework
+    (binary format), or the legacy ``.npz`` container (sniffed)."""
+    with open(fname, "rb") as f:
+        buf = f.read()
+    if _ser.is_reference_format(buf):
+        arrays, names = _ser.load_list(buf)
+        if names:
+            return dict(zip(names, arrays))
+        return arrays
+    # legacy npz container (sniff: zip archives start with 'PK')
+    if buf[:2] != b"PK":
+        raise ValueError(
+            "%s is neither the reference binary NDArray format (magic 0x112) "
+            "nor an npz container" % fname)
+    import io
+    with _np.load(io.BytesIO(buf), allow_pickle=False) as npz:
         keys = list(npz.keys())
         if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
             items = sorted(((int(k[len(_LIST_PREFIX):]), npz[k]) for k in keys))

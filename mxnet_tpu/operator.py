@@ -1,0 +1,312 @@
+"""User-defined operators (``mx.operator.CustomOp`` / ``CustomOpProp``).
+
+Reference: ``python/mxnet/operator.py`` + ``src/operator/custom/custom-inl.h:50-173``
+— user Python forward/backward registered as a first-class op, runnable from
+``nd.Custom`` / ``sym.Custom`` / Gluon, with autograd support.
+
+TPU-native design: two execution paths share the same user protocol.
+
+  * **Eager** (``nd.Custom``): the user's forward/backward run directly on the
+    caller's NDArrays (auxiliary states mutate in place, arbitrary
+    numpy/python allowed).  Under ``autograd.record()`` the tape records a
+    ``jax.custom_vjp`` node whose bwd rule replays the user's ``backward`` —
+    the analog of the reference's dedicated custom-op worker thread.
+  * **Compiled** (``sym.Custom`` inside a jitted executor graph, or any
+    CachedOp trace): the op lowers to ``jax.pure_callback`` (host execution —
+    exactly where the reference runs custom ops) wrapped in the same
+    ``jax.custom_vjp``, with output shapes/dtypes from the prop's
+    ``infer_shape``/``infer_type``.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get"]
+
+
+class CustomOp:
+    """Base class for user operator implementations."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the grad_req (kWriteTo/kAddTo)."""
+        if req in ("null", None):
+            return
+        from .ndarray import NDArray
+        src_data = src._data if isinstance(src, NDArray) else src
+        if req == "add":
+            dst._set_data(dst._data + src_data)
+        else:  # write / inplace
+            dst._set_data(src_data.astype(dst._data.dtype))
+
+
+class CustomOpProp:
+    """Operator properties: names, shapes, types, and the op factory."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs take the first input's shape; no aux."""
+        return (in_shape,
+                [in_shape[0]] * len(self.list_outputs()),
+                [in_shape[0]] * len(self.list_auxiliary_states()))
+
+    def infer_type(self, in_type):
+        return (in_type,
+                [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return (in_stype,
+                ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_CUSTOM_OP_REGISTRY = {}
+
+
+def register(reg_name):
+    """Class decorator: ``@mx.operator.register("sqr")`` on a CustomOpProp
+    subclass (reference operator.py register)."""
+    def do_register(prop_cls):
+        _CUSTOM_OP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get(reg_name):
+    return _CUSTOM_OP_REGISTRY.get(reg_name)
+
+
+def _create_prop(op_type, kwargs):
+    cls = _CUSTOM_OP_REGISTRY.get(op_type)
+    if cls is None:
+        raise MXNetError("custom op type '%s' is not registered "
+                         "(use @mx.operator.register)" % op_type)
+    # the reference passes user kwargs to the prop ctor as strings
+    return cls(**{k: str(v) for k, v in kwargs.items()})
+
+
+def _split_inputs(prop, inputs):
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    if len(inputs) != n_args + n_aux:
+        raise MXNetError(
+            "custom op expects %d args + %d aux, got %d inputs"
+            % (n_args, n_aux, len(inputs)))
+    return list(inputs[:n_args]), list(inputs[n_args:])
+
+
+def _inferred(prop, in_data):
+    in_shapes = [list(x.shape) for x in in_data]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [x.dtype for x in in_data]
+    _, out_types, _ = prop.infer_type(in_types)
+    return in_shapes, in_types, out_shapes, out_types
+
+
+# ---------------------------------------------------------------------------
+# Eager path: nd.Custom
+# ---------------------------------------------------------------------------
+
+def _imperative_custom(*inputs, op_type=None, name=None, out=None, **kwargs):
+    """nd.Custom(*data_and_aux, op_type='name', **op_kwargs)."""
+    from . import autograd
+    from .ndarray import NDArray, zeros as nd_zeros
+    from .context import current_context
+
+    if op_type is None:
+        raise MXNetError("nd.Custom requires op_type=")
+    nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
+    prop = _create_prop(op_type, kwargs)
+    in_data, aux = _split_inputs(prop, nd_inputs)
+    in_shapes, in_types, out_shapes, out_types = _inferred(prop, in_data)
+    op = prop.create_operator(current_context(), in_shapes, in_types)
+
+    out_data = [nd_zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    n_out = len(out_data)
+    is_train = autograd.is_training() or autograd.is_recording()
+    with autograd.pause():
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_data, out_data=out_data, aux=aux)
+
+    if autograd.is_recording():
+        import jax
+
+        def fn(*in_vals):
+            @jax.custom_vjp
+            def f(*vals):
+                outs = tuple(o._data for o in out_data)
+                return outs if n_out > 1 else outs[0]
+
+            def f_fwd(*vals):
+                return f(*vals), None
+
+            def f_bwd(res, gs):
+                gs = gs if isinstance(gs, tuple) else (gs,)
+                from .ndarray import _wrap
+                out_grad = [_wrap(g) for g in gs]
+                in_grad = [nd_zeros(tuple(s), dtype=t)
+                           for s, t in zip(in_shapes, in_types)]
+                with autograd.pause():
+                    op.backward(req=["write"] * len(in_data),
+                                out_grad=out_grad, in_data=in_data,
+                                out_data=out_data, in_grad=in_grad, aux=aux)
+                return tuple(g._data for g in in_grad)
+
+            f.defvjp(f_fwd, f_bwd)
+            return f(*in_vals)
+
+        autograd.record_op(fn, in_data, out_data, name="Custom:%s" % op_type)
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o, r in zip(outs, out_data):
+            o._set_data(r._data)
+            o._ag_entry = getattr(r, "_ag_entry", None)
+        return out
+    return out_data[0] if n_out == 1 else out_data
+
+
+# ---------------------------------------------------------------------------
+# Compiled path: registry op used by sym.Custom / jitted graphs
+# ---------------------------------------------------------------------------
+
+def _custom_fcompute(attrs, *in_vals):
+    """fcompute for the registry 'Custom' op: host-callback execution with a
+    custom VJP, traceable inside any jitted graph."""
+    import jax
+    import jax.numpy as jnp
+
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op node missing op_type attr")
+    kwargs = {k: v for k, v in attrs.items()
+              if k != "op_type" and not k.startswith("_")}
+    prop = _create_prop(op_type, kwargs)
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    n_out = len(prop.list_outputs())
+    args = in_vals[:n_args]
+    aux_vals = in_vals[n_args:n_args + n_aux]
+
+    in_shapes = [list(v.shape) for v in args]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [v.dtype for v in args]
+    _, out_types, _ = prop.infer_type(in_types)
+    out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                      for s, t in zip(out_shapes, out_types))
+    aux_specs = tuple(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                      for v in aux_vals)
+    in_specs = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                     for s, t in zip(in_shapes, in_types))
+    is_train = bool(attrs.get("_training", False))
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    def _to_nd(np_vals):
+        from .ndarray import array as nd_array
+        return [nd_array(_np.asarray(v)) for v in np_vals]
+
+    def host_forward(*vals):
+        from .ndarray import zeros as nd_zeros
+        from . import autograd
+        in_nd = _to_nd(vals[:n_args])
+        aux_nd = _to_nd(vals[n_args:])
+        out_nd = [nd_zeros(tuple(s), dtype=t)
+                  for s, t in zip(out_shapes, out_types)]
+        with autograd.pause():
+            op.forward(is_train=is_train, req=["write"] * n_out,
+                       in_data=in_nd, out_data=out_nd, aux=aux_nd)
+        return tuple([o.asnumpy() for o in out_nd] +
+                     [a.asnumpy() for a in aux_nd])
+
+    def host_backward(*vals):
+        from .ndarray import zeros as nd_zeros
+        from . import autograd
+        i = 0
+        gs = _to_nd(vals[i:i + n_out]); i += n_out
+        in_nd = _to_nd(vals[i:i + n_args]); i += n_args
+        out_nd = _to_nd(vals[i:i + n_out]); i += n_out
+        aux_nd = _to_nd(vals[i:i + n_aux])
+        in_grad = [nd_zeros(tuple(s), dtype=t)
+                   for s, t in zip(in_shapes, in_types)]
+        with autograd.pause():
+            op.backward(req=["write"] * n_args, out_grad=gs, in_data=in_nd,
+                        out_data=out_nd, in_grad=in_grad, aux=aux_nd)
+        return tuple(g.asnumpy() for g in in_grad)
+
+    @jax.custom_vjp
+    def f(*vals):
+        res = jax.pure_callback(host_forward, out_specs + aux_specs, *vals)
+        return tuple(res[:n_out])
+
+    def f_fwd(*vals):
+        res = jax.pure_callback(host_forward, out_specs + aux_specs, *vals)
+        outs = tuple(res[:n_out])
+        aux_after = tuple(res[n_out:])
+        return outs, (vals, outs, aux_after)
+
+    def f_bwd(res, gs):
+        vals, outs, aux_after = res
+        flat = tuple(gs) + tuple(vals[:n_args]) + tuple(outs) + aux_after
+        gin = jax.pure_callback(host_backward, in_specs, *flat)
+        # no cotangents for aux states
+        return tuple(gin) + tuple(jnp.zeros_like(a) for a in aux_vals)
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*in_vals)
+    return outs if n_out > 1 else outs[0]
+
+
+def _install():
+    """Register the 'Custom' op and install nd.Custom / sym.Custom."""
+    from .ops import registry as op_registry
+
+    def _n_outputs(attrs):
+        prop = _create_prop(attrs["op_type"],
+                            {k: v for k, v in attrs.items()
+                             if k != "op_type" and not k.startswith("_")})
+        return len(prop.list_outputs())
+
+    op_registry.register("Custom", num_outputs=_n_outputs,
+                         mode_dependent=True, no_jit=True)(_custom_fcompute)
+
+    from . import ndarray as nd_mod
+    nd_mod.Custom = _imperative_custom
+    try:
+        from . import symbol as sym_mod
+        from .symbol.register import make_sym_func
+        sym_mod.Custom = make_sym_func("Custom")
+    except ImportError:
+        pass

@@ -149,8 +149,10 @@ def _signum_update(attrs, weight, grad, mom):
     momentum = float(attrs.get("momentum", 0.0))
     wd_lh = float(attrs.get("wd_lh", 0.0))
     g = _prep_grad(jnp, grad, rescale, clip)
-    mom_new = momentum * mom - (1 - momentum) * g
-    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new) - lr * wd * weight
+    # wd folds into the momentum (reference SignumKernel,
+    # optimizer_op-inl.h: mom = m*mom - (1-m)*wd*w - (1-m)*g)
+    mom_new = momentum * mom - (1 - momentum) * wd * weight - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
     return w, mom_new
 
 

@@ -118,9 +118,16 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
         scale = 1.0 / _np.sqrt(q.shape[-1])
     use_pallas = _use_pallas() if interpret is None else True
 
+    def _blocks_align(q_, k_):
+        # the kernel's grid floors T/block_q and the inner loop's final
+        # dslice clamps in-bounds: a ragged tail would silently drop query
+        # rows / double-count trailing keys.  Both seq lengths must tile.
+        T, Tk = q_.shape[2], k_.shape[2]
+        return T % min(128, T) == 0 and Tk % min(128, Tk) == 0
+
     @jax.custom_vjp
     def f(q_, k_, v_):
-        if use_pallas and q_.shape[2] % 128 == 0 or interpret:
+        if (use_pallas or interpret) and _blocks_align(q_, k_):
             try:
                 return _flash_attention_pallas(q_, k_, v_, causal, scale,
                                                interpret=bool(interpret))

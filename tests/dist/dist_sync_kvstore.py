@@ -56,6 +56,28 @@ def main():
     kv.pull("3", out=out)
     check_diff(out, float(sum(range(1, nworker + 1))))
 
+    # --- 2-bit gradient compression with error feedback (reference
+    # dist_sync_kvstore.py check_compr_residual) -------------------------
+    threshold = 0.5
+    kv.set_gradient_compression({"type": "2bit", "threshold": threshold})
+    kv.init("c1", mx.nd.zeros(SHAPE))
+    # every worker pushes the same grad; per-worker quantization is
+    # identical, so the reduced result is nworker * quantized(grad)
+    grad_np = np.array([[0.7, -0.9, 0.2, -0.1],
+                        [0.4, 1.3, -2.0, 0.05],
+                        [0.0, 0.6, -0.55, 0.49]], dtype=np.float32)[:SHAPE[0], :SHAPE[1]]
+    residual = np.zeros_like(grad_np)
+    for _ in range(3):
+        acc = residual + grad_np
+        quant = np.where(acc >= threshold, threshold,
+                         np.where(acc <= -threshold, -threshold, 0.0))
+        residual = acc - quant
+        kv.push("c1", mx.nd.array(grad_np))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull("c1", out=out)
+        np.testing.assert_allclose(out.asnumpy(), nworker * quant,
+                                   rtol=0, atol=1e-6)
+
     print("dist_sync_kvstore rank %d/%d: OK" % (rank, nworker), flush=True)
 
 

@@ -1,0 +1,148 @@
+"""Custom operator API tests (reference tests/python/unittest/test_operator.py
+test_custom_op:4848-5030; python/mxnet/operator.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        if aux:
+            aux[0][:] = 1
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+        if aux:
+            assert (aux[0].asnumpy() == 1).all()
+
+
+@mx.operator.register("sqr_t")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super(SqrProp, self).__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return ["aux"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], [in_shape[0]]
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Mult(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[1])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], in_data[1] * out_grad[0])
+        self.assign(in_grad[1], req[1], in_data[0] * out_grad[0])
+
+
+@mx.operator.register("mult_t")
+class MultProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super(MultProp, self).__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Mult()
+
+
+def test_custom_op_eager_forward_backward():
+    x = nd.array(np.random.uniform(-1, 1, size=(4, 10)).astype(np.float32))
+    aux = nd.zeros((4, 10))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, aux, op_type="sqr_t")
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+    # forward mutated the aux state in place
+    np.testing.assert_allclose(aux.asnumpy(), 1.0)
+
+
+def test_custom_op_eager_two_inputs():
+    lhs = nd.array(np.random.uniform(-1, 1, (4, 10)).astype(np.float32))
+    rhs = nd.array(np.random.uniform(-1, 1, (4, 10)).astype(np.float32))
+    lhs.attach_grad()
+    rhs.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(lhs, rhs, op_type="mult_t")
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), lhs.asnumpy() * rhs.asnumpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(lhs.grad.asnumpy(), rhs.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(rhs.grad.asnumpy(), lhs.asnumpy(), rtol=1e-5)
+
+
+def test_custom_op_chained_with_builtin_ops():
+    """Custom grad composes with the tape through surrounding builtin ops."""
+    x = nd.array(np.random.uniform(0.5, 1.5, (3, 5)).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        h = x * 3
+        y = nd.Custom(h, nd.zeros_like(h), op_type="sqr_t")
+        z = y.sum()
+    z.backward()
+    # z = sum((3x)^2) -> dz/dx = 18x
+    np.testing.assert_allclose(x.grad.asnumpy(), 18 * x.asnumpy(), rtol=1e-4)
+
+
+def test_custom_op_symbolic_executor():
+    """sym.Custom runs inside the jitted executor graph (host callback) with
+    working gradients."""
+    data = mx.sym.Variable("data")
+    auxv = mx.sym.Variable("aux")
+    op = mx.sym.Custom(data=data, aux=auxv, name="sqr", op_type="sqr_t")
+    x_np = np.random.uniform(-1, 1, (4, 10)).astype(np.float32)
+
+    exe = op.simple_bind(mx.cpu(), data=(4, 10), aux=(4, 10))
+    exe.arg_dict["data"][:] = x_np
+    out = exe.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), x_np ** 2, rtol=1e-5)
+    exe.backward(nd.ones((4, 10)))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * x_np,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_custom_op_numeric_gradient():
+    """check_numeric_gradient-style finite differences vs the custom vjp."""
+    x_np = np.random.uniform(0.2, 1.0, (3, 4)).astype(np.float32)
+
+    def f(xv):
+        y = nd.Custom(nd.array(xv), nd.zeros((3, 4)), op_type="sqr_t")
+        return float(y.sum().asscalar())
+
+    eps = 1e-3
+    num = np.zeros_like(x_np)
+    for i in range(x_np.shape[0]):
+        for j in range(x_np.shape[1]):
+            xp = x_np.copy(); xp[i, j] += eps
+            xm = x_np.copy(); xm[i, j] -= eps
+            num[i, j] = (f(xp) - f(xm)) / (2 * eps)
+
+    x = nd.array(x_np)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, nd.zeros((3, 4)), op_type="sqr_t")
+        s = y.sum()
+    s.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2, atol=1e-2)

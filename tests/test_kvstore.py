@@ -105,3 +105,61 @@ def test_gradient_compression():
     out = nd.empty(SHAPE)
     kv.pull(3, out=out)
     assert out.shape == SHAPE
+
+
+def expected_2bit_quantization(grad, residual, threshold):
+    """Numpy port of the reference's expected-quantization math
+    (tests/nightly/test_kvstore.py:33-63 compute_expected_2bit_quantization)."""
+    acc = grad + residual
+    quant = np.where(acc >= threshold, threshold,
+                     np.where(acc <= -threshold, -threshold, 0.0))
+    new_residual = acc - quant
+    return quant.astype(np.float32), new_residual.astype(np.float32)
+
+
+def test_two_bit_quantization_math():
+    from mxnet_tpu.gradient_compression import TwoBitCompression
+    rng = np.random.RandomState(0)
+    threshold = 0.5
+    gc = TwoBitCompression(threshold)
+    grad = rng.normal(0, 1, (7, 9)).astype(np.float32)
+    residual = np.zeros_like(grad)
+    for _ in range(4):  # error feedback accumulates across rounds
+        codes, new_res = gc.quantize(grad, residual)
+        deq = gc.dequantize(codes)
+        exp_q, exp_res = expected_2bit_quantization(grad, residual, threshold)
+        assert_almost_equal(np.asarray(deq), exp_q)
+        assert_almost_equal(np.asarray(new_res), exp_res)
+        assert set(np.unique(np.asarray(codes))) <= {-1, 0, 1}
+        residual = np.asarray(new_res)
+
+
+def test_two_bit_residual_preserves_signal():
+    """Small constant gradients eventually push through via the residual."""
+    from mxnet_tpu.gradient_compression import TwoBitCompression
+    gc = TwoBitCompression(0.5)
+    grad = np.full((4,), 0.2, np.float32)
+    residual = np.zeros_like(grad)
+    total = np.zeros_like(grad)
+    for _ in range(10):
+        codes, residual = gc.quantize(grad, residual)
+        total += np.asarray(gc.dequantize(codes))
+        residual = np.asarray(residual)
+    # 10 steps of 0.2 = 2.0 signal; quantized stream must deliver it to
+    # within one threshold
+    np.testing.assert_allclose(total, 2.0, atol=0.5)
+
+
+def test_gradient_compression_dist_single_worker():
+    """dist_sync with 1 worker: compressed push applies quantized (not raw)
+    gradients with error feedback."""
+    kv = mx.kvstore.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    kv.init("g", nd.zeros(SHAPE))
+    kv.push("g", nd.ones(SHAPE))  # 1.0 < threshold -> quantizes to 0
+    out = nd.empty(SHAPE)
+    kv.pull("g", out=out)
+    assert_almost_equal(out.asnumpy(), np.zeros(SHAPE))
+    kv.push("g", nd.ones(SHAPE))  # residual 1+1 = 2 >= threshold -> fires
+    kv.pull("g", out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 2.0))

@@ -156,3 +156,33 @@ def test_wait_to_read():
     b = nd.dot(a, a)
     b.wait_to_read()
     assert b.asnumpy()[0, 0] == 100
+
+
+def test_view_observes_base_mutation():
+    """Basic-index views alias bidirectionally (reference NDArray shares the
+    Chunk): mutating the base must be visible through existing views."""
+    x = nd.arange(12).reshape((3, 4))
+    y = x[0]
+    np.testing.assert_allclose(y.asnumpy(), [0, 1, 2, 3])
+    x[:] = 0
+    np.testing.assert_allclose(y.asnumpy(), [0, 0, 0, 0])
+    # and write-through still works
+    y[:] = 7
+    np.testing.assert_allclose(x.asnumpy()[0], [7, 7, 7, 7])
+    np.testing.assert_allclose(x.asnumpy()[1:], 0)
+
+
+def test_waitall_fences_pending_work():
+    x = nd.ones((64, 64))
+    for _ in range(5):
+        x = nd.dot(x, x) * 1e-3
+    nd.waitall()  # must not raise and must leave x fully materialized
+    assert np.isfinite(x.asnumpy()).all()
+
+
+def test_nested_view_observes_base_mutation():
+    x = nd.arange(12).reshape((3, 4))
+    y = x[0:2]
+    z = y[0]
+    x[:] = 0
+    np.testing.assert_allclose(z.asnumpy(), 0)

@@ -277,3 +277,36 @@ def test_deconvolution_shape():
     w = nd.array(np.random.uniform(-1, 1, (3, 2, 3, 3)))
     out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=2, stride=(2, 2))
     assert out.shape == (1, 2, 9, 9)
+
+
+def test_adagrad_wd_outside_history():
+    """wd must NOT enter the AdaGrad history (reference optimizer.py:
+    history += grad^2; update adds wd*weight outside)."""
+    from mxnet_tpu import optimizer as opt
+    w_np = np.array([1.0, -2.0, 3.0], np.float32)
+    g_np = np.array([0.1, 0.2, -0.3], np.float32)
+    lr, wd, eps = 0.5, 0.1, 1e-7
+    ada = opt.create("adagrad", learning_rate=lr, wd=wd, eps=eps)
+    w = nd.array(w_np)
+    state = ada.create_state(0, w)
+    ada.update(0, w, nd.array(g_np), state)
+    hist = g_np * g_np
+    expect = w_np - lr * (g_np / np.sqrt(hist + eps) + wd * w_np)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(state.asnumpy(), hist, rtol=1e-6)
+
+
+def test_signum_wd_inside_momentum():
+    """wd folds into the Signum momentum (reference SignumKernel)."""
+    from mxnet_tpu import optimizer as opt
+    w_np = np.array([1.0, -2.0, 0.5], np.float32)
+    g_np = np.array([0.3, -0.1, 0.2], np.float32)
+    lr, wd, mom_c = 0.1, 0.05, 0.9
+    sgn = opt.create("signum", learning_rate=lr, momentum=mom_c, wd=wd)
+    w = nd.array(w_np)
+    state = sgn.create_state(0, w)
+    sgn.update(0, w, nd.array(g_np), state)
+    mom = -(1 - mom_c) * wd * w_np - (1 - mom_c) * g_np
+    expect = w_np + lr * np.sign(mom)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(state.asnumpy(), mom, rtol=1e-5)

@@ -1,0 +1,109 @@
+"""Binary NDArray serialization tests (reference src/ndarray/ndarray.cc
+Save/Load; python/mxnet/ndarray/utils.py:149,222; legacy fixture from
+tests/python/unittest/test_ndarray.py test_legacy_ndarray_load:308-314)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_load_reference_legacy_file(tmp_path):
+    """A file produced by the reference C++ (pre-V1 legacy layout) loads."""
+    data = nd.load(os.path.join(HERE, "data", "legacy_ndarray.v0"))
+    assert isinstance(data, list) and len(data) == 6
+    for a in data:
+        np.testing.assert_allclose(a.asnumpy(), np.arange(128, dtype=np.float32))
+
+
+def test_binary_roundtrip_list(tmp_path):
+    fname = str(tmp_path / "arrays.params")
+    arrays = [nd.array(np.random.RandomState(0).normal(0, 1, (3, 4)).astype(np.float32)),
+              nd.array(np.arange(10, dtype=np.int32)),
+              nd.array(np.arange(6, dtype=np.float64).reshape(2, 3))]
+    nd.save(fname, arrays)
+    back = nd.load(fname)
+    assert isinstance(back, list) and len(back) == 3
+    for a, b in zip(arrays, back):
+        assert a.dtype == b.dtype
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_binary_roundtrip_dict(tmp_path):
+    fname = str(tmp_path / "named.params")
+    data = {"arg:weight": nd.array(np.eye(4, dtype=np.float32)),
+            "aux:running_mean": nd.array(np.zeros(4, dtype=np.float32))}
+    nd.save(fname, data)
+    back = nd.load(fname)
+    assert set(back.keys()) == set(data.keys())
+    for k in data:
+        np.testing.assert_allclose(back[k].asnumpy(), data[k].asnumpy())
+
+
+def test_binary_format_bytes_layout(tmp_path):
+    """First 8 bytes are the reference list magic 0x112 — the cross-check
+    that the reference would recognize our files."""
+    import struct
+    fname = str(tmp_path / "x.params")
+    nd.save(fname, [nd.ones((2, 2))])
+    with open(fname, "rb") as f:
+        head = f.read(28)
+    magic, reserved, count = struct.unpack("<QQQ", head[:24])
+    assert magic == 0x112 and reserved == 0 and count == 1
+    (v2_magic,) = struct.unpack("<I", head[24:28])
+    assert v2_magic == 0xF993FAC9
+
+
+def test_binary_roundtrip_sparse(tmp_path):
+    from mxnet_tpu.ndarray import sparse
+    fname = str(tmp_path / "sp.params")
+    dense = np.zeros((4, 5), np.float32)
+    dense[0, 1] = 2.0
+    dense[2, 3] = -1.5
+    csr = sparse.csr_matrix(nd.array(dense))
+    rsp = sparse.row_sparse_array(nd.array(dense))
+    nd.save(fname, {"csr": csr, "rsp": rsp})
+    back = nd.load(fname)
+    assert back["csr"].stype == "csr"
+    assert back["rsp"].stype == "row_sparse"
+    np.testing.assert_allclose(back["csr"].asnumpy(), dense)
+    np.testing.assert_allclose(back["rsp"].asnumpy(), dense)
+
+
+def test_npz_backward_compat(tmp_path):
+    """Checkpoints written by the round-1 npz container still load."""
+    fname = str(tmp_path / "old.params")
+    with open(fname, "wb") as f:
+        np.savez(f, **{"w": np.ones((2, 3), np.float32)})
+    back = nd.load(fname)
+    np.testing.assert_allclose(back["w"].asnumpy(), 1.0)
+
+
+def test_load_garbage_raises_clear_error(tmp_path):
+    fname = str(tmp_path / "junk.params")
+    with open(fname, "wb") as f:
+        f.write(b"this is not a checkpoint")
+    with pytest.raises(ValueError, match="magic 0x112"):
+        nd.load(fname)
+
+
+def test_checkpoint_roundtrip_through_model(tmp_path):
+    """model save_checkpoint/load_checkpoint ride the binary format."""
+    prefix = str(tmp_path / "ckpt")
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    arg = {"fc_weight": nd.ones((3, 4)), "fc_bias": nd.zeros((3,))}
+    mx.model.save_checkpoint(prefix, 7, sym, arg, {})
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    np.testing.assert_allclose(arg2["fc_weight"].asnumpy(), 1.0)
+    assert sym2.list_arguments() == sym.list_arguments()
+
+
+def test_save_0d_raises(tmp_path):
+    with pytest.raises(ValueError, match="0-d"):
+        nd.save(str(tmp_path / "s.params"),
+                {"s": nd.array(np.float32(5.0))})
