@@ -109,4 +109,5 @@ def num_gpus():
 
 
 def num_tpus():
-    return len(_accel_devices())
+    import jax
+    return len([d for d in jax.local_devices() if d.platform != "cpu"])

@@ -1,4 +1,16 @@
-"""Learning-rate schedulers (reference: python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedules as pure functions of the update count.
+
+API parity with the reference scheduler classes (python/mxnet/lr_scheduler.py)
+but a different design: every schedule here is *stateless* — ``sched(t)``
+is a closed-form function of ``t`` alone, never of the query history.  The
+reference mutates ``base_lr`` in place while scanning steps, which makes the
+schedule depend on being called with monotonically increasing ``num_update``;
+a pure formulation has no such hazard and, being side-effect free, can also be
+traced into a jitted train step if the caller wants the lr on-device.
+
+Each class keeps the reference constructor signature so Optimizer /
+Trainer code can pass ``lr_scheduler=`` objects unchanged.
+"""
 from __future__ import annotations
 
 import math
@@ -7,9 +19,27 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
            "PolyScheduler", "CosineScheduler"]
 
 
+def _warmup_value(t, *, steps, begin, end, mode):
+    """lr during warmup, t in [0, steps)."""
+    if mode == "linear":
+        return begin + (end - begin) * (t / steps)
+    if mode == "constant":
+        return begin
+    raise ValueError("unknown warmup_mode %r (want 'linear' or 'constant')"
+                     % (mode,))
+
+
 class LRScheduler:
+    """Base class: handles the warmup ramp, delegates the rest to subclasses.
+
+    Subclasses implement :meth:`_after_warmup`, a pure function of the
+    update count, and never touch instance state from inside ``__call__``.
+    """
+
     def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
                  warmup_mode="linear"):
+        if warmup_mode not in ("linear", "constant"):
+            raise ValueError("unknown warmup_mode %r" % (warmup_mode,))
         self.base_lr = base_lr
         self.warmup_steps = warmup_steps
         self.warmup_begin_lr = warmup_begin_lr
@@ -18,109 +48,97 @@ class LRScheduler:
 
     def get_warmup_lr(self, num_update):
         assert num_update < self.warmup_steps
-        if self.warmup_mode == "linear":
-            increase = ((self.warmup_final_lr - self.warmup_begin_lr)
-                        * float(num_update) / float(self.warmup_steps))
-            return self.warmup_begin_lr + increase
-        if self.warmup_mode == "constant":
-            return self.warmup_begin_lr
-        raise ValueError("Invalid warmup mode %s" % self.warmup_mode)
+        return _warmup_value(float(num_update), steps=float(self.warmup_steps),
+                             begin=self.warmup_begin_lr,
+                             end=self.warmup_final_lr, mode=self.warmup_mode)
+
+    def _after_warmup(self, num_update):
+        raise NotImplementedError
 
     def __call__(self, num_update):
-        raise NotImplementedError
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._after_warmup(num_update)
 
 
 class FactorScheduler(LRScheduler):
+    """lr = base_lr * factor^d, floored at stop_factor_lr.
+
+    d counts the step boundaries strictly passed: a decay lands on update
+    ``k*step + 1`` (k >= 1), matching the reference's scan loop.
+    """
+
     def __init__(self, step, factor=1, stop_factor_lr=1e-8, base_lr=0.01,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
+            raise ValueError("step must be >= 1, got %r" % (step,))
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor must be <= 1 so the lr decays, got %r"
+                             % (factor,))
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-        return self.base_lr
+    def _after_warmup(self, num_update):
+        decays = max(0, (num_update - 1) // self.step)
+        return max(self.base_lr * self.factor ** decays, self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
+    """lr = base_lr * factor^(number of milestones strictly passed)."""
+
     def __init__(self, step, factor=1, base_lr=0.01, warmup_steps=0,
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1 round")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("every milestone must be >= 1: %r" % (step,))
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("milestones must strictly increase: %r" % (step,))
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _after_warmup(self, num_update):
+        passed = sum(1 for milestone in self.step if num_update > milestone)
+        return self.base_lr * self.factor ** passed
 
 
 class PolyScheduler(LRScheduler):
+    """Polynomial decay from base_lr to final_lr over max_update updates."""
+
     def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update must be a positive int, got %r"
+                             % (max_update,))
         self.power = pwr
-        self.base_lr_orig = self.base_lr
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max_update - warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                pow(1 - float(num_update - self.warmup_steps) / float(self.max_steps),
-                    self.power)
-        return self.base_lr
+    def _after_warmup(self, num_update):
+        t = min(num_update, self.max_update) - self.warmup_steps
+        frac = 1.0 - t / float(self.max_steps)
+        return self.final_lr + (self.base_lr - self.final_lr) * frac ** self.power
 
 
 class CosineScheduler(LRScheduler):
+    """Half-cosine decay from base_lr to final_lr over max_update updates."""
+
     def __init__(self, max_update, base_lr=0.01, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update must be a positive int, got %r"
+                             % (max_update,))
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max_update - warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 + math.cos(math.pi * (num_update - self.warmup_steps) / self.max_steps)) / 2
-        return self.base_lr
+    def _after_warmup(self, num_update):
+        t = min(num_update, self.max_update) - self.warmup_steps
+        cos_out = 0.5 * (1.0 + math.cos(math.pi * t / self.max_steps))
+        return self.final_lr + (self.base_lr - self.final_lr) * cos_out

@@ -1,12 +1,19 @@
-"""Evaluation metrics (reference: python/mxnet/metric.py — EvalMetric base +
-registry at :68,189; Accuracy/TopK/F1/MCC/Perplexity/MAE/MSE/RMSE/CrossEntropy/
-NLL/PearsonCorrelation/Loss/Custom/CompositeEvalMetric)."""
+"""Evaluation metrics.
+
+API parity with the reference metric module (python/mxnet/metric.py —
+EvalMetric base + registry, Accuracy/TopKAccuracy/F1/MCC/Perplexity/MAE/MSE/
+RMSE/CrossEntropy/NegativeLogLikelihood/PearsonCorrelation/Loss/Custom/
+CompositeEvalMetric), built on a different core: most metrics here are thin
+declarations over ``_ScalarMetric``, which owns the accumulate/get/reset
+machinery, and each subclass contributes a single vectorized
+``_batch_stat(label, pred) -> (stat_sum, count)`` over numpy arrays.
+The reference instead hand-rolls the update loop in every class.
+"""
 from __future__ import annotations
 
 import math
 import numpy
 
-from .base import numeric_types, string_types
 from .ndarray import NDArray
 from . import ndarray
 
@@ -19,64 +26,70 @@ _METRIC_REGISTRY = {}
 
 
 def register(klass):
+    """Register a metric class under its lowercased class name."""
     _METRIC_REGISTRY[klass.__name__.lower()] = klass
     return klass
 
 
 def _alias(*aliases):
     def deco(klass):
-        for a in aliases:
-            _METRIC_REGISTRY[a.lower()] = klass
-        return register(klass)
+        register(klass)
+        _METRIC_REGISTRY.update({a.lower(): klass for a in aliases})
+        return klass
     return deco
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    """Reference-compatible label/pred consistency check.
+
+    With ``shape=False`` compares lengths, otherwise full shapes; with
+    ``wrap=True`` promotes bare NDArrays to one-element lists.
+    """
+    got = (labels.shape, preds.shape) if shape else (len(labels), len(preds))
+    if got[0] != got[1]:
         raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+                         "predictions {}".format(*got))
     if wrap:
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels = [labels] if isinstance(labels, NDArray) else labels
+        preds = [preds] if isinstance(preds, NDArray) else preds
     return labels, preds
 
 
+def _as_numpy_pairs(labels, preds, check=True):
+    """Yield (label, pred) numpy pairs from NDArray lists."""
+    if check:
+        labels, preds = check_label_shapes(labels, preds, True)
+    for label, pred in zip(labels, preds):
+        yield label.asnumpy(), pred.asnumpy()
+
+
 class EvalMetric:
+    """Base metric: ratio of accumulated ``sum_metric`` over ``num_inst``."""
+
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
-        self.name = str(name)
-        self.output_names = output_names
-        self.label_names = label_names
-        self._kwargs = kwargs
+        self.name, self._kwargs = str(name), kwargs
+        self.output_names, self.label_names = output_names, label_names
         self.reset()
 
     def __str__(self):
-        return "EvalMetric: {}".format(dict(self.get_name_value()))
+        return "EvalMetric: %s" % dict(self.get_name_value())
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
-        return config
+        """Serializable config: kwargs + identity fields."""
+        return dict(self._kwargs,
+                    metric=self.__class__.__name__,
+                    name=self.name,
+                    output_names=self.output_names,
+                    label_names=self.label_names)
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names if name in pred]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names if name in label]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        """Update from {name: NDArray} dicts, honoring output/label_names."""
+        def select(d, names):
+            if names is None:
+                return list(d.values())
+            return [d[n] for n in names if n in d]
+        self.update(select(label, self.label_names),
+                    select(pred, self.output_names))
 
     def update(self, labels, preds):
         raise NotImplementedError()
@@ -92,46 +105,65 @@ class EvalMetric:
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
+
+
+class _ScalarMetric(EvalMetric):
+    """Metric defined by one vectorized statistic per (label, pred) pair.
+
+    Subclasses override ``_batch_stat(label, pred) -> (stat_sum, count)``
+    operating on numpy arrays; everything else (iteration, conversion,
+    accumulation) lives here.
+    """
+
+    def update(self, labels, preds):
+        for label, pred in _as_numpy_pairs(labels, preds):
+            stat, count = self._batch_stat(label, pred)
+            self.sum_metric += stat
+            self.num_inst += count
+
+    def _batch_stat(self, label, pred):
+        raise NotImplementedError()
 
 
 def create(metric, *args, **kwargs):
+    """Create a metric from a name, callable, instance, or list thereof."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, *args, **kwargs))
-        return composite_metric
-    if isinstance(metric, str) and metric.lower() in _METRIC_REGISTRY:
-        return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, *args, **kwargs))
+        return out
+    if isinstance(metric, str):
+        klass = _METRIC_REGISTRY.get(metric.lower())
+        if klass is not None:
+            return klass(*args, **kwargs)
     raise ValueError("metric %s not recognized" % metric)
 
 
 @register
 class CompositeEvalMetric(EvalMetric):
+    """Fan updates out to child metrics; report all their values."""
+
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
 
     def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
+        if not 0 <= index < len(self.metrics):
             return ValueError("Metric index {} is out of range 0 and {}".format(
                 index, len(self.metrics)))
+        return self.metrics[index]
 
     def update_dict(self, labels, preds):
         for metric in self.metrics:
@@ -142,202 +174,210 @@ class CompositeEvalMetric(EvalMetric):
             metric.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
 
     def get(self):
-        names = []
-        values = []
+        names, values = [], []
         for metric in self.metrics:
             name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, numeric_types):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+            names += name if isinstance(name, list) else [name]
+            values += value if isinstance(value, list) else [value]
         return (names, values)
 
     def get_config(self):
         config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        config["metrics"] = [m.get_config() for m in self.metrics]
         return config
 
 
 @_alias("acc")
-class Accuracy(EvalMetric):
-    def __init__(self, axis=1, name="accuracy", output_names=None, label_names=None):
+class Accuracy(_ScalarMetric):
+    """Fraction of predictions equal to the label (argmax over `axis`)."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
         super().__init__(name, axis=axis, output_names=output_names,
                          label_names=label_names)
         self.axis = axis
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            if pred_label.shape != label.shape:
-                pred_label = ndarray.argmax(pred_label, axis=self.axis)
-            pred_label = pred_label.asnumpy().astype("int32")
-            label = label.asnumpy().astype("int32")
-            labels_, preds_ = check_label_shapes(label, pred_label)
-            self.sum_metric += (pred_label.flat == label.flat).sum()
-            self.num_inst += len(pred_label.flat)
+        for label, pred in zip(labels, preds):
+            if pred.shape != label.shape:
+                pred = ndarray.argmax(pred, axis=self.axis)
+            decided = pred.asnumpy().astype("int32").ravel()
+            truth = label.asnumpy().astype("int32").ravel()
+            check_label_shapes(truth, decided)
+            hits = decided == truth
+            self.sum_metric += int(hits.sum())
+            self.num_inst += hits.size
 
 
 @_alias("top_k_accuracy", "top_k_acc")
-class TopKAccuracy(EvalMetric):
+class TopKAccuracy(_ScalarMetric):
+    """Fraction of samples whose label lands in the top-k scores.
+
+    Uses a vectorized argpartition membership test rather than the
+    reference's per-rank column scan.
+    """
+
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
         super().__init__(name, top_k=top_k, output_names=output_names,
                          label_names=label_names)
+        if top_k <= 1:
+            raise AssertionError("Please use Accuracy if top_k is no more than 1")
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+        self.name = "%s_%d" % (self.name, top_k)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            label = label.asnumpy().astype("int32")
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (pred_label[:, num_classes - 1 - j].flat
-                                        == label.flat).sum()
-            self.num_inst += num_samples
-
-
-@register
-class F1(EvalMetric):
-    def __init__(self, name="f1", output_names=None, label_names=None, average="macro"):
-        self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        super().__init__(name=name, output_names=output_names, label_names=label_names)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
-        if self.average == "macro":
-            self.sum_metric += self.metrics.fscore
-            self.num_inst += 1
-            self.metrics.reset_stats()
+    def _batch_stat(self, label, pred):
+        if pred.ndim == 1:
+            hits = (pred.astype("int64") == label.astype("int64")).sum()
+            return int(hits), label.shape[0]
+        if pred.ndim != 2:
+            raise AssertionError("Predictions should be no more than 2 dims")
+        k = min(self.top_k, pred.shape[1])
+        if k == pred.shape[1]:
+            top = numpy.argsort(pred, axis=1)[:, -k:]
         else:
-            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
-            self.num_inst = self.metrics.total_examples
-
-    def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0
-        if hasattr(self, "metrics"):
-            self.metrics.reset_stats()
+            top = numpy.argpartition(pred.astype("float32"), -k, axis=1)[:, -k:]
+        member = (top == label.astype("int64")[:, None]).any(axis=1)
+        return int(member.sum()), label.shape[0]
 
 
-class _BinaryClassificationMetrics:
+class _ConfusionCounts:
+    """Binary-classification confusion tally shared by F1 and MCC."""
+
+    FIELDS = ("tp", "fp", "fn", "tn")
+
     def __init__(self):
-        self.true_positives = 0
-        self.false_negatives = 0
-        self.false_positives = 0
-        self.true_negatives = 0
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.counts = dict.fromkeys(self.FIELDS, 0)
 
     def update_binary_stats(self, label, pred):
-        pred = pred.asnumpy()
-        label = label.asnumpy().astype("int32")
-        pred_label = numpy.argmax(pred, axis=1)
-        check_label_shapes(label, pred)
-        if len(numpy.unique(label)) > 2:
+        scores = pred.asnumpy()
+        truth = label.asnumpy().astype("int32").ravel()
+        decided = numpy.argmax(scores, axis=1)
+        check_label_shapes(truth, decided)
+        if numpy.unique(truth).size > 2:
             raise ValueError("%s currently only supports binary classification."
-                             % self.__class__.__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label == 1)
-        label_false = 1 - label_true
-        self.true_positives += (pred_true * label_true).sum()
-        self.false_positives += (pred_true * label_false).sum()
-        self.false_negatives += (pred_false * label_true).sum()
-        self.true_negatives += (pred_false * label_false).sum()
+                             % type(self).__name__)
+        pos_pred, pos_true = decided == 1, truth == 1
+        self.counts["tp"] += int((pos_pred & pos_true).sum())
+        self.counts["fp"] += int((pos_pred & ~pos_true).sum())
+        self.counts["fn"] += int((~pos_pred & pos_true).sum())
+        self.counts["tn"] += int((~pos_pred & ~pos_true).sum())
+
+    # accessors used by tests / downstream code
+    true_positives = property(lambda self: self.counts["tp"])
+    false_positives = property(lambda self: self.counts["fp"])
+    false_negatives = property(lambda self: self.counts["fn"])
+    true_negatives = property(lambda self: self.counts["tn"])
+
+    @property
+    def total_examples(self):
+        return sum(self.counts.values())
 
     @property
     def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (self.true_positives + self.false_positives)
-        return 0.0
+        denom = self.counts["tp"] + self.counts["fp"]
+        return self.counts["tp"] / denom if denom else 0.0
 
     @property
     def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (self.true_positives + self.false_negatives)
-        return 0.0
+        denom = self.counts["tp"] + self.counts["fn"]
+        return self.counts["tp"] / denom if denom else 0.0
 
     @property
     def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (self.precision + self.recall)
-        return 0.0
+        p, r = self.precision, self.recall
+        return 2.0 * p * r / (p + r) if p + r else 0.0
 
     @property
     def matthewscc(self):
         if not self.total_examples:
             return 0.0
-        true_pos = float(self.true_positives)
-        false_pos = float(self.false_positives)
-        false_neg = float(self.false_negatives)
-        true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos), (true_pos + false_neg),
-                 (true_neg + false_pos), (true_neg + false_neg)]
+        tp, fp, fn, tn = (float(self.counts[f]) for f in self.FIELDS)
+        pairs = ((tp + fp), (tp + fn), (tn + fp), (tn + fn))
         denom = 1.0
-        for t in filter(lambda t: t != 0.0, terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(denom)
-
-    @property
-    def total_examples(self):
-        return (self.false_negatives + self.false_positives
-                + self.true_negatives + self.true_positives)
-
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+        for term in pairs:
+            denom *= term or 1.0
+        return (tp * tn - fp * fn) / math.sqrt(denom)
 
 
-@register
-class MCC(EvalMetric):
-    def __init__(self, name="mcc", output_names=None, label_names=None, average="macro"):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        super().__init__(name=name, output_names=output_names, label_names=label_names)
+# reference-compatible alias for the internal stats helper
+_BinaryClassificationMetrics = _ConfusionCounts
+
+
+class _ConfusionMetric(EvalMetric):
+    """Base for F1 / MCC: accumulate confusion counts, report one score.
+
+    ``average='macro'`` averages per-batch scores; ``'micro'`` scores the
+    pooled counts.
+    """
+
+    _stat_name = None  # property name on _ConfusionCounts
+
+    def __init__(self, name, output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self.metrics = _ConfusionCounts()
+        super().__init__(name=name, output_names=output_names,
+                         label_names=label_names)
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc
+            self.metrics.update_binary_stats(label, pred)
+        score = getattr(self.metrics, self._stat_name)
+        if self.average == "macro":
+            self.sum_metric += score
             self.num_inst += 1
-            self._metrics.reset_stats()
+            self.metrics.reset_stats()
         else:
-            self.sum_metric = self._metrics.matthewscc * self._metrics.total_examples
-            self.num_inst = self._metrics.total_examples
+            n = self.metrics.total_examples
+            self.sum_metric, self.num_inst = score * n, n
 
     def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0.0
-        if hasattr(self, "_metrics"):
-            self._metrics.reset_stats()
+        self.sum_metric, self.num_inst = 0.0, 0
+        if hasattr(self, "metrics"):
+            self.metrics.reset_stats()
+
+
+@register
+class F1(_ConfusionMetric):
+    _stat_name = "fscore"
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names, average)
+
+
+@register
+class MCC(_ConfusionMetric):
+    _stat_name = "matthewscc"
+
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names, average)
+
+    # reference spelling kept for introspection parity
+    @property
+    def _average(self):
+        return self.average
+
+    @property
+    def _metrics(self):
+        return self.metrics
 
 
 @register
 class Perplexity(EvalMetric):
+    """exp(mean negative log predicted probability of the label)."""
+
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, ignore_label=ignore_label,
@@ -347,23 +387,20 @@ class Perplexity(EvalMetric):
 
     def update(self, labels, preds):
         assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
         for label, pred in zip(labels, preds):
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.as_in_context(pred.context).reshape((label.size,))
-            pred = ndarray.pick(pred, label.astype(dtype="int32"), axis=self.axis)
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
+            if label.size != pred.size // pred.shape[-1]:
+                raise AssertionError("shape mismatch: %s vs. %s"
+                                     % (label.shape, pred.shape))
+            flat = label.as_in_context(pred.context).reshape((label.size,))
+            picked = ndarray.pick(pred, flat.astype(dtype="int32"),
+                                  axis=self.axis).asnumpy()
+            flat = flat.asnumpy()
+            keep = numpy.ones_like(picked, dtype=bool)
             if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label).astype(pred_np.dtype)
-                num -= int(numpy.sum(ignore))
-                pred_np = pred_np * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, pred_np)))
-            num += pred_np.size
-        self.sum_metric += loss
-        self.num_inst += num
+                keep = flat != self.ignore_label
+            self.sum_metric += float(
+                -numpy.log(numpy.maximum(picked[keep], 1e-10)).sum())
+            self.num_inst += int(keep.sum())
 
     def get(self):
         if self.num_inst == 0:
@@ -371,176 +408,146 @@ class Perplexity(EvalMetric):
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
+def _as_2d(a):
+    return a[:, None] if a.ndim == 1 else a
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_ScalarMetric):
     def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    def _batch_stat(self, label, pred):
+        return numpy.abs(_as_2d(label) - _as_2d(pred)).mean(), 1
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_ScalarMetric):
     def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def _batch_stat(self, label, pred):
+        return numpy.square(_as_2d(label) - _as_2d(pred)).mean(), 1
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_ScalarMetric):
     def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    def _batch_stat(self, label, pred):
+        return math.sqrt(numpy.square(_as_2d(label) - _as_2d(pred)).mean()), 1
+
+
+class _LabelProbMetric(_ScalarMetric):
+    """Shared core of CrossEntropy / NegativeLogLikelihood: sum of
+    -log p(label) over the batch."""
+
+    def __init__(self, eps, name, output_names, label_names):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names)
+        self.eps = eps
+
+    def _batch_stat(self, label, pred):
+        idx = label.ravel().astype("int64")
+        if idx.shape[0] != pred.shape[0]:
+            raise AssertionError((idx.shape[0], pred.shape[0]))
+        p_label = pred[numpy.arange(pred.shape[0]), idx]
+        return float(-numpy.log(p_label + self.eps).sum()), pred.shape[0]
 
 
 @_alias("ce")
-class CrossEntropy(EvalMetric):
+class CrossEntropy(_LabelProbMetric):
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+        super().__init__(eps, name, output_names, label_names)
 
 
 @_alias("nll_loss")
-class NegativeLogLikelihood(EvalMetric):
+class NegativeLogLikelihood(_LabelProbMetric):
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
+        super().__init__(eps, name, output_names, label_names)
 
 
 @_alias("pearsonr")
 class PearsonCorrelation(EvalMetric):
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
             check_label_shapes(label, pred, False, True)
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            self.sum_metric += numpy.corrcoef(pred.ravel(), label.ravel())[0, 1]
+            x = pred.asnumpy().ravel()
+            y = label.asnumpy().ravel()
+            self.sum_metric += float(numpy.corrcoef(x, y)[0, 1])
             self.num_inst += 1
 
 
 @register
 class Loss(EvalMetric):
+    """Mean of raw loss outputs (no labels consumed)."""
+
     def __init__(self, name="loss", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
     def update(self, _, preds):
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        preds = [preds] if isinstance(preds, NDArray) else preds
         for pred in preds:
-            loss = ndarray.sum(pred).asscalar()
-            self.sum_metric += loss
+            self.sum_metric += float(ndarray.sum(pred).asscalar())
             self.num_inst += pred.size
 
 
 @register
 class Torch(Loss):
     def __init__(self, name="torch", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
 
 @register
 class Caffe(Loss):
     def __init__(self, name="caffe", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
 
 @register
 class CustomMetric(EvalMetric):
+    """Wrap a ``feval(label, pred) -> value | (sum, count)`` function."""
+
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:  # lambdas
                 name = "custom(%s)" % name
-        super().__init__(name, feval=feval, allow_extra_outputs=allow_extra_outputs,
+        super().__init__(name, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs,
                          output_names=output_names, label_names=label_names)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
     def update(self, labels, preds):
-        if not self._allow_extra_outputs:
-            labels, preds = check_label_shapes(labels, preds, True)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.num_inst += 1
+        for label, pred in _as_numpy_pairs(
+                labels, preds, check=not self._allow_extra_outputs):
+            result = self._feval(label, pred)
+            stat, count = result if isinstance(result, tuple) else (result, 1)
+            self.sum_metric += stat
+            self.num_inst += count
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Lift a numpy feval into a CustomMetric (reference mx.metric.np)."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__

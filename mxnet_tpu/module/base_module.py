@@ -22,6 +22,31 @@ def _as_list(obj):
     return [obj]
 
 
+def _fire(callbacks, *args):
+    """Invoke a callback, a list of callbacks, or nothing (None)."""
+    if callbacks is None:
+        return
+    for callback in _as_list(callbacks):
+        callback(*args)
+
+
+def _with_lookahead(iterable):
+    """Yield (batch, upcoming) pairs; upcoming is None on the last batch.
+
+    The one-batch lookahead lets the fit loop call ``prepare`` on the next
+    batch (sparse row-id prefetch) while the current one is in flight.
+    """
+    it = iter(iterable)
+    try:
+        current = next(it)
+    except StopIteration:
+        return
+    for upcoming in it:
+        yield current, upcoming
+        current = upcoming
+    yield current, None
+
+
 def _check_input_names(symbol, names, typename, throw):
     args = symbol.list_arguments()
     for name in names:
@@ -37,13 +62,14 @@ def _check_input_names(symbol, names, typename, throw):
 
 
 class BaseModule:
+    # lifecycle flags, all False until the corresponding stage runs
+    _STAGE_FLAGS = ("binded", "for_training", "inputs_need_grad",
+                    "params_initialized", "optimizer_initialized")
+
     def __init__(self, logger=logging):
         self.logger = logger
-        self.binded = False
-        self.for_training = False
-        self.inputs_need_grad = False
-        self.params_initialized = False
-        self.optimizer_initialized = False
+        for flag in self._STAGE_FLAGS:
+            setattr(self, flag, False)
         self._symbol = None
         self._total_exec_bytes = 0
 
@@ -67,23 +93,24 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
-            if isinstance(eval_batch, list):
-                self.update_metric(eval_metric, [eb.label for eb in eval_batch],
-                                   pre_sliced=True)
-            else:
-                self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
+            self._metric_from_batch(eval_metric, eval_batch)
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals()))
             actual_num_batch += 1
         if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            _fire(score_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
+                                eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
+
+    def _metric_from_batch(self, eval_metric, batch):
+        """Update a metric from one batch, which may be pre-sliced per device."""
+        if isinstance(batch, list):
+            self.update_metric(eval_metric, [b.label for b in batch],
+                               pre_sliced=True)
+        else:
+            self.update_metric(eval_metric, batch.label)
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
@@ -157,47 +184,32 @@ class BaseModule:
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            eval_name_vals = []
+            for nbatch, (data_batch, upcoming) in enumerate(
+                    _with_lookahead(train_data)):
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric,
-                                       [db.label for db in data_batch],
-                                       pre_sliced=True)
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
+                self._metric_from_batch(eval_metric, data_batch)
+                if upcoming is not None:
+                    # prefetch hook for the next batch (e.g. sparse row pull)
+                    self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
                 if monitor is not None:
                     monitor.toc_print()
-                if end_of_batch:
+                if upcoming is None:
+                    # snapshot before callbacks may auto-reset the metric
                     eval_name_vals = eval_metric.get_name_value()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=eval_metric, locals=locals()))
             for name, val in eval_name_vals:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            _fire(epoch_end_callback, epoch, self.symbol, arg_params_, aux_params_)
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
                                  score_end_callback=eval_end_callback,

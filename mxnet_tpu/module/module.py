@@ -29,15 +29,12 @@ class Module(BaseModule):
                  fixed_param_names=None, state_names=None, group2ctxs=None,
                  compression_params=None):
         super().__init__(logger=logger)
-        if context is None:
-            context = cpu()
-        if isinstance(context, Context):
-            context = [context]
-        self._context = context
-        if work_load_list is None:
-            work_load_list = [1] * len(self._context)
-        assert len(work_load_list) == len(self._context)
-        self._work_load_list = work_load_list
+        ctxs = context if context is not None else cpu()
+        self._context = [ctxs] if isinstance(ctxs, Context) else list(ctxs)
+        self._work_load_list = (list(work_load_list) if work_load_list
+                                else [1] * len(self._context))
+        if len(self._work_load_list) != len(self._context):
+            raise AssertionError("work_load_list must have one entry per context")
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -58,19 +55,13 @@ class Module(BaseModule):
         _check_input_names(symbol, self._state_names, "state", True)
         _check_input_names(symbol, self._fixed_param_names, "fixed_param", True)
 
-        self._arg_params = None
-        self._aux_params = None
+        # parameter state, optimizer state, and bind state all start empty
+        for attr in ("_arg_params", "_aux_params", "_optimizer", "_kvstore",
+                     "_update_on_kvstore", "_updater", "_preload_opt_states",
+                     "_grad_req", "_exec_group", "_data_shapes", "_label_shapes"):
+            setattr(self, attr, None)
         self._params_dirty = False
         self._compression_params = compression_params
-        self._optimizer = None
-        self._kvstore = None
-        self._update_on_kvstore = None
-        self._updater = None
-        self._preload_opt_states = None
-        self._grad_req = None
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -96,9 +87,7 @@ class Module(BaseModule):
 
     def _reset_bind(self):
         self.binded = False
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
+        self._exec_group = self._data_shapes = self._label_shapes = None
 
     @property
     def data_names(self):
@@ -156,27 +145,24 @@ class Module(BaseModule):
             self._aux_params = {name: arr for name, arr in
                                 zip(self._aux_names, aux_arrays)}
 
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        cache_arr.copyto(arr)
-                else:
-                    if not allow_missing:
-                        raise RuntimeError("%s is not presented" % name)
-                    if initializer is not None:
-                        initializer(name, arr)
-            else:
-                initializer(name, arr)
+        def _fill(desc, arr, provided):
+            # prefer a user-provided value; otherwise fall back to the
+            # initializer (or fail, when missing values are not allowed)
+            src = provided.get(desc) if provided is not None else None
+            if src is not None:
+                if src is not arr:
+                    src.copyto(arr)
+                return
+            if provided is not None and not allow_missing:
+                raise RuntimeError("%s is not presented" % desc)
+            if initializer is not None:
+                initializer(desc, arr)
 
         attrs = self._symbol.attr_dict()
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, aux_params)
+        for params, provided in ((self._arg_params, arg_params),
+                                 (self._aux_params, aux_params)):
+            for name, arr in sorted(params.items()):
+                _fill(InitDesc(name, attrs.get(name, None)), arr, provided)
 
         self.params_initialized = True
         self._params_dirty = False
@@ -279,10 +265,11 @@ class Module(BaseModule):
                               "num_workers (%s vs. %s)."
                               % (optimizer.rescale_grad, rescale_grad))
 
-        self._optimizer = optimizer
-        self._kvstore = kvstore
+        self._optimizer, self._kvstore = optimizer, kvstore
         self._update_on_kvstore = update_on_kvstore
-        self._updater = None
+        # either the kvstore applies updates (set_optimizer) or we keep a
+        # local updater; never both
+        self._updater = None if update_on_kvstore else opt.get_updater(optimizer)
 
         if kvstore:
             if self._compression_params:
@@ -294,8 +281,6 @@ class Module(BaseModule):
                                 update_on_kvstore=update_on_kvstore)
         if update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
-        else:
-            self._updater = opt.get_updater(optimizer)
 
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
@@ -304,10 +289,8 @@ class Module(BaseModule):
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
-        self._optimizer = shared_module._optimizer
-        self._kvstore = shared_module._kvstore
-        self._update_on_kvstore = shared_module._update_on_kvstore
-        self._updater = shared_module._updater
+        for attr in ("_optimizer", "_kvstore", "_update_on_kvstore", "_updater"):
+            setattr(self, attr, getattr(shared_module, attr))
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
@@ -341,17 +324,16 @@ class Module(BaseModule):
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        group = self._exec_group
         if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore, self._exec_group.param_names)
+            _update_params_on_kvstore(group.param_arrays, group.grad_arrays,
+                                      self._kvstore, group.param_names)
         else:
-            _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
+            _update_params(group.param_arrays, group.grad_arrays,
                            updater=self._updater,
                            num_device=len(self._context),
                            kvstore=self._kvstore,
-                           param_names=self._exec_group.param_names)
+                           param_names=group.param_names)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

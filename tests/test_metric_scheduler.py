@@ -1,0 +1,240 @@
+"""Tests for mxnet_tpu.metric and mxnet_tpu.lr_scheduler.
+
+Mirrors the reference checks in tests/python/unittest/test_metric.py and the
+scheduler semantics of python/mxnet/lr_scheduler.py.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric as metric_mod
+from mxnet_tpu import lr_scheduler
+from mxnet_tpu import nd
+
+
+def test_accuracy_basic():
+    m = metric_mod.create("acc")
+    pred = nd.array([[0.3, 0.7], [0.8, 0.2], [0.1, 0.9]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    assert value == pytest.approx(2.0 / 3.0)
+
+
+def test_accuracy_same_shape_no_argmax():
+    m = metric_mod.Accuracy()
+    m.update([nd.array([1, 0, 1, 1])], [nd.array([1, 1, 1, 0])])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_top_k_accuracy():
+    m = metric_mod.create("top_k_accuracy", top_k=3)
+    assert m.name == "top_k_accuracy_3"
+    np.random.seed(0)
+    pred = np.random.uniform(size=(20, 10)).astype(np.float32)
+    label = np.random.randint(0, 10, 20)
+    m.update([nd.array(label)], [nd.array(pred)])
+    expect = np.mean([l in np.argsort(p)[-3:] for p, l in zip(pred, label)])
+    assert m.get()[1] == pytest.approx(expect)
+
+
+def test_top_k_requires_k_above_one():
+    with pytest.raises(AssertionError):
+        metric_mod.TopKAccuracy(top_k=1)
+
+
+def _f1_inputs():
+    pred = nd.array([[0.7, 0.3], [0.2, 0.8], [0.4, 0.6], [0.9, 0.1]])
+    label = nd.array([0, 1, 0, 1])  # tp=1 fp=1 fn=1 tn=1
+    return label, pred
+
+
+def test_f1_macro_and_micro():
+    label, pred = _f1_inputs()
+    for average in ("macro", "micro"):
+        m = metric_mod.F1(average=average)
+        m.update([label], [pred])
+        # precision = recall = 0.5 -> f1 = 0.5 either way for one batch
+        assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1_rejects_multiclass_labels():
+    m = metric_mod.F1()
+    pred = nd.array([[0.5, 0.5], [0.5, 0.5], [0.5, 0.5]])
+    with pytest.raises(ValueError):
+        m.update([nd.array([0, 1, 2])], [pred])
+
+
+def test_mcc_matches_formula():
+    m = metric_mod.MCC(average="micro")
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4],
+                     [0.2, 0.8], [0.7, 0.3]])
+    label = nd.array([1, 0, 0, 0, 1, 1])
+    m.update([label], [pred])
+    tp, tn, fp, fn = 2.0, 2.0, 1.0, 1.0
+    want = (tp * tn - fp * fn) / math.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    assert m.get()[1] == pytest.approx(want)
+
+
+def test_perplexity_ignores_label():
+    m = metric_mod.Perplexity(ignore_label=0)
+    pred = nd.array([[0.2, 0.8], [0.9, 0.1], [0.5, 0.5]])
+    label = nd.array([1, 0, 1])
+    m.update([label], [pred])
+    # rows with label==0 are ignored: -log(0.8), -log(0.5) over 2 samples
+    want = math.exp((-math.log(0.8) - math.log(0.5)) / 2.0)
+    assert m.get()[1] == pytest.approx(want, rel=1e-5)
+
+
+def test_regression_metrics():
+    label = nd.array([1.0, 2.0, 3.0])
+    pred = nd.array([1.5, 2.0, 2.0])
+    diffs = np.array([0.5, 0.0, 1.0])
+    expect = {
+        "mae": np.abs(diffs).mean(),
+        "mse": (diffs ** 2).mean(),
+        "rmse": math.sqrt((diffs ** 2).mean()),
+    }
+    for name, want in expect.items():
+        m = metric_mod.create(name)
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(want), name
+
+
+def test_cross_entropy_and_nll():
+    pred = nd.array([[0.2, 0.8], [0.6, 0.4]])
+    label = nd.array([1, 0])
+    want = (-math.log(0.8) - math.log(0.6)) / 2.0
+    for name in ("ce", "nll_loss"):
+        m = metric_mod.create(name)
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(want, rel=1e-5), name
+
+
+def test_pearson_correlation():
+    np.random.seed(3)
+    label = np.random.uniform(size=(10, 2)).astype(np.float32)
+    pred = np.random.uniform(size=(10, 2)).astype(np.float32)
+    m = metric_mod.create("pearsonr")
+    m.update([nd.array(label)], [nd.array(pred)])
+    want = np.corrcoef(pred.ravel(), label.ravel())[0, 1]
+    assert m.get()[1] == pytest.approx(float(want), rel=1e-5)
+
+
+def test_composite_metric():
+    m = metric_mod.CompositeEvalMetric(["acc", "mae"])
+    pred = nd.array([[0.3, 0.7], [0.8, 0.2]])
+    label = nd.array([1, 1])
+    m.update([label], [pred])
+    pairs = dict(m.get_name_value())
+    assert pairs["accuracy"] == pytest.approx(0.5)
+    assert "mae" in pairs
+    assert isinstance(m.get_metric(0), metric_mod.Accuracy)
+
+
+def test_custom_metric_and_np():
+    def feval(label, pred):
+        return float(np.sum(label == np.argmax(pred, axis=1))), label.shape[0]
+    m = metric_mod.np(feval)
+    pred = nd.array([[0.3, 0.7], [0.8, 0.2]])
+    m.update([nd.array([1, 1])], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+    with pytest.raises(NotImplementedError):
+        m.get_config()
+
+
+def test_update_dict_respects_names():
+    m = metric_mod.Accuracy(output_names=["out"], label_names=["lab"])
+    m.update_dict({"lab": nd.array([1])}, {"out": nd.array([[0.1, 0.9]]),
+                                           "junk": nd.array([[1.0, 0.0]])})
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_metric_reset_and_nan():
+    m = metric_mod.Accuracy()
+    assert math.isnan(m.get()[1])
+    m.update([nd.array([0])], [nd.array([[0.9, 0.1]])])
+    m.reset()
+    assert m.num_inst == 0 and math.isnan(m.get()[1])
+
+
+# ---------------------------------------------------------------- schedulers
+
+def test_factor_scheduler_decay_points():
+    s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == pytest.approx(1.0)
+    assert s(10) == pytest.approx(1.0)       # boundary not yet passed
+    assert s(11) == pytest.approx(0.5)       # first decay at step+1
+    assert s(21) == pytest.approx(0.25)
+    # stateless: earlier updates still give the un-decayed rate
+    assert s(5) == pytest.approx(1.0)
+
+
+def test_factor_scheduler_floor():
+    s = lr_scheduler.FactorScheduler(step=1, factor=0.1, base_lr=1.0,
+                                     stop_factor_lr=1e-3)
+    assert s(100) == pytest.approx(1e-3)
+
+
+def test_factor_scheduler_validation():
+    with pytest.raises(ValueError):
+        lr_scheduler.FactorScheduler(step=0)
+    with pytest.raises(ValueError):
+        lr_scheduler.FactorScheduler(step=1, factor=1.5)
+
+
+def test_multifactor_scheduler():
+    s = lr_scheduler.MultiFactorScheduler(step=[5, 9], factor=0.1, base_lr=1.0)
+    assert s(5) == pytest.approx(1.0)
+    assert s(6) == pytest.approx(0.1)
+    assert s(10) == pytest.approx(0.01)
+    with pytest.raises(ValueError):
+        lr_scheduler.MultiFactorScheduler(step=[9, 5])
+    with pytest.raises(ValueError):
+        lr_scheduler.MultiFactorScheduler(step=[])
+
+
+def test_poly_scheduler():
+    s = lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2,
+                                   final_lr=0.1)
+    assert s(0) == pytest.approx(1.0)
+    assert s(100) == pytest.approx(0.1)
+    assert s(1000) == pytest.approx(0.1)     # clamps past max_update
+    assert s(50) == pytest.approx(0.1 + 0.9 * 0.25)
+
+
+def test_cosine_scheduler():
+    s = lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert s(0) == pytest.approx(1.0)
+    assert s(50) == pytest.approx(0.5)
+    assert s(100) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_warmup_linear_and_constant():
+    s = lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                   warmup_steps=10, warmup_begin_lr=0.1)
+    assert s(0) == pytest.approx(0.1)
+    assert s(5) == pytest.approx(0.1 + 0.9 * 0.5)
+    c = lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                     warmup_steps=10, warmup_begin_lr=0.2,
+                                     warmup_mode="constant")
+    assert c(3) == pytest.approx(0.2)
+    with pytest.raises(ValueError):
+        lr_scheduler.FactorScheduler(step=5, warmup_mode="bogus")
+
+
+def test_scheduler_in_optimizer():
+    opt = mx.optimizer.create(
+        "sgd", learning_rate=1.0,
+        lr_scheduler=lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                                  base_lr=1.0))
+    w = nd.array([1.0])
+    g = nd.array([0.0])
+    state = opt.create_state(0, w)
+    for _ in range(5):
+        opt.update(0, w, g, state)  # zero grads: only lr schedule advances
+    assert w.asscalar() == pytest.approx(1.0)
