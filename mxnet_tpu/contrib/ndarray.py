@@ -6,29 +6,45 @@ from ..ndarray import NDArray, invoke
 from .control_flow import foreach, while_loop, cond  # noqa: F401
 
 
-def count_sketch(*args, **kwargs):
-    raise NotImplementedError("count_sketch planned")
+def count_sketch(data, h, s, out_dim, **kwargs):
+    return invoke("_contrib_count_sketch", [data, h, s],
+                  dict(kwargs, out_dim=out_dim))
 
 
 def fft(data, compute_size=128, **kwargs):
-    import jax.numpy as jnp
-    from ..ndarray import _wrap
-    out = jnp.fft.fft(data._data)
-    # MXNet contrib.fft returns interleaved real/imag along last dim
-    real = out.real
-    imag = out.imag
-    inter = jnp.stack([real, imag], axis=-1).reshape(data.shape[:-1] + (-1,))
-    return _wrap(inter.astype(data._data.dtype), ctx=data.context)
+    return invoke("_contrib_fft", [data], {})
 
 
 def ifft(data, compute_size=128, **kwargs):
-    import jax.numpy as jnp
-    from ..ndarray import _wrap
-    x = data._data
-    x = x.reshape(x.shape[:-1] + (-1, 2))
-    comp = x[..., 0] + 1j * x[..., 1]
-    out = jnp.fft.ifft(comp)
-    return _wrap(out.real.astype(data._data.dtype) * comp.shape[-1], ctx=data.context)
+    return invoke("_contrib_ifft", [data], {})
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, **kwargs):
+    inputs = [x for x in (data, label, data_lengths, label_lengths)
+              if x is not None]
+    attrs = dict(kwargs)
+    attrs.setdefault("use_data_lengths", data_lengths is not None)
+    attrs.setdefault("use_label_lengths", label_lengths is not None)
+    return invoke("CTCLoss", inputs, attrs)
+
+
+def Proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    return invoke("_contrib_Proposal", [cls_prob, bbox_pred, im_info], kwargs)
+
+
+def DeformableConvolution(data, offset, weight, bias=None, **kwargs):
+    inputs = [x for x in (data, offset, weight, bias) if x is not None]
+    return invoke("_contrib_DeformableConvolution", inputs, kwargs)
+
+
+def PSROIPooling(data, rois, **kwargs):
+    return invoke("_contrib_PSROIPooling", [data, rois], kwargs)
+
+
+def SyncBatchNorm(data, gamma, beta, moving_mean, moving_var, **kwargs):
+    out = invoke("_contrib_SyncBatchNorm",
+                 [data, gamma, beta, moving_mean, moving_var], kwargs)
+    return out[0] if isinstance(out, (list, tuple)) else out
 
 
 def quantize(data, min_range, max_range, out_type="uint8"):

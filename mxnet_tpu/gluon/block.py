@@ -374,7 +374,20 @@ class HybridBlock(Block):
         return self.forward(*args)
 
     def forward(self, x, *args):
-        """Eager path: resolve params on x's context and call hybrid_forward."""
+        """Eager path: resolve params on x's context and call hybrid_forward.
+
+        With Symbol inputs, builds the symbolic graph instead (reference
+        HybridBlock.forward symbol branch): params enter as their ``var()``
+        placeholders and F is the sym module."""
+        from ..symbol import Symbol
+        if isinstance(x, Symbol):
+            from .. import symbol as sym_mod
+            params = {k: v.var() for k, v in self._reg_params.items()}
+            self._in_hybrid_forward = True
+            try:
+                return self.hybrid_forward(sym_mod, x, *args, **params)
+            finally:
+                self._in_hybrid_forward = False
         ctx = x.context if isinstance(x, NDArray) else current_context()
         try:
             params = {k: v.data(ctx) for k, v in self._reg_params.items()}

@@ -131,9 +131,10 @@ class KLDivLoss(Loss):
 
 
 class CTCLoss(Loss):
-    """Connectionist temporal classification loss (reference loss.py CTCLoss
-    over src/operator/contrib/ctc_loss.cc; computed here with a lax.scan
-    dynamic program — MXU-friendly batched alpha recursion)."""
+    """Connectionist temporal classification loss (reference gluon loss.py
+    CTCLoss).  Delegates to the registered CTCLoss op (ops/nn_ops.py) with
+    ``blank_label='last'`` exactly as the reference does — the op runs the
+    log-semiring alpha recursion as one lax.scan."""
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
         assert layout in ("NTC", "TNC")
@@ -143,59 +144,18 @@ class CTCLoss(Loss):
         batch_axis = label_layout.find("N")
         super().__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, pred_lengths=None, label_lengths=None,
-                       sample_weight=None):
-        import jax
-        import jax.numpy as jnp
-        from ..ndarray import NDArray, _wrap
-
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
         if self._layout == "NTC":
-            pred_v = pred._data.transpose(1, 0, 2) if isinstance(pred, NDArray) \
-                else pred.transpose((1, 0, 2))
-        else:
-            pred_v = pred._data if isinstance(pred, NDArray) else pred
-        label_v = label._data if isinstance(label, NDArray) else label
+            pred = F.transpose(pred, axes=(1, 0, 2))
         if self._label_layout == "TN":
-            label_v = label_v.T
-        T, B, C = pred_v.shape
-        L = label_v.shape[1]
-        logp = jax.nn.log_softmax(pred_v, axis=-1)
-        blank = 0
-        # extended label sequence with blanks: length 2L+1
-        ext = jnp.full((B, 2 * L + 1), blank, dtype=jnp.int32)
-        ext = ext.at[:, 1::2].set(label_v.astype(jnp.int32))
-        S = 2 * L + 1
-        neg_inf = -1e30
-        alpha0 = jnp.full((B, S), neg_inf)
-        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
-        alpha0 = alpha0.at[:, 1].set(
-            jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
-
-        same_as_prev2 = jnp.concatenate(
-            [jnp.ones((B, 2), dtype=bool),
-             ext[:, 2:] == ext[:, :-2]], axis=1)
-
-        def step(alpha, logp_t):
-            a = alpha
-            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), a[:, :-1]], axis=1)
-            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), a[:, :-2]], axis=1)
-            a2 = jnp.where(same_as_prev2, neg_inf, a2)
-            m = jnp.maximum(jnp.maximum(a, a1), a2)
-            m_safe = jnp.maximum(m, neg_inf)
-            sum_ = jnp.exp(a - m_safe) + jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe)
-            new_alpha = m_safe + jnp.log(jnp.maximum(sum_, 1e-37)) + \
-                jnp.take_along_axis(logp_t, ext, axis=1)
-            return new_alpha, None
-
-        alphaT, _ = jax.lax.scan(step, alpha0, logp[1:])
-        # loss = -log(alpha[T-1, S-1] + alpha[T-1, S-2])
-        last = alphaT if T > 1 else alpha0
-        m = jnp.maximum(last[:, -1], last[:, -2])
-        ll = m + jnp.log(jnp.exp(last[:, -1] - m) + jnp.exp(last[:, -2] - m))
-        loss_v = -ll
-        if isinstance(pred, NDArray):
-            return _wrap(loss_v, ctx=pred.context)
-        return loss_v
+            label = F.transpose(label, axes=(1, 0))
+        lengths = [x for x in (pred_lengths, label_lengths) if x is not None]
+        loss = F.CTCLoss(pred, label, *lengths,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None,
+                         blank_label="last")
+        return _apply_weighting(F, loss, self._weight, sample_weight)
 
 
 class HuberLoss(Loss):

@@ -160,10 +160,12 @@ class CompositeEvalMetric(EvalMetric):
         self.metrics.append(create(metric))
 
     def get_metric(self, index):
-        if not 0 <= index < len(self.metrics):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            # reference behavior: the error object is returned, not raised
             return ValueError("Metric index {} is out of range 0 and {}".format(
                 index, len(self.metrics)))
-        return self.metrics[index]
 
     def update_dict(self, labels, preds):
         for metric in self.metrics:

@@ -30,21 +30,7 @@ def _fire(callbacks, *args):
         callback(*args)
 
 
-def _with_lookahead(iterable):
-    """Yield (batch, upcoming) pairs; upcoming is None on the last batch.
-
-    The one-batch lookahead lets the fit loop call ``prepare`` on the next
-    batch (sparse row-id prefetch) while the current one is in flight.
-    """
-    it = iter(iterable)
-    try:
-        current = next(it)
-    except StopIteration:
-        return
-    for upcoming in it:
-        yield current, upcoming
-        current = upcoming
-    yield current, None
+_NO_BATCH = object()  # sentinel: iterator exhausted
 
 
 def _check_input_names(symbol, names, typename, throw):
@@ -185,24 +171,31 @@ class BaseModule:
             tic = time.time()
             eval_metric.reset()
             eval_name_vals = []
-            for nbatch, (data_batch, upcoming) in enumerate(
-                    _with_lookahead(train_data)):
+            batches = iter(train_data)
+            data_batch = next(batches, _NO_BATCH)
+            nbatch = 0
+            while data_batch is not _NO_BATCH:
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
                 self._metric_from_batch(eval_metric, data_batch)
-                if upcoming is not None:
+                # only fetch the next batch AFTER training on this one — a
+                # DataIter may reuse the previous batch's buffers on next()
+                upcoming = next(batches, _NO_BATCH)
+                if upcoming is not _NO_BATCH:
                     # prefetch hook for the next batch (e.g. sparse row pull)
                     self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
                 if monitor is not None:
                     monitor.toc_print()
-                if upcoming is None:
+                if upcoming is _NO_BATCH:
                     # snapshot before callbacks may auto-reset the metric
                     eval_name_vals = eval_metric.get_name_value()
                 _fire(batch_end_callback,
                       BatchEndParam(epoch=epoch, nbatch=nbatch,
                                     eval_metric=eval_metric, locals=locals()))
+                data_batch = upcoming
+                nbatch += 1
             for name, val in eval_name_vals:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()

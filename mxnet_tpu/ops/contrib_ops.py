@@ -22,6 +22,13 @@ def _jnp():
     return jnp
 
 
+from .nn_ops import _pair
+
+
+def _pair2(v):
+    return _pair(v, 2)
+
+
 # ---------------------------------------------------------------------------
 # SSD: MultiBoxPrior / MultiBoxTarget / MultiBoxDetection
 # ---------------------------------------------------------------------------
@@ -331,9 +338,301 @@ def _roi_align(attrs, data, rois):
     return jax.vmap(per_roi)(rois)
 
 
-@register("_contrib_Proposal")
+def _generate_anchors(feature_stride, ratios, scales):
+    """py-faster-rcnn base anchors (proposal.cc GenerateAnchors), numpy."""
+    base = _np.array([0, 0, feature_stride - 1, feature_stride - 1], _np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size_r = (w * h) / r
+        ws = _np.round(_np.sqrt(size_r))
+        hs = _np.round(ws * r)
+        for s in scales:
+            sw, sh = ws * s, hs * s
+            anchors.append([cx - 0.5 * (sw - 1), cy - 0.5 * (sh - 1),
+                            cx + 0.5 * (sw - 1), cy + 0.5 * (sh - 1)])
+    return _np.asarray(anchors, _np.float32)  # (A, 4)
+
+
+@register("_contrib_Proposal",
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1)
 def _proposal(attrs, cls_prob, bbox_pred, im_info):
-    raise NotImplementedError("Proposal op: RCNN stage widening item")
+    """RPN proposal generation (src/operator/contrib/proposal.cc).
+
+    cls_prob (N, 2A, H, W), bbox_pred (N, 4A, H, W), im_info (N, 3) ->
+    rois (N*rpn_post_nms_top_n, 5) [batch_idx, x1, y1, x2, y2]
+    (+ scores if output_score).
+
+    TPU-native: fixed-size everything — top-k selection + a fori_loop NMS over
+    the sorted prefix; short outputs are filled by cycling kept boxes like the
+    reference (keep[i % out_size]).  The grad is defined as zero (reference
+    Backward assigns 0).
+    """
+    import jax
+    jnp = _jnp()
+    from jax import lax
+    pre_n = int(attrs.get("rpn_pre_nms_top_n", 6000))
+    post_n = int(attrs.get("rpn_post_nms_top_n", 300))
+    thresh = float(attrs.get("threshold", 0.7))
+    min_size = float(attrs.get("rpn_min_size", 16))
+    scales = tuple(float(s) for s in attrs.get("scales", (4, 8, 16, 32)))
+    ratios = tuple(float(r) for r in attrs.get("ratios", (0.5, 1, 2)))
+    fs = int(attrs.get("feature_stride", 16))
+    output_score = bool(attrs.get("output_score", False))
+
+    N, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    base = _generate_anchors(fs, ratios, scales)          # (A, 4)
+    sx = (_np.arange(W) * fs).astype(_np.float32)
+    sy = (_np.arange(H) * fs).astype(_np.float32)
+    # layout index = h*(W*A) + w*A + a (reference workspace ordering)
+    shifts = _np.stack(
+        [_np.tile(sx[None, :, None], (H, 1, A)),
+         _np.tile(sy[:, None, None], (1, W, A)),
+         _np.tile(sx[None, :, None], (H, 1, A)),
+         _np.tile(sy[:, None, None], (1, W, A))], axis=-1)  # (H, W, A, 4)
+    anchors = jnp.asarray((shifts + base[None, None]).reshape(-1, 4))
+    M = H * W * A
+    K1 = min(pre_n, M)
+
+    def one_image(scores_hw, deltas_hw, info):
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        # scores: fg half, (A, H, W) -> flat in (h, w, a) order
+        score = jnp.transpose(scores_hw[A:], (1, 2, 0)).reshape(-1)
+        d = deltas_hw.reshape(A, 4, H, W)
+        d = jnp.transpose(d, (2, 3, 0, 1)).reshape(-1, 4)  # (M, 4)
+        widths = anchors[:, 2] - anchors[:, 0] + 1.0
+        heights = anchors[:, 3] - anchors[:, 1] + 1.0
+        ctr_x = anchors[:, 0] + 0.5 * (widths - 1.0)
+        ctr_y = anchors[:, 1] + 0.5 * (heights - 1.0)
+        pred_cx = d[:, 0] * widths + ctr_x
+        pred_cy = d[:, 1] * heights + ctr_y
+        pred_w = jnp.exp(d[:, 2]) * widths
+        pred_h = jnp.exp(d[:, 3]) * heights
+        x1 = jnp.clip(pred_cx - 0.5 * (pred_w - 1.0), 0.0, im_w - 1.0)
+        y1 = jnp.clip(pred_cy - 0.5 * (pred_h - 1.0), 0.0, im_h - 1.0)
+        x2 = jnp.clip(pred_cx + 0.5 * (pred_w - 1.0), 0.0, im_w - 1.0)
+        y2 = jnp.clip(pred_cy + 0.5 * (pred_h - 1.0), 0.0, im_h - 1.0)
+        # invalidate feature positions past the real (unpadded) image extent
+        real_h = (im_h / fs).astype(jnp.int32)
+        real_w = (im_w / fs).astype(jnp.int32)
+        hh = jnp.repeat(jnp.arange(H), W * A)
+        ww = jnp.tile(jnp.repeat(jnp.arange(W), A), H)
+        score = jnp.where((hh >= real_h) | (ww >= real_w), -1.0, score)
+        # FilterBox: boxes smaller than min_size*im_scale are inflated and
+        # demoted (proposal.cc:140-158)
+        ms = min_size * im_scale
+        small = ((x2 - x1 + 1.0) < ms) | ((y2 - y1 + 1.0) < ms)
+        x1 = jnp.where(small, x1 - ms / 2, x1)
+        y1 = jnp.where(small, y1 - ms / 2, y1)
+        x2 = jnp.where(small, x2 + ms / 2, x2)
+        y2 = jnp.where(small, y2 + ms / 2, y2)
+        score = jnp.where(small, -1.0, score)
+
+        order = jnp.argsort(-score)[:K1]
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)[order]
+        kscore = score[order]
+        area = ((boxes[:, 2] - boxes[:, 0] + 1.0)
+                * (boxes[:, 3] - boxes[:, 1] + 1.0))
+
+        def nms_body(i, supp):
+            ix1 = jnp.maximum(boxes[i, 0], boxes[:, 0])
+            iy1 = jnp.maximum(boxes[i, 1], boxes[:, 1])
+            ix2 = jnp.minimum(boxes[i, 2], boxes[:, 2])
+            iy2 = jnp.minimum(boxes[i, 3], boxes[:, 3])
+            inter = (jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+                     * jnp.maximum(iy2 - iy1 + 1.0, 0.0))
+            iou = inter / (area[i] + area - inter)
+            kill = (~supp[i]) & (iou > thresh) & (jnp.arange(K1) > i)
+            return supp | kill
+
+        supp = lax.fori_loop(0, K1, nms_body, jnp.zeros((K1,), bool))
+        kept = ~supp
+        out_size = jnp.maximum(jnp.sum(kept.astype(jnp.int32)), 1)
+        rank = jnp.cumsum(kept.astype(jnp.int32)) - 1
+        keep_list = jnp.zeros((K1,), jnp.int32).at[
+            jnp.where(kept, rank, K1 - 1)].set(jnp.arange(K1, dtype=jnp.int32))
+        idx = jnp.arange(post_n) % out_size
+        sel = keep_list[jnp.clip(idx, 0, K1 - 1)]
+        return boxes[sel], kscore[sel]
+
+    rois, scores = jax.vmap(one_image)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=rois.dtype), post_n)
+    out = jnp.concatenate([batch_idx[:, None], rois.reshape(-1, 4)], axis=1)
+    out = lax.stop_gradient(out)
+    if output_score:
+        return out, lax.stop_gradient(scores.reshape(-1, 1))
+    return out
+
+
+@register("_contrib_DeformableConvolution")
+def _deformable_convolution(attrs, data, offset, weight, bias=None):
+    """Deformable convolution v1 (src/operator/contrib/deformable_convolution.cc).
+
+    data (N, C, H, W); offset (N, 2*ndg*kh*kw, Ho, Wo) with per-kernel-point
+    (dy, dx) pairs; weight (F, C/num_group, kh, kw).
+
+    TPU-native: instead of the reference's deformable-im2col CUDA kernel, the
+    bilinear sampling is a vectorized 4-corner gather producing
+    (N, C, K, Ho, Wo), and the contraction with the weights is one einsum —
+    which XLA maps onto the MXU as a batched matmul.
+    """
+    import jax
+    jnp = _jnp()
+    kh, kw = _pair2(attrs["kernel"])
+    sh, sw = _pair2(attrs.get("stride", (1, 1)))
+    ph, pw = _pair2(attrs.get("pad", (0, 0)))
+    dh, dw = _pair2(attrs.get("dilate", (1, 1)))
+    groups = int(attrs.get("num_group", 1))
+    ndg = int(attrs.get("num_deformable_group", 1))
+    N, C, H, W = data.shape
+    F = weight.shape[0]
+    K = kh * kw
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    off = offset.reshape(N, ndg, K, 2, Ho, Wo)
+    ky, kx = _np.meshgrid(_np.arange(kh), _np.arange(kw), indexing="ij")
+    base_y = (jnp.arange(Ho) * sh - ph)[None, :, None]   # (1, Ho, 1)
+    base_x = (jnp.arange(Wo) * sw - pw)[None, None, :]   # (1, 1, Wo)
+    kern_y = jnp.asarray(ky.reshape(-1) * dh)[:, None, None]  # (K, 1, 1)
+    kern_x = jnp.asarray(kx.reshape(-1) * dw)[:, None, None]
+    ys = base_y + kern_y + off[:, :, :, 0]   # (N, ndg, K, Ho, Wo)
+    xs = base_x + kern_x + off[:, :, :, 1]
+
+    def sample(img, y, x):
+        """img (C', H, W); y/x (K, Ho, Wo) -> (C', K, Ho, Wo), zero outside."""
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        out = 0.0
+        for oy, ox in ((0, 0), (0, 1), (1, 0), (1, 1)):
+            yi, xi = y0 + oy, x0 + ox
+            wgt = ((1.0 - jnp.abs(y - yi)) * (1.0 - jnp.abs(x - xi)))
+            valid = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            out = out + img[:, yc, xc] * (wgt * valid)[None]
+        return out
+
+    data_g = data.reshape(N, ndg, C // ndg, H, W)
+    sampled = jax.vmap(jax.vmap(sample))(data_g, ys, xs)  # (N, ndg, C/ndg, K, Ho, Wo)
+    sampled = sampled.reshape(N, C, K, Ho, Wo)
+    w = weight.reshape(groups, F // groups, C // groups, K)
+    s = sampled.reshape(N, groups, C // groups, K, Ho, Wo)
+    out = jnp.einsum("ngckhw,gfck->ngfhw", s, w).reshape(N, F, Ho, Wo)
+    if not attrs.get("no_bias", False) and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register("_contrib_PSROIPooling")
+def _psroi_pooling(attrs, data, rois):
+    """Position-sensitive ROI pooling (src/operator/contrib/psroi_pooling.cc).
+
+    data (N, output_dim*group_size^2, H, W); rois (R, 5) [batch, x1, y1, x2, y2]
+    -> (R, output_dim, pooled, pooled).  Each output bin averages one dedicated
+    channel group over its spatial cell.
+
+    TPU-native: the per-bin loops become two masked einsum contractions
+    (rows then columns), then a static fancy-index picks each bin's channel.
+    """
+    jnp = _jnp()
+    scale = float(attrs.get("spatial_scale", 1.0))
+    out_dim = int(attrs["output_dim"])
+    pooled = int(attrs["pooled_size"])
+    gs = int(attrs.get("group_size", 0)) or pooled
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+
+    batch_ind = rois[:, 0].astype(jnp.int32)
+    start_w = jnp.round(rois[:, 1]) * scale
+    start_h = jnp.round(rois[:, 2]) * scale
+    end_w = (jnp.round(rois[:, 3]) + 1.0) * scale
+    end_h = (jnp.round(rois[:, 4]) + 1.0) * scale
+    roi_w = jnp.maximum(end_w - start_w, 0.1)
+    roi_h = jnp.maximum(end_h - start_h, 0.1)
+    bin_h = roi_h / pooled       # (R,)
+    bin_w = roi_w / pooled
+    pidx = jnp.arange(pooled, dtype=jnp.float32)
+    hstart = jnp.clip(jnp.floor(pidx[None, :] * bin_h[:, None]
+                                + start_h[:, None]), 0, H).astype(jnp.int32)
+    hend = jnp.clip(jnp.ceil((pidx[None, :] + 1) * bin_h[:, None]
+                             + start_h[:, None]), 0, H).astype(jnp.int32)
+    wstart = jnp.clip(jnp.floor(pidx[None, :] * bin_w[:, None]
+                                + start_w[:, None]), 0, W).astype(jnp.int32)
+    wend = jnp.clip(jnp.ceil((pidx[None, :] + 1) * bin_w[:, None]
+                             + start_w[:, None]), 0, W).astype(jnp.int32)
+    hgrid = jnp.arange(H)
+    wgrid = jnp.arange(W)
+    mask_h = ((hgrid[None, None, :] >= hstart[:, :, None])
+              & (hgrid[None, None, :] < hend[:, :, None])).astype(data.dtype)
+    mask_w = ((wgrid[None, None, :] >= wstart[:, :, None])
+              & (wgrid[None, None, :] < wend[:, :, None])).astype(data.dtype)
+
+    gathered = data[batch_ind]                       # (R, C, H, W)
+    # exact summation: these contractions are masked sums, so keep the MXU
+    # at full precision rather than the bf16 default
+    t = jnp.einsum("rchw,rph->rcpw", gathered, mask_h, precision="highest")
+    t = jnp.einsum("rcpw,rqw->rcpq", t, mask_w, precision="highest")
+
+    # bin (ctop, ph, pw) reads channel (ctop*gs + gh)*gs + gw
+    gh = _np.clip(_np.arange(pooled) * gs // pooled, 0, gs - 1)
+    gw = gh
+    c_idx = ((_np.arange(out_dim)[:, None, None] * gs + gh[None, :, None]) * gs
+             + gw[None, None, :])                     # (out_dim, P, P)
+    sel = t[:, c_idx, _np.arange(pooled)[None, :, None],
+            _np.arange(pooled)[None, None, :]]        # (R, out_dim, P, P)
+
+    bin_area = ((hend - hstart)[:, None, :, None]
+                * (wend - wstart)[:, None, None, :]).astype(data.dtype)
+    empty = bin_area <= 0
+    return jnp.where(empty, 0.0, sel / jnp.maximum(bin_area, 1.0))
+
+
+@register("_contrib_count_sketch")
+def _count_sketch(attrs, data, h, s):
+    """Count sketch projection (src/operator/contrib/count_sketch.cc).
+
+    data (N, in_dim), hash buckets h (1, in_dim) in [0, out_dim), signs s
+    (1, in_dim) in {-1, +1} -> (N, out_dim) with
+    out[n, h[i]] += s[i] * data[n, i].  One scatter-add per batch on TPU.
+    """
+    jnp = _jnp()
+    out_dim = int(attrs["out_dim"])
+    n = data.shape[0]
+    idx = h.reshape(-1).astype(_jnp().int32)
+    signed = data * s.reshape(1, -1)
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, idx].add(signed)
+
+
+@register("_contrib_fft")
+def _fft(attrs, data):
+    """1-D FFT over the last axis (src/operator/contrib/fft-inl.h).
+
+    Real input (..., d) -> (..., 2d) with interleaved [re, im] pairs, matching
+    the reference's cufftComplex layout (unnormalized forward transform).
+    """
+    jnp = _jnp()
+    out = jnp.fft.fft(data.astype(jnp.float32))
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (-1,)).astype(data.dtype)
+
+
+@register("_contrib_ifft")
+def _ifft(attrs, data):
+    """1-D inverse FFT (src/operator/contrib/ifft-inl.h).
+
+    Interleaved complex input (..., 2d) -> real (..., d); unnormalized like
+    cuFFT (the reference test divides by d to compare with numpy)."""
+    jnp = _jnp()
+    x = data.astype(jnp.float32)
+    x = x.reshape(x.shape[:-1] + (-1, 2))
+    comp = x[..., 0] + 1j * x[..., 1]
+    d = comp.shape[-1]
+    return (jnp.fft.ifft(comp).real * d).astype(data.dtype)
 
 
 # ---------------------------------------------------------------------------

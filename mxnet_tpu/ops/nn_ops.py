@@ -705,7 +705,200 @@ def _rnn(attrs, data, parameters, state, state_cell=None):
 
 @register("Correlation")
 def _correlation(attrs, data1, data2):
-    raise NotImplementedError("Correlation op: planned (optical-flow workloads)")
+    """FlowNet correlation layer (src/operator/correlation.cc:40-82).
+
+    For every output position the kernel-window inner product (or abs
+    difference) between data1 and data2 displaced by each offset in the
+    (2*max_displacement/stride2+1)^2 neighborhood, averaged over
+    kernel_size^2 * channels.
+
+    TPU-native: instead of the reference's per-pixel scalar loop, each of the
+    D^2 displacements becomes one shifted elementwise product + strided
+    window-sum — all static slices, so XLA fuses the whole neighborhood into
+    a few vectorized kernels.
+    """
+    jnp = _jnp()
+    K = int(attrs.get("kernel_size", 1))
+    md = int(attrs.get("max_displacement", 1))
+    s1 = int(attrs.get("stride1", 1))
+    s2 = int(attrs.get("stride2", 1))
+    pad = int(attrs.get("pad_size", 0))
+    is_multiply = bool(attrs.get("is_multiply", True))
+    N, C, H, W = data1.shape
+    kr = (K - 1) // 2
+    border = md + kr
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    top_h = -(-(Hp - 2 * border) // s1)   # ceil-div, reference shape math
+    top_w = -(-(Wp - 2 * border) // s1)
+    grid_r = md // s2
+    D = 2 * grid_r + 1
+    # padded frames, NHWC; data2 gets an extra max_displacement margin so
+    # every displacement is a static in-bounds slice
+    y_hi = md + (top_h - 1) * s1 + K      # one past the last row data1 reads
+    x_hi = md + (top_w - 1) * s1 + K
+    HA, WA = max(Hp, y_hi), max(Wp, x_hi)
+    t1 = jnp.zeros((N, HA, WA, C), data1.dtype)
+    t1 = t1.at[:, pad:pad + H, pad:pad + W].set(jnp.transpose(data1, (0, 2, 3, 1)))
+    t2 = jnp.zeros((N, HA + 2 * md, WA + 2 * md, C), data2.dtype)
+    t2 = t2.at[:, md + pad:md + pad + H, md + pad:md + pad + W].set(
+        jnp.transpose(data2, (0, 2, 3, 1)))
+    scale = 1.0 / (K * K * C)
+    channels = []
+    for dy in range(-grid_r, grid_r + 1):
+        for dx in range(-grid_r, grid_r + 1):
+            shifted = t2[:, md + dy * s2:md + dy * s2 + HA,
+                         md + dx * s2:md + dx * s2 + WA]
+            if is_multiply:
+                prod = jnp.sum(t1 * shifted, axis=-1)     # (N, HA, WA)
+            else:
+                prod = jnp.sum(jnp.abs(t1 - shifted), axis=-1)
+            acc = 0.0
+            for h in range(K):
+                for w in range(K):
+                    acc = acc + prod[:, md + h:md + h + (top_h - 1) * s1 + 1:s1,
+                                     md + w:md + w + (top_w - 1) * s1 + 1:s1]
+            channels.append(acc * scale)
+    # channel order: tc = (dy+grid_r)*D + (dx+grid_r) (s2p from tc//D)
+    return jnp.stack(channels, axis=1)
+
+
+@register("CTCLoss")
+def _ctc_loss(attrs, data, label, data_lengths=None, label_lengths=None):
+    """Connectionist Temporal Classification loss (src/operator/nn/ctc_loss.cc).
+
+    data: (T, N, C) unnormalized activations (softmax applied internally, like
+    warp-ctc); label: (N, L) int indices; returns per-example loss (N,).
+    blank_label='first' reserves channel 0 (labels are >=1, padding 0);
+    'last' reserves channel C-1 (labels 0-indexed, padding -1).
+
+    TPU-native: the alpha recursion runs in the log semiring under one
+    ``lax.scan`` over time — a single compiled loop, batched over N, and
+    differentiable (the reference ships a hand-written backward; here the
+    scan's VJP provides it).
+    """
+    import jax
+    jnp = _jnp()
+    lax = _lax()
+    T, N, C = data.shape
+    blank_first = str(attrs.get("blank_label", "first")) == "first"
+    blank = 0 if blank_first else C - 1
+    pad_val = 0 if blank_first else -1
+    NEG = jnp.asarray(-1e30, jnp.float32)
+
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    label = label.astype(jnp.int32)
+    L = label.shape[1]
+    # optional inputs arrive positionally in (data_lengths, label_lengths)
+    # order, but when only use_label_lengths is set the single extra input IS
+    # the label lengths (reference CTCLossOpNumInputs, ctc_loss.cc)
+    use_dl = bool(attrs.get("use_data_lengths", False))
+    use_ll = bool(attrs.get("use_label_lengths", False))
+    extras = [x for x in (data_lengths, label_lengths) if x is not None]
+    if not attrs:  # direct fcompute call: trust the keyword positions
+        use_dl, use_ll = data_lengths is not None, label_lengths is not None
+    dl = extras.pop(0) if use_dl and extras else None
+    ll = extras.pop(0) if use_ll and extras else None
+    if extras:
+        raise ValueError(
+            "CTCLoss got %d length input(s) not covered by use_data_lengths/"
+            "use_label_lengths — set the matching flag(s)" % len(extras))
+    if ll is not None:
+        lab_len = ll.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum((label != pad_val).astype(jnp.int32), axis=1)
+    if dl is not None:
+        seq_len = dl.astype(jnp.int32)
+    else:
+        seq_len = jnp.full((N,), T, jnp.int32)
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank  (length S)
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    pos = jnp.arange(S)
+    valid_s = pos[None, :] < (2 * lab_len + 1)[:, None]
+    # a position may also arrive from s-2 when its label differs from ext[s-2]
+    # (and is not blank) — the standard CTC skip transition
+    can_skip = jnp.zeros((N, S), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    def emit(t_logp, labels_ext):
+        return jnp.take_along_axis(t_logp, labels_ext, axis=1)  # (N, S)
+
+    alpha0 = jnp.full((N, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    if L > 0:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, emit(logp[0], ext)[:, 1], NEG))
+    alpha0 = jnp.where(valid_s, alpha0, NEG)
+
+    def step(alpha, t_and_logp):
+        t, lp = t_and_logp
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((N, 1), NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((N, 2), NEG), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        new = merged + emit(lp, ext)
+        new = jnp.where(valid_s, new, NEG)
+        # freeze finished sequences (t >= their data length)
+        new = jnp.where((t < seq_len)[:, None], new, alpha)
+        return new, None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(step, alpha0, (ts, logp[1:]))
+
+    end = 2 * lab_len  # index of final blank in the extended sequence
+    a_last = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(lab_len > 0, a_prev, NEG)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    return loss.astype(data.dtype)
+
+
+alias("ctc_loss", "CTCLoss")
+alias("_contrib_CTCLoss", "CTCLoss")
+alias("_contrib_ctc_loss", "CTCLoss")
+
+
+@register("_contrib_SyncBatchNorm", num_outputs=3, mode_dependent=True)
+def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
+    """Synchronized BatchNorm (src/operator/contrib/sync_batch_norm.cc).
+
+    The reference synchronizes batch statistics across ``ndev`` GPU workers
+    with a host-side barrier + shared buffer keyed by ``key``.  TPU-native:
+    when traced inside pjit/shard_map with a mesh axis named ``axis_name``
+    (default 'dp'), the batch mean and mean-of-squares ride one
+    ``lax.pmean`` over ICI; outside a mesh it degrades to plain BatchNorm.
+    Returns (out, mean, var) like BatchNorm; caller folds running stats.
+    """
+    jnp = _jnp()
+    lax = _lax()
+    eps = float(attrs.get("eps", 1e-3))
+    fix_gamma = bool(attrs.get("fix_gamma", True))
+    use_global = (bool(attrs.get("use_global_stats", False))
+                  or not attrs.get("_training", False))
+    axis_name = attrs.get("axis_name", "dp")
+    bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    axes = (0,) + tuple(range(2, data.ndim))
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if use_global:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=axes)
+        sq = jnp.mean(jnp.square(data), axis=axes)
+        try:  # inside shard_map/pmap with the axis bound: cross-device stats
+            mean = lax.pmean(mean, axis_name)
+            sq = lax.pmean(sq, axis_name)
+        except NameError:  # axis not bound: single-device semantics
+            pass
+        var = sq - jnp.square(mean)
+    inv = jnp.reshape(gamma, bshape) * lax.rsqrt(jnp.reshape(var, bshape) + eps)
+    out = (data - jnp.reshape(mean, bshape)) * inv + jnp.reshape(beta, bshape)
+    return out, mean, var
 
 
 @register("GridGenerator")
@@ -791,6 +984,9 @@ _get_op("Deconvolution").arg_spec = lambda attrs: (
     ["data", "weight"] + ([] if attrs.get("no_bias", True) else ["bias"]))
 _get_op("BatchNorm").arg_spec = ["data", "gamma", "beta",
                                  "aux:moving_mean", "aux:moving_var"]
+_get_op("_contrib_SyncBatchNorm").arg_spec = ["data", "gamma", "beta",
+                                              "aux:moving_mean", "aux:moving_var"]
+_get_op("CTCLoss").arg_spec = ["data", "label:label"]
 _get_op("LayerNorm").arg_spec = ["data", "gamma", "beta"]
 _get_op("InstanceNorm").arg_spec = ["data", "gamma", "beta"]
 _get_op("Embedding").arg_spec = ["data", "weight"]
@@ -915,6 +1111,7 @@ _get_op("FullyConnected").param_shape_fn = _fc_param_shapes
 _get_op("Convolution").param_shape_fn = _conv_param_shapes
 _get_op("Deconvolution").param_shape_fn = _deconv_param_shapes
 _get_op("BatchNorm").param_shape_fn = _bn_param_shapes
+_get_op("_contrib_SyncBatchNorm").param_shape_fn = _bn_param_shapes
 _get_op("LayerNorm").param_shape_fn = _ln_param_shapes
 _get_op("InstanceNorm").param_shape_fn = _in_param_shapes
 _get_op("Embedding").param_shape_fn = _embedding_param_shapes
