@@ -17,18 +17,25 @@ import mxnet_tpu as mx
 from mxnet_tpu import sym
 
 
-def sym_gen_factory(num_hidden, num_layers, num_embed, vocab_size):
+def sym_gen_factory(num_hidden, num_layers, num_embed, vocab_size,
+                    fused=True):
+    """Build per-bucket symbols with the legacy mx.rnn cell API (reference
+    example/rnn/bucketing/lstm_bucketing.py uses the same structure)."""
     def sym_gen(seq_len):
         data = sym.Variable("data")
         label = sym.Variable("softmax_label")
         embed = sym.Embedding(data, name="embed", input_dim=vocab_size,
                               output_dim=num_embed)
-        # (N, T, E) -> (T, N, E) for the fused RNN
-        tnc = sym.transpose(embed, axes=(1, 0, 2))
-        rnn = sym.RNN(tnc, name="lstm", state_size=num_hidden,
-                      num_layers=num_layers, mode="lstm", state_outputs=False)
-        ntc = sym.transpose(rnn, axes=(1, 0, 2))
-        pred = sym.Reshape(ntc, shape=(-1, num_hidden))
+        if fused:
+            cell = mx.rnn.FusedRNNCell(num_hidden, num_layers=num_layers,
+                                       mode="lstm", prefix="lstm_")
+        else:
+            cell = mx.rnn.SequentialRNNCell()
+            for i in range(num_layers):
+                cell.add(mx.rnn.LSTMCell(num_hidden, prefix="lstm_l%d_" % i))
+        outputs, _ = cell.unroll(seq_len, inputs=embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, num_hidden))
         pred = sym.FullyConnected(pred, name="pred", num_hidden=vocab_size)
         label_flat = sym.Reshape(label, shape=(-1,))
         out = sym.SoftmaxOutput(pred, label_flat, name="softmax")

@@ -55,6 +55,7 @@ from . import model
 from . import monitor
 from .monitor import Monitor
 from . import contrib
+from . import rnn
 from .executor import Executor
 from . import rtc  # compat shim: runtime kernels are Pallas on TPU
 

@@ -361,7 +361,12 @@ class HybridBlock(Block):
     def __call__(self, *args):
         for hook in self._forward_pre_hooks.values():
             hook(self, args)
-        if self._active and not self._in_hybrid_forward:
+        from ..symbol import Symbol
+        if args and isinstance(args[0], Symbol):
+            # symbolic tracing takes priority over the hybridized CachedOp
+            # (reference HybridBlock.__call__ dispatches on input type)
+            out = self._build_symbol(*args)
+        elif self._active and not self._in_hybrid_forward:
             out = self._call_cached_op(*args)
         else:
             out = self.forward(*args)
@@ -376,18 +381,11 @@ class HybridBlock(Block):
     def forward(self, x, *args):
         """Eager path: resolve params on x's context and call hybrid_forward.
 
-        With Symbol inputs, builds the symbolic graph instead (reference
-        HybridBlock.forward symbol branch): params enter as their ``var()``
-        placeholders and F is the sym module."""
+        Symbol inputs build the symbolic graph instead (reference
+        HybridBlock.forward symbol branch)."""
         from ..symbol import Symbol
         if isinstance(x, Symbol):
-            from .. import symbol as sym_mod
-            params = {k: v.var() for k, v in self._reg_params.items()}
-            self._in_hybrid_forward = True
-            try:
-                return self.hybrid_forward(sym_mod, x, *args, **params)
-            finally:
-                self._in_hybrid_forward = False
+            return self._build_symbol(x, *args)
         ctx = x.context if isinstance(x, NDArray) else current_context()
         try:
             params = {k: v.data(ctx) for k, v in self._reg_params.items()}
@@ -429,12 +427,15 @@ class HybridBlock(Block):
         nd.save("%s-%04d.params" % (path, epoch), arg_dict)
 
     def _build_symbol(self, *inputs):
-        """Run hybrid_forward with F=symbol to build a graph."""
+        """Run hybrid_forward with F=symbol to build a graph; params enter
+        as their ``var()`` placeholders."""
         from .. import symbol as sym_mod
         params = {k: v.var() for k, v in self._reg_params.items()}
-        if params or not self._children:
+        self._in_hybrid_forward = True
+        try:
             return self.hybrid_forward(sym_mod, *inputs, **params)
-        return self.hybrid_forward(sym_mod, *inputs)
+        finally:
+            self._in_hybrid_forward = False
 
 
 class SymbolBlock(HybridBlock):

@@ -272,7 +272,12 @@ class FusedRNN(Initializer):
         ngates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[self._mode]
         ndir = 2 if self._bidirectional else 1
         H = self._num_hidden
-        np_arr = arr.asnumpy()
+        # reference semantics: fall back to the global initializer when no
+        # per-matrix init was given (initializer.py FusedRNN docstring)
+        sub_init = self._init
+        if sub_init is None:
+            sub_init = getattr(desc, "global_init", None) or Uniform(0.07)
+        np_arr = _np.array(arr.asnumpy())  # asnumpy views are read-only
         # input size inferred from total length
         # total = sum_l sum_d (G*H*in_l + G*H*H) + 2*L*D*G*H
         L, D, G = self._num_layers, ndir, ngates
@@ -291,7 +296,7 @@ class FusedRNN(Initializer):
                     size = wshape[0] * wshape[1]
                     block = _np.empty(wshape, dtype=_np.float32)
                     tmp = _nd_array(block)
-                    self._init("%s_l%d_%s" % (str(desc), layer, wname), tmp)
+                    sub_init("%s_l%d_%s" % (str(desc), layer, wname), tmp)
                     np_arr[offset:offset + size] = tmp.asnumpy().reshape(-1)
                     offset += size
         for layer in range(L):

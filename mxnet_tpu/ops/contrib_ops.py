@@ -25,10 +25,6 @@ def _jnp():
 from .nn_ops import _pair
 
 
-def _pair2(v):
-    return _pair(v, 2)
-
-
 # ---------------------------------------------------------------------------
 # SSD: MultiBoxPrior / MultiBoxTarget / MultiBoxDetection
 # ---------------------------------------------------------------------------
@@ -481,10 +477,10 @@ def _deformable_convolution(attrs, data, offset, weight, bias=None):
     """
     import jax
     jnp = _jnp()
-    kh, kw = _pair2(attrs["kernel"])
-    sh, sw = _pair2(attrs.get("stride", (1, 1)))
-    ph, pw = _pair2(attrs.get("pad", (0, 0)))
-    dh, dw = _pair2(attrs.get("dilate", (1, 1)))
+    kh, kw = _pair(attrs["kernel"])
+    sh, sw = _pair(attrs.get("stride", (1, 1)))
+    ph, pw = _pair(attrs.get("pad", (0, 0)))
+    dh, dw = _pair(attrs.get("dilate", (1, 1)))
     groups = int(attrs.get("num_group", 1))
     ndg = int(attrs.get("num_deformable_group", 1))
     N, C, H, W = data.shape

@@ -210,6 +210,19 @@ def _upsampling(attrs, *inputs):
 # Normalization
 # ---------------------------------------------------------------------------
 
+def _bn_apply(attrs, data, gamma, beta, mean, var):
+    """Shared affine-normalize step of BatchNorm/SyncBatchNorm."""
+    jnp = _jnp()
+    eps = float(attrs.get("eps", 1e-3))
+    axis = int(attrs.get("axis", 1))
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    if bool(attrs.get("fix_gamma", True)):
+        gamma = jnp.ones_like(gamma)
+    inv = jnp.reshape(gamma, bshape) / jnp.sqrt(jnp.reshape(var, bshape) + eps)
+    return (data - jnp.reshape(mean, bshape)) * inv + jnp.reshape(beta, bshape)
+
+
 @register("BatchNorm", num_outputs=3, mode_dependent=True)
 def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     """Batch normalization (src/operator/nn/batch_norm.cc).
@@ -218,24 +231,15 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     returned mean/var are the batch statistics; the caller folds them into the
     running averages (functional aux-state update — see gluon/nn BatchNorm)."""
     jnp = _jnp()
-    eps = float(attrs.get("eps", 1e-3))
     axis = int(attrs.get("axis", 1))
-    fix_gamma = bool(attrs.get("fix_gamma", True))
     use_global = bool(attrs.get("use_global_stats", False)) or not attrs.get("_training", False)
-    axes = tuple(i for i in range(data.ndim) if i != axis)
-    bshape = [1] * data.ndim
-    bshape[axis] = data.shape[axis]
-    bshape = tuple(bshape)
-    if fix_gamma:
-        gamma = jnp.ones_like(gamma)
     if use_global:
         mean, var = moving_mean, moving_var
     else:
+        axes = tuple(i for i in range(data.ndim) if i != axis)
         mean = jnp.mean(data, axis=axes)
         var = jnp.var(data, axis=axes)
-    inv = jnp.reshape(gamma, bshape) / jnp.sqrt(jnp.reshape(var, bshape) + eps)
-    out = (data - jnp.reshape(mean, bshape)) * inv + jnp.reshape(beta, bshape)
-    return out, mean, var
+    return _bn_apply(attrs, data, gamma, beta, mean, var), mean, var
 
 
 @register("LayerNorm")
@@ -811,6 +815,12 @@ def _ctc_loss(attrs, data, label, data_lengths=None, label_lengths=None):
     else:
         seq_len = jnp.full((N,), T, jnp.int32)
 
+    if L == 0:
+        # no labels at all: the only path is all-blanks
+        t_mask = jnp.arange(T)[:, None] < seq_len[None, :]
+        total = jnp.sum(jnp.where(t_mask, logp[:, :, blank], 0.0), axis=0)
+        return (-total).astype(data.dtype)
+
     # extended label sequence: blank, l1, blank, l2, ..., blank  (length S)
     S = 2 * L + 1
     ext = jnp.full((N, S), blank, jnp.int32)
@@ -876,18 +886,13 @@ def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     """
     jnp = _jnp()
     lax = _lax()
-    eps = float(attrs.get("eps", 1e-3))
-    fix_gamma = bool(attrs.get("fix_gamma", True))
     use_global = (bool(attrs.get("use_global_stats", False))
                   or not attrs.get("_training", False))
     axis_name = attrs.get("axis_name", "dp")
-    bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
-    axes = (0,) + tuple(range(2, data.ndim))
-    if fix_gamma:
-        gamma = jnp.ones_like(gamma)
     if use_global:
         mean, var = moving_mean, moving_var
     else:
+        axes = (0,) + tuple(range(2, data.ndim))
         mean = jnp.mean(data, axis=axes)
         sq = jnp.mean(jnp.square(data), axis=axes)
         try:  # inside shard_map/pmap with the axis bound: cross-device stats
@@ -896,9 +901,7 @@ def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
         except NameError:  # axis not bound: single-device semantics
             pass
         var = sq - jnp.square(mean)
-    inv = jnp.reshape(gamma, bshape) * lax.rsqrt(jnp.reshape(var, bshape) + eps)
-    out = (data - jnp.reshape(mean, bshape)) * inv + jnp.reshape(beta, bshape)
-    return out, mean, var
+    return _bn_apply(attrs, data, gamma, beta, mean, var), mean, var
 
 
 @register("GridGenerator")

@@ -82,6 +82,30 @@ def _transpose(attrs, x):
     return _jnp().transpose(x, axes=axes)
 
 
+@register("SwapAxis")
+def _swap_axis(attrs, x):
+    """Swap two axes (src/operator/swapaxis.cc; dim1/dim2 attrs)."""
+    return _jnp().swapaxes(x, int(attrs.get("dim1", 0)), int(attrs.get("dim2", 0)))
+
+
+alias("swapaxes", "SwapAxis")
+
+
+@register("_rnn_state_like")
+def _rnn_state_like(attrs, ref):
+    """Zeros for an RNN begin state, batch size taken from ``ref``.
+
+    The reference resolves zero dims in state shapes (e.g. (0, H)) through
+    bidirectional shape inference at bind time; this repo's inference is a
+    forward abstract evaluation, so the legacy rnn cells emit this op instead:
+    every 0 in ``shape`` is replaced by ref.shape[ref_axis] at trace time.
+    """
+    jnp = _jnp()
+    b = ref.shape[int(attrs.get("ref_axis", 0))]
+    shape = tuple(b if int(s) == 0 else int(s) for s in attrs["shape"])
+    return jnp.zeros(shape, dtype=ref.dtype)
+
+
 @register("expand_dims")
 def _expand_dims(attrs, x):
     return _jnp().expand_dims(x, int(attrs["axis"]))

@@ -1,0 +1,230 @@
+"""Tests for the legacy mx.rnn package (reference:
+tests/python/unittest/test_rnn.py patterns).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _bind_unroll(cell, T, batch, feat, merge=None):
+    inputs = [sym.Variable("t%d_data" % i) for i in range(T)]
+    outputs, _ = cell.unroll(T, inputs, merge_outputs=merge)
+    if isinstance(outputs, list):
+        outputs = sym.Group(outputs)
+    shapes = {"t%d_data" % i: (batch, feat) for i in range(T)}
+    exe = outputs.simple_bind(ctx=mx.cpu(), **shapes)
+    return exe
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(10, prefix="rnn_")
+    exe = _bind_unroll(cell, 3, 4, 6)
+    args = sorted(set(exe.arg_dict) - {"t0_data", "t1_data", "t2_data"})
+    assert args == ["rnn_h2h_bias", "rnn_h2h_weight",
+                    "rnn_i2h_bias", "rnn_i2h_weight"]
+    outs = exe.forward()
+    assert len(outs) == 3 and all(o.shape == (4, 10) for o in outs)
+
+
+def test_lstm_cell_unroll_and_grad():
+    cell = mx.rnn.LSTMCell(8, prefix="lstm_")
+    inputs = [sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert len(states) == 2
+    grouped = sym.Group(outputs)
+    exe = grouped.simple_bind(ctx=mx.cpu(), grad_req="write",
+                              **{"t%d_data" % i: (2, 5) for i in range(3)})
+    for name, arr in exe.arg_dict.items():
+        arr[:] = np.random.RandomState(0).uniform(-0.1, 0.1, arr.shape)
+    outs = exe.forward(is_train=True)
+    assert outs[0].shape == (2, 8)
+    exe.backward([nd.ones((2, 8)) for _ in range(3)])
+    gnorm = float(np.abs(exe.grad_dict["lstm_i2h_weight"].asnumpy()).sum())
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_gru_cell_step():
+    cell = mx.rnn.GRUCell(6, prefix="gru_")
+    x = sym.Variable("x")
+    states = cell.begin_state(func=sym.Variable)
+    out, new_states = cell(x, states)
+    exe = out.simple_bind(ctx=mx.cpu(), x=(3, 4),
+                          gru_begin_state_0=(3, 6))
+    outs = exe.forward()
+    assert outs[0].shape == (3, 6)
+
+
+def test_sequential_stack():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(8, prefix="l1_"))
+    inputs = sym.Variable("data")  # (N, T, C)
+    outputs, states = stack.unroll(4, inputs, merge_outputs=True)
+    assert len(states) == 4
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 4, 5))
+    assert exe.forward()[0].shape == (2, 4, 8)
+
+
+def test_bidirectional_merge():
+    cell = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(4, prefix="l_"),
+                                    mx.rnn.LSTMCell(4, prefix="r_"))
+    outputs, _ = cell.unroll(3, sym.Variable("data"), merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 3, 5))
+    assert exe.forward()[0].shape == (2, 3, 8)  # 2x hidden when bidirectional
+
+
+def test_residual_and_zoneout_cells():
+    base = mx.rnn.RNNCell(5, prefix="res_")
+    res = mx.rnn.ResidualCell(base)
+    outputs, _ = res.unroll(2, sym.Variable("data"), merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 2, 5))
+    assert exe.forward()[0].shape == (2, 2, 5)
+
+    zo = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(5, prefix="zo_"),
+                            zoneout_outputs=0.5, zoneout_states=0.5)
+    outputs, _ = zo.unroll(2, sym.Variable("data"), merge_outputs=True)
+    exe2 = outputs.simple_bind(ctx=mx.cpu(), data=(2, 2, 5))
+    assert exe2.forward()[0].shape == (2, 2, 5)
+
+
+def test_dropout_cell_in_stack():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.RNNCell(6, prefix="a_"))
+    stack.add(mx.rnn.DropoutCell(0.5, prefix="do_"))
+    stack.add(mx.rnn.RNNCell(6, prefix="b_"))
+    outputs, _ = stack.unroll(3, sym.Variable("data"), merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 3, 4))
+    assert exe.forward()[0].shape == (2, 3, 6)
+
+
+def test_fused_cell_matches_unfused():
+    """FusedRNNCell (one RNN kernel) == its unfuse() stack, weight-for-weight."""
+    T, N, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(42)
+    x = rng.uniform(-1, 1, (N, T, I)).astype(np.float32)
+
+    fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="lstm_")
+    fo, _ = fused.unroll(T, sym.Variable("data"), merge_outputs=True)
+    fexe = fo.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    for name, arr in fexe.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.uniform(-0.5, 0.5, arr.shape)
+    fexe.arg_dict["data"][:] = x
+    fused_out = fexe.forward()[0].asnumpy()
+
+    stack = fused.unfuse()
+    uo, _ = stack.unroll(T, sym.Variable("data"), merge_outputs=True)
+    uexe = uo.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    unpacked = fused.unpack_weights({k: v for k, v in fexe.arg_dict.items()
+                                     if k != "data"})
+    repacked = stack.pack_weights(unpacked)
+    for name, arr in uexe.arg_dict.items():
+        if name == "data":
+            arr[:] = x
+        else:
+            arr[:] = repacked[name]
+    unfused_out = uexe.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    cell = mx.rnn.LSTMCell(4, prefix="lstm_")
+    args = {"lstm_i2h_weight": nd.array(np.random.rand(16, 3)),
+            "lstm_i2h_bias": nd.array(np.random.rand(16)),
+            "lstm_h2h_weight": nd.array(np.random.rand(16, 4)),
+            "lstm_h2h_bias": nd.array(np.random.rand(16))}
+    unpacked = cell.unpack_weights(args)
+    assert "lstm_i2h_i_weight" in unpacked
+    assert unpacked["lstm_i2h_f_weight"].shape == (4, 3)
+    packed = cell.pack_weights(unpacked)
+    for k, v in args.items():
+        np.testing.assert_allclose(packed[k].asnumpy(), v.asnumpy())
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["the", "cat", "sat"], ["a", "dog", "ran", "far"],
+                 ["the", "dog"], ["a", "cat", "sat"]]
+    encoded, vocab = mx.rnn.encode_sentences(sentences, start_label=1)
+    assert all(isinstance(i, int) for s in encoded for i in s)
+    assert len(set(vocab.values())) == len(vocab)
+
+    data = [list(np.random.randint(1, 20, size=l))
+            for l in [3, 3, 3, 4, 4, 4, 4, 7]]
+    it = mx.rnn.BucketSentenceIter(data, batch_size=2, buckets=[4, 8],
+                                   invalid_label=0)
+    batches = list(it)
+    assert batches, "iterator yielded no batches"
+    for b in batches:
+        assert b.data[0].shape in ((2, 4), (2, 8))
+        # label is data shifted left by one
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+    it.reset()
+    assert len(list(it)) == len(batches)
+
+
+def test_bucket_iter_tn_layout():
+    data = [list(np.random.randint(1, 9, size=4)) for _ in range(6)]
+    it = mx.rnn.BucketSentenceIter(data, batch_size=2, buckets=[4],
+                                   layout="TN")
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 2)
+
+
+def test_bucketing_module_with_rnn_cells():
+    """End-to-end: BucketingModule + mx.rnn stack trains (ref example/rnn)."""
+    vocab, emb, H = 16, 8, 10
+    buckets = [4, 6]
+    batch = 4
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab, output_dim=emb,
+                              name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(H, prefix="lstm_l0_"))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, H))
+        pred = sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label_f = sym.Reshape(label, shape=(-1,))
+        return sym.SoftmaxOutput(pred, label_f, name="softmax"), \
+            ["data"], ["softmax_label"]
+
+    sentences = [list(np.random.randint(1, vocab, size=l))
+                 for l in [3, 3, 3, 3, 5, 5, 5, 5] * 3]
+    it = mx.rnn.BucketSentenceIter(sentences, batch, buckets=buckets,
+                                   invalid_label=0)
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=max(buckets),
+                                 context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    metric = mx.metric.Perplexity(ignore_label=None)
+    for _ in range(2):
+        it.reset()
+        metric.reset()
+        for batch_data in it:
+            mod.forward(batch_data)
+            mod.update_metric(metric, batch_data.label)
+            mod.backward()
+            mod.update()
+    assert np.isfinite(metric.get()[1])
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mx.rnn.LSTMCell(4, prefix="lstm_")
+    outputs, _ = cell.unroll(2, sym.Variable("data"), merge_outputs=True)
+    args = {"lstm_i2h_weight": nd.array(np.random.rand(16, 3)),
+            "lstm_i2h_bias": nd.array(np.random.rand(16)),
+            "lstm_h2h_weight": nd.array(np.random.rand(16, 4)),
+            "lstm_h2h_bias": nd.array(np.random.rand(16))}
+    prefix = str(tmp_path / "model")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 1, outputs, args, {})
+    s2, a2, _ = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
+    for k, v in args.items():
+        np.testing.assert_allclose(a2[k].asnumpy(), v.asnumpy(), rtol=1e-6)

@@ -69,6 +69,32 @@ def test_ctc_loss_variable_data_lengths():
     np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
 
 
+def test_ctc_loss_empty_label_matrix():
+    # L=0: the only valid path is all blanks
+    rng = np.random.RandomState(20)
+    T, N, C = 5, 2, 4
+    data = rng.randn(T, N, C).astype(np.float32)
+    label = np.zeros((N, 0), np.int32)
+    out = invoke("CTCLoss", [nd.array(data), nd.array(label)], {}).asnumpy()
+    import torch
+    import torch.nn.functional as F
+    logp = F.log_softmax(torch.from_numpy(data), dim=-1)
+    want = -logp[:, :, 0].sum(dim=0).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridized_block_symbol_input():
+    # a hybridized block must still trace symbolically (review regression)
+    import mxnet_tpu.gluon as gluon
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    net(nd.zeros((2, 4)))
+    net.hybridize()
+    net(nd.zeros((2, 4)))
+    s = net(mx.sym.Variable("data"))
+    assert type(s).__name__ == "Symbol"
+
+
 def test_ctc_loss_gradient_flows():
     import jax
     import jax.numpy as jnp
