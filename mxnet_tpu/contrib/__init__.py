@@ -5,3 +5,4 @@ from . import control_flow
 from .control_flow import foreach, while_loop, cond
 from . import autograd  # old-API shim
 from . import quantization
+from . import onnx

@@ -1,0 +1,14 @@
+"""ONNX interchange (reference: python/mxnet/contrib/onnx/).
+
+No external ``onnx`` dependency: the wire format is handled by a
+protoc-generated module from the stable ONNX IR schema
+(``onnx.proto`` in this directory), so exported files interoperate with
+standard ONNX tooling and standard ``.onnx`` files load here.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model, get_model_metadata
+
+# reference-compatible aliases
+import_to_gluon = None  # gluon import arrives with SymbolBlock.imports
+mx2onnx_export = export_model
+onnx2mx_import = import_model

@@ -1,0 +1,397 @@
+"""Symbol+params -> ONNX export (reference:
+python/mxnet/contrib/onnx/mx2onnx/export_model.py + _op_translations.py).
+
+The graph walk emits one (or a few) ONNX nodes per mxnet op via the
+converter table below; parameters become initializers with raw_data
+payloads.  Opset 11 semantics.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from . import onnx_pb2 as op_pb
+
+TENSOR_TYPE = {
+    _np.dtype(_np.float32): op_pb.TensorProto.FLOAT,
+    _np.dtype(_np.float64): op_pb.TensorProto.DOUBLE,
+    _np.dtype(_np.float16): op_pb.TensorProto.FLOAT16,
+    _np.dtype(_np.int32): op_pb.TensorProto.INT32,
+    _np.dtype(_np.int64): op_pb.TensorProto.INT64,
+    _np.dtype(_np.int8): op_pb.TensorProto.INT8,
+    _np.dtype(_np.uint8): op_pb.TensorProto.UINT8,
+    _np.dtype(_np.bool_): op_pb.TensorProto.BOOL,
+}
+
+_CONVERTERS = {}
+
+
+def register_export(*op_names):
+    def deco(fn):
+        for name in op_names:
+            _CONVERTERS[name] = fn
+        return fn
+    return deco
+
+
+class _ExportContext:
+    """Mutable state of one export: nodes, initializers, name bookkeeping."""
+
+    def __init__(self, graph, params):
+        self.graph = graph
+        self.params = params
+        self._const_i = 0
+
+    def add_node(self, op_type, inputs, outputs, name, **attrs):
+        node = self.graph.node.add()
+        node.op_type = op_type
+        node.name = name
+        node.input.extend(inputs)
+        node.output.extend(outputs)
+        for key, value in attrs.items():
+            attr = node.attribute.add()
+            attr.name = key
+            if isinstance(value, float):
+                attr.type = op_pb.AttributeProto.FLOAT
+                attr.f = value
+            elif isinstance(value, bool) or isinstance(value, int):
+                attr.type = op_pb.AttributeProto.INT
+                attr.i = int(value)
+            elif isinstance(value, str):
+                attr.type = op_pb.AttributeProto.STRING
+                attr.s = value.encode()
+            elif isinstance(value, (list, tuple)):
+                if value and isinstance(value[0], float):
+                    attr.type = op_pb.AttributeProto.FLOATS
+                    attr.floats.extend(value)
+                else:
+                    attr.type = op_pb.AttributeProto.INTS
+                    attr.ints.extend(int(v) for v in value)
+            else:
+                raise TypeError("unsupported ONNX attr %s=%r" % (key, value))
+        return node
+
+    def add_initializer(self, name, array):
+        array = _np.ascontiguousarray(array)
+        tensor = self.graph.initializer.add()
+        tensor.name = name
+        tensor.dims.extend(array.shape)
+        tensor.data_type = TENSOR_TYPE[array.dtype]
+        tensor.raw_data = array.tobytes()
+        return name
+
+    def const_shape(self, values):
+        """An int64 constant initializer (for Reshape targets etc.)."""
+        self._const_i += 1
+        name = "_const_%d" % self._const_i
+        return self.add_initializer(name, _np.asarray(values, _np.int64))
+
+
+class _NodeNames:
+    """Unique graph names per node: mxnet symbols reference nodes by index
+    and tolerate duplicate names, ONNX references by name and does not."""
+
+    def __init__(self, nodes):
+        self._by_id = {}
+        seen = {}
+        for node in nodes:
+            if node.op is None:
+                # variables keep their names — they must match param keys
+                self._by_id[id(node)] = node.name
+                continue
+            count = seen.get(node.name, 0)
+            seen[node.name] = count + 1
+            self._by_id[id(node)] = node.name if count == 0 \
+                else "%s__%d" % (node.name, count)
+
+    def node(self, node):
+        return self._by_id[id(node)]
+
+    def outputs(self, node):
+        base = self._by_id[id(node)]
+        if node.num_outputs == 1:
+            return [base]
+        return ["%s_%d" % (base, i) for i in range(node.num_outputs)]
+
+    def inputs(self, node):
+        return [self.outputs(inp)[idx] for inp, idx in node.inputs]
+
+
+def _ints(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v)] * n
+
+
+# ----------------------------------------------------------------- converters
+
+@register_export("FullyConnected")
+def _export_fc(ctx, node, ins, outs):
+    no_bias = bool(node.attrs.get("no_bias", False))
+    if not node.attrs.get("flatten", True):
+        # per-position matmul on >2D input: x @ W^T (+ b)
+        wt = outs[0] + "_wT"
+        ctx.add_node("Transpose", [ins[1]], [wt], outs[0] + "_transpose",
+                     perm=[1, 0])
+        if no_bias:
+            ctx.add_node("MatMul", [ins[0], wt], outs, node.name)
+        else:
+            mm = outs[0] + "_mm"
+            ctx.add_node("MatMul", [ins[0], wt], [mm], outs[0] + "_matmul")
+            ctx.add_node("Add", [mm, ins[2]], outs, node.name)
+        return
+    flat = outs[0] + "_flat"
+    ctx.add_node("Flatten", [ins[0]], [flat], outs[0] + "_flatten", axis=1)
+    gemm_in = [flat, ins[1]] + ([] if no_bias else [ins[2]])
+    ctx.add_node("Gemm", gemm_in, outs, node.name,
+                 alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+@register_export("Convolution")
+def _export_conv(ctx, node, ins, outs):
+    kernel = _ints(node.attrs["kernel"])
+    nd = len(kernel)
+    stride = _ints(node.attrs.get("stride", [1] * nd), nd)
+    pad = _ints(node.attrs.get("pad", [0] * nd), nd)
+    dilate = _ints(node.attrs.get("dilate", [1] * nd), nd)
+    ctx.add_node("Conv", ins, outs, node.name,
+                 kernel_shape=kernel, strides=stride, pads=pad * 2,
+                 dilations=dilate,
+                 group=int(node.attrs.get("num_group", 1)))
+
+
+@register_export("Activation")
+def _export_activation(ctx, node, ins, outs):
+    op_type = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus"}[node.attrs.get("act_type", "relu")]
+    ctx.add_node(op_type, ins, outs, node.name)
+
+
+@register_export("LeakyReLU")
+def _export_leaky(ctx, node, ins, outs):
+    act = node.attrs.get("act_type", "leaky")
+    slope = float(node.attrs.get("slope", 0.25))
+    if act == "leaky":
+        ctx.add_node("LeakyRelu", ins, outs, node.name, alpha=slope)
+    elif act == "elu":
+        ctx.add_node("Elu", ins, outs, node.name, alpha=slope)
+    elif act == "prelu":
+        ctx.add_node("PRelu", ins, outs, node.name)
+    else:
+        raise NotImplementedError("ONNX export of LeakyReLU %s" % act)
+
+
+@register_export("Pooling")
+def _export_pooling(ctx, node, ins, outs):
+    pool = node.attrs.get("pool_type", "max")
+    if bool(node.attrs.get("global_pool", False)):
+        op_type = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[pool]
+        ctx.add_node(op_type, ins, outs, node.name)
+        return
+    kernel = _ints(node.attrs["kernel"])
+    nd = len(kernel)
+    stride = _ints(node.attrs.get("stride", [1] * nd), nd)
+    pad = _ints(node.attrs.get("pad", [0] * nd), nd)
+    op_type = {"max": "MaxPool", "avg": "AveragePool"}[pool]
+    extra = {}
+    if pool == "avg":
+        extra["count_include_pad"] = 1
+    ctx.add_node(op_type, ins, outs, node.name, kernel_shape=kernel,
+                 strides=stride, pads=pad * 2, **extra)
+
+
+@register_export("BatchNorm")
+def _export_bn(ctx, node, ins, outs):
+    ins = list(ins)
+    if bool(node.attrs.get("fix_gamma", True)):
+        # the mxnet runtime forces gamma to 1 under fix_gamma (the default);
+        # ONNX has no such flag, so bake the ones into the exported scale
+        gamma = ctx.params.get(ins[1])
+        if gamma is not None:
+            ins[1] = ctx.add_initializer(
+                outs[0] + "_gamma_fixed",
+                _np.ones(gamma.shape, _np.float32))
+    ctx.add_node("BatchNormalization", ins, outs[:1], node.name,
+                 epsilon=float(node.attrs.get("eps", 1e-3)),
+                 momentum=float(node.attrs.get("momentum", 0.9)))
+
+
+@register_export("Flatten")
+def _export_flatten(ctx, node, ins, outs):
+    ctx.add_node("Flatten", ins, outs, node.name, axis=1)
+
+
+@register_export("softmax")
+def _export_softmax(ctx, node, ins, outs):
+    ctx.add_node("Softmax", ins, outs, node.name,
+                 axis=int(node.attrs.get("axis", -1)))
+
+
+@register_export("SoftmaxOutput")
+def _export_softmax_output(ctx, node, ins, outs):
+    # inference export: the label input disappears, loss becomes Softmax
+    ctx.add_node("Softmax", ins[:1], outs, node.name, axis=1)
+
+
+@register_export("elemwise_add", "_plus", "broadcast_add")
+def _export_add(ctx, node, ins, outs):
+    ctx.add_node("Add", ins, outs, node.name)
+
+
+@register_export("elemwise_sub", "_minus", "broadcast_sub")
+def _export_sub(ctx, node, ins, outs):
+    ctx.add_node("Sub", ins, outs, node.name)
+
+
+@register_export("elemwise_mul", "_mul", "broadcast_mul")
+def _export_mul(ctx, node, ins, outs):
+    ctx.add_node("Mul", ins, outs, node.name)
+
+
+@register_export("elemwise_div", "_div", "broadcast_div")
+def _export_div(ctx, node, ins, outs):
+    ctx.add_node("Div", ins, outs, node.name)
+
+
+@register_export("add_n", "ElementWiseSum")
+def _export_add_n(ctx, node, ins, outs):
+    ctx.add_node("Sum", ins, outs, node.name)
+
+
+@register_export("Concat", "concat")
+def _export_concat(ctx, node, ins, outs):
+    ctx.add_node("Concat", ins, outs, node.name,
+                 axis=int(node.attrs.get("dim", 1)))
+
+
+@register_export("Reshape", "reshape")
+def _export_reshape(ctx, node, ins, outs):
+    shape = ctx.const_shape(_ints(node.attrs["shape"], 1))
+    ctx.add_node("Reshape", [ins[0], shape], outs, node.name)
+
+
+@register_export("Dropout")
+def _export_dropout(ctx, node, ins, outs):
+    ctx.add_node("Dropout", ins, outs[:1], node.name,
+                 ratio=float(node.attrs.get("p", 0.5)))
+
+
+@register_export("transpose")
+def _export_transpose(ctx, node, ins, outs):
+    axes = node.attrs.get("axes")
+    extra = {"perm": _ints(axes, 1)} if axes else {}
+    ctx.add_node("Transpose", ins, outs, node.name, **extra)
+
+
+@register_export("Embedding")
+def _export_embedding(ctx, node, ins, outs):
+    idx = outs[0] + "_idx"
+    ctx.add_node("Cast", [ins[0]], [idx], outs[0] + "_cast",
+                 to=int(op_pb.TensorProto.INT64))
+    ctx.add_node("Gather", [ins[1], idx], outs, node.name, axis=0)
+
+
+@register_export("LRN")
+def _export_lrn(ctx, node, ins, outs):
+    ctx.add_node("LRN", ins, outs[:1], node.name,
+                 alpha=float(node.attrs.get("alpha", 1e-4)),
+                 beta=float(node.attrs.get("beta", 0.75)),
+                 bias=float(node.attrs.get("knorm", 2.0)),
+                 size=int(node.attrs["nsize"]))
+
+
+@register_export("Cast", "cast")
+def _export_cast(ctx, node, ins, outs):
+    to = TENSOR_TYPE[_np.dtype(node.attrs["dtype"])]
+    ctx.add_node("Cast", ins, outs, node.name, to=int(to))
+
+
+@register_export("dot")
+def _export_dot(ctx, node, ins, outs):
+    ctx.add_node("MatMul", ins, outs, node.name)
+
+
+# ------------------------------------------------------------------- driver
+
+def export_model(sym, params, input_shape, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol + params dict to an ONNX file.
+
+    ``params`` may mix ``arg:``/``aux:``-prefixed keys (Module.get_params
+    style) or be plain name->NDArray.  Returns the file path.
+    """
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+    flat_params = {}
+    for key, value in params.items():
+        name = key.split(":", 1)[1] if key.startswith(("arg:", "aux:")) else key
+        flat_params[name] = value
+
+    model = op_pb.ModelProto()
+    model.ir_version = 7
+    model.producer_name = "mxnet_tpu"
+    opset = model.opset_import.add()
+    opset.domain = ""
+    opset.version = 11
+    graph = model.graph
+    graph.name = "mxnet_tpu_model"
+    ctx = _ExportContext(graph, flat_params)
+
+    nodes = sym._topo_nodes()
+    # label variables feeding ONLY loss heads vanish in the inference export
+    loss_labels, used_elsewhere = set(), set()
+    for node in nodes:
+        if node.op is None:
+            continue
+        for pos, (inp, _idx) in enumerate(node.inputs):
+            if inp.op is not None:
+                continue
+            if node.op == "SoftmaxOutput" and pos == 1:
+                loss_labels.add(inp.name)
+            else:
+                used_elsewhere.add(inp.name)
+    label_names = loss_labels - used_elsewhere - set(flat_params)
+    data_names = [n.name for n in nodes
+                  if n.op is None and n.name not in flat_params
+                  and n.name not in label_names]
+    if len(data_names) != len(input_shape):
+        raise ValueError("got %d input shapes for inputs %s"
+                         % (len(input_shape), data_names))
+
+    elem_type = TENSOR_TYPE[_np.dtype(input_type)]
+    for name, shape in zip(data_names, input_shape):
+        vi = graph.input.add()
+        vi.name = name
+        vi.type.tensor_type.elem_type = elem_type
+        for dim in shape:
+            vi.type.tensor_type.shape.dim.add().dim_value = int(dim)
+
+    names = _NodeNames(nodes)
+    for node in nodes:
+        if node.op is None:
+            if node.name in flat_params:
+                ctx.add_initializer(node.name,
+                                    flat_params[node.name].asnumpy())
+            continue
+        conv = _CONVERTERS.get(node.op)
+        if conv is None:
+            raise NotImplementedError(
+                "ONNX export not implemented for op %s" % node.op)
+        ins = [n for n in names.inputs(node) if n not in label_names]
+        conv(ctx, node, ins, names.outputs(node))
+        if verbose:
+            logging.info("converted %s (%s)", node.name, node.op)
+
+    produced = {o for n in graph.node for o in n.output}
+    for entry_node, idx in sym._entries:
+        out_name = names.outputs(entry_node)[idx]
+        if out_name not in produced and entry_node.op is not None:
+            raise ValueError("output %s was not produced" % out_name)
+        vi = graph.output.add()
+        vi.name = out_name
+        vi.type.tensor_type.elem_type = elem_type
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

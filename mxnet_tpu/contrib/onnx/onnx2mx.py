@@ -1,0 +1,367 @@
+"""ONNX -> Symbol import (reference:
+python/mxnet/contrib/onnx/onnx2mx/import_model.py + _op_translations.py).
+
+``import_model`` returns (sym, arg_params, aux_params) ready for
+``mx.mod.Module`` / ``gluon.SymbolBlock``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import onnx_pb2 as op_pb
+
+_NP_TYPE = {
+    op_pb.TensorProto.FLOAT: _np.float32,
+    op_pb.TensorProto.DOUBLE: _np.float64,
+    op_pb.TensorProto.FLOAT16: _np.float16,
+    op_pb.TensorProto.INT32: _np.int32,
+    op_pb.TensorProto.INT64: _np.int64,
+    op_pb.TensorProto.INT8: _np.int8,
+    op_pb.TensorProto.UINT8: _np.uint8,
+    op_pb.TensorProto.BOOL: _np.bool_,
+}
+
+_IMPORTERS = {}
+
+
+def register_import(*op_types):
+    def deco(fn):
+        for name in op_types:
+            _IMPORTERS[name] = fn
+        return fn
+    return deco
+
+
+def _tensor_to_numpy(tensor):
+    dtype = _NP_TYPE[tensor.data_type]
+    if tensor.raw_data:
+        arr = _np.frombuffer(tensor.raw_data, dtype=dtype)
+    elif tensor.float_data:
+        arr = _np.asarray(tensor.float_data, _np.float32).astype(dtype)
+    elif tensor.int64_data:
+        arr = _np.asarray(tensor.int64_data, _np.int64).astype(dtype)
+    elif tensor.int32_data:
+        arr = _np.asarray(tensor.int32_data, _np.int32).astype(dtype)
+    elif tensor.double_data:
+        arr = _np.asarray(tensor.double_data, _np.float64).astype(dtype)
+    else:
+        arr = _np.zeros(0, dtype)
+    return arr.reshape(tuple(tensor.dims))
+
+
+def _attrs(node):
+    out = {}
+    for attr in node.attribute:
+        kind = attr.type
+        if kind == op_pb.AttributeProto.FLOAT:
+            out[attr.name] = attr.f
+        elif kind == op_pb.AttributeProto.INT:
+            out[attr.name] = attr.i
+        elif kind == op_pb.AttributeProto.STRING:
+            out[attr.name] = attr.s.decode()
+        elif kind == op_pb.AttributeProto.FLOATS:
+            out[attr.name] = list(attr.floats)
+        elif kind == op_pb.AttributeProto.INTS:
+            out[attr.name] = [int(i) for i in attr.ints]
+        elif kind == op_pb.AttributeProto.TENSOR:
+            out[attr.name] = _tensor_to_numpy(attr.t)
+        else:
+            raise NotImplementedError("ONNX attribute type %d" % kind)
+    return out
+
+
+class _ImportContext:
+    def __init__(self):
+        self.values = {}      # output name -> Symbol
+        self.consts = {}      # initializer name -> numpy (for shape reads)
+        self.arg_params = {}
+        self.aux_params = {}
+
+    def sym(self, name):
+        from ... import symbol as sym_mod
+        if name not in self.values:
+            # initializer-backed variables carry their known shape so the
+            # executor's forward shape inference can always complete
+            const = self.consts.get(name)
+            shape = tuple(const.shape) if const is not None else None
+            self.values[name] = sym_mod.Variable(name, shape=shape)
+        return self.values[name]
+
+
+def _halve_pads(pads):
+    """ONNX [x1_begin, x2_begin, x1_end, x2_end] -> symmetric mxnet pad."""
+    if not pads:
+        return None
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if list(begin) != list(end):
+        raise NotImplementedError("asymmetric ONNX pads %s" % (pads,))
+    return [int(p) for p in begin]
+
+
+@register_import("Conv")
+def _import_conv(ctx, node, a, sym_mod):
+    weight = ctx.consts.get(node.input[1])
+    kwargs = {"kernel": tuple(a["kernel_shape"]),
+              "num_filter": int(weight.shape[0]) if weight is not None else 0,
+              "num_group": int(a.get("group", 1)),
+              "no_bias": len(node.input) < 3}
+    if a.get("strides"):
+        kwargs["stride"] = tuple(a["strides"])
+    if a.get("dilations"):
+        kwargs["dilate"] = tuple(a["dilations"])
+    pad = _halve_pads(a.get("pads"))
+    if pad:
+        kwargs["pad"] = tuple(pad)
+    ins = [ctx.sym(i) for i in node.input]
+    return sym_mod.Convolution(*ins, name=node.name or node.output[0], **kwargs)
+
+
+@register_import("Gemm")
+def _import_gemm(ctx, node, a, sym_mod):
+    if a.get("transA", 0):
+        raise NotImplementedError("Gemm with transA")
+    weight_name = node.input[1]
+    if not a.get("transB", 0):
+        # mxnet FC stores (hidden, in): transpose the initializer once
+        if weight_name in ctx.arg_params:
+            from ... import ndarray as nd
+            ctx.arg_params[weight_name] = nd.array(
+                ctx.arg_params[weight_name].asnumpy().T)
+            ctx.consts[weight_name] = ctx.consts[weight_name].T
+    weight = ctx.consts.get(weight_name)
+    ins = [ctx.sym(i) for i in node.input]
+    return sym_mod.FullyConnected(
+        *ins, name=node.name or node.output[0],
+        num_hidden=int(weight.shape[0]) if weight is not None else 0,
+        no_bias=len(node.input) < 3)
+
+
+@register_import("MatMul")
+def _import_matmul(ctx, node, a, sym_mod):
+    return sym_mod.dot(ctx.sym(node.input[0]), ctx.sym(node.input[1]),
+                       name=node.name or node.output[0])
+
+
+@register_import("Relu", "Sigmoid", "Tanh", "Softplus")
+def _import_activation(ctx, node, a, sym_mod):
+    act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+           "Softplus": "softrelu"}[node.op_type]
+    return sym_mod.Activation(ctx.sym(node.input[0]), act_type=act,
+                              name=node.name or node.output[0])
+
+
+@register_import("LeakyRelu")
+def _import_leaky(ctx, node, a, sym_mod):
+    return sym_mod.LeakyReLU(ctx.sym(node.input[0]), act_type="leaky",
+                             slope=float(a.get("alpha", 0.01)),
+                             name=node.name or node.output[0])
+
+
+@register_import("Elu")
+def _import_elu(ctx, node, a, sym_mod):
+    return sym_mod.LeakyReLU(ctx.sym(node.input[0]), act_type="elu",
+                             slope=float(a.get("alpha", 1.0)),
+                             name=node.name or node.output[0])
+
+
+@register_import("MaxPool", "AveragePool")
+def _import_pool(ctx, node, a, sym_mod):
+    kwargs = {"kernel": tuple(a["kernel_shape"]),
+              "pool_type": "max" if node.op_type == "MaxPool" else "avg"}
+    if a.get("strides"):
+        kwargs["stride"] = tuple(a["strides"])
+    pad = _halve_pads(a.get("pads"))
+    if pad:
+        kwargs["pad"] = tuple(pad)
+    return sym_mod.Pooling(ctx.sym(node.input[0]),
+                           name=node.name or node.output[0], **kwargs)
+
+
+@register_import("GlobalMaxPool", "GlobalAveragePool")
+def _import_global_pool(ctx, node, a, sym_mod):
+    pool = "max" if node.op_type == "GlobalMaxPool" else "avg"
+    return sym_mod.Pooling(ctx.sym(node.input[0]), kernel=(1, 1),
+                           global_pool=True, pool_type=pool,
+                           name=node.name or node.output[0])
+
+
+@register_import("BatchNormalization")
+def _import_bn(ctx, node, a, sym_mod):
+    # inputs: x, gamma, beta, mean, var — mean/var are aux states in mxnet
+    for aux in node.input[3:5]:
+        if aux in ctx.arg_params:
+            ctx.aux_params[aux] = ctx.arg_params.pop(aux)
+        if aux not in ctx.values:  # mark the variable as auxiliary state
+            ctx.values[aux] = sym_mod.Variable(aux, __is_aux__=True)
+    ins = [ctx.sym(i) for i in node.input]
+    bn = sym_mod.BatchNorm(*ins, name=node.name or node.output[0],
+                           eps=float(a.get("epsilon", 1e-5)),
+                           momentum=float(a.get("momentum", 0.9)),
+                           fix_gamma=False)
+    return bn[0]  # mxnet BN also emits mean/var; ONNX BN is single-output
+
+
+@register_import("Flatten")
+def _import_flatten(ctx, node, a, sym_mod):
+    return sym_mod.Flatten(ctx.sym(node.input[0]),
+                           name=node.name or node.output[0])
+
+
+@register_import("Softmax")
+def _import_softmax(ctx, node, a, sym_mod):
+    return sym_mod.softmax(ctx.sym(node.input[0]),
+                           axis=int(a.get("axis", -1)),
+                           name=node.name or node.output[0])
+
+
+_BROADCAST = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+              "Mul": "broadcast_mul", "Div": "broadcast_div"}
+
+
+@register_import("Add", "Sub", "Mul", "Div")
+def _import_binary(ctx, node, a, sym_mod):
+    fn = getattr(sym_mod, _BROADCAST[node.op_type])
+    return fn(ctx.sym(node.input[0]), ctx.sym(node.input[1]),
+              name=node.name or node.output[0])
+
+
+@register_import("Sum")
+def _import_sum(ctx, node, a, sym_mod):
+    return sym_mod.add_n(*[ctx.sym(i) for i in node.input],
+                         name=node.name or node.output[0])
+
+
+@register_import("Concat")
+def _import_concat(ctx, node, a, sym_mod):
+    return sym_mod.Concat(*[ctx.sym(i) for i in node.input],
+                          dim=int(a.get("axis", 1)),
+                          name=node.name or node.output[0])
+
+
+@register_import("Reshape")
+def _import_reshape(ctx, node, a, sym_mod):
+    shape = ctx.consts.get(node.input[1])
+    if shape is None:
+        raise NotImplementedError("Reshape with dynamic shape input")
+    ctx.arg_params.pop(node.input[1], None)
+    return sym_mod.Reshape(ctx.sym(node.input[0]),
+                           shape=tuple(int(s) for s in shape),
+                           name=node.name or node.output[0])
+
+
+@register_import("Transpose")
+def _import_transpose(ctx, node, a, sym_mod):
+    kwargs = {"axes": tuple(a["perm"])} if a.get("perm") else {}
+    return sym_mod.transpose(ctx.sym(node.input[0]),
+                             name=node.name or node.output[0], **kwargs)
+
+
+@register_import("Dropout")
+def _import_dropout(ctx, node, a, sym_mod):
+    return sym_mod.Dropout(ctx.sym(node.input[0]),
+                           p=float(a.get("ratio", 0.5)),
+                           name=node.name or node.output[0])
+
+
+@register_import("Identity")
+def _import_identity(ctx, node, a, sym_mod):
+    return ctx.sym(node.input[0])
+
+
+@register_import("Cast")
+def _import_cast(ctx, node, a, sym_mod):
+    dtype = _np.dtype(_NP_TYPE[int(a["to"])]).name
+    return sym_mod.Cast(ctx.sym(node.input[0]), dtype=dtype,
+                        name=node.name or node.output[0])
+
+
+@register_import("Gather")
+def _import_gather(ctx, node, a, sym_mod):
+    weight = ctx.consts.get(node.input[0])
+    if int(a.get("axis", 0)) == 0 and weight is not None and weight.ndim == 2:
+        return sym_mod.Embedding(ctx.sym(node.input[1]),
+                                 ctx.sym(node.input[0]),
+                                 input_dim=weight.shape[0],
+                                 output_dim=weight.shape[1],
+                                 name=node.name or node.output[0])
+    return sym_mod.take(ctx.sym(node.input[0]), ctx.sym(node.input[1]),
+                        axis=int(a.get("axis", 0)),
+                        name=node.name or node.output[0])
+
+
+@register_import("Constant")
+def _import_constant(ctx, node, a, sym_mod):
+    from ... import ndarray as nd
+    value = a["value"]
+    name = node.output[0]
+    ctx.consts[name] = value
+    ctx.arg_params[name] = nd.array(value)
+    return ctx.sym(name)
+
+
+@register_import("LRN")
+def _import_lrn(ctx, node, a, sym_mod):
+    return sym_mod.LRN(ctx.sym(node.input[0]),
+                       alpha=float(a.get("alpha", 1e-4)),
+                       beta=float(a.get("beta", 0.75)),
+                       knorm=float(a.get("bias", 1.0)),
+                       nsize=int(a["size"]),
+                       name=node.name or node.output[0])
+
+
+# ------------------------------------------------------------------- driver
+
+def _load_model_proto(model_file):
+    model = op_pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    return model
+
+
+def import_model(model_file):
+    """Import an ONNX file: returns (sym, arg_params, aux_params)."""
+    from ... import symbol as sym_mod
+    from ... import ndarray as nd
+
+    model = _load_model_proto(model_file)
+    graph = model.graph
+    ctx = _ImportContext()
+
+    for tensor in graph.initializer:
+        arr = _tensor_to_numpy(tensor)
+        ctx.consts[tensor.name] = arr
+        ctx.arg_params[tensor.name] = nd.array(arr)
+
+    for node in graph.node:
+        importer = _IMPORTERS.get(node.op_type)
+        if importer is None:
+            raise NotImplementedError(
+                "ONNX import not implemented for op %s" % node.op_type)
+        result = importer(ctx, node, _attrs(node), sym_mod)
+        outs = [result] if not isinstance(result, (list, tuple)) else result
+        for name, value in zip(node.output, list(outs)):
+            ctx.values[name] = value
+
+    outputs = [ctx.values[vi.name] for vi in graph.output]
+    sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
+    # params that were consumed as attrs (reshape targets) are already popped
+    return sym, ctx.arg_params, ctx.aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output names+shapes recorded in an ONNX file."""
+    graph = _load_model_proto(model_file).graph
+    inits = {t.name for t in graph.initializer}
+
+    def info(value_infos, skip=()):
+        out = []
+        for vi in value_infos:
+            if vi.name in skip:
+                continue
+            dims = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, dims))
+        return out
+
+    return {"input_tensor_data": info(graph.input, skip=inits),
+            "output_tensor_data": info(graph.output)}

@@ -25,7 +25,36 @@ from .. import ndarray as nd_mod
 from .. import autograd
 from ..cached_op import CachedOp
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
-from ..name import NameManager
+from ..name import NameManager, Prefix
+
+
+class _TraceNames(Prefix):
+    """Prefix name manager that keeps node names unique across one symbolic
+    trace.  Sibling blocks may share a prefix (gluon allows ``prefix=""``
+    children), and layers name their op nodes with fixed hints like "fwd" —
+    without trace-wide dedup, exported graphs would contain colliding names.
+    """
+
+    def __init__(self, prefix, seen):
+        super().__init__(prefix)
+        self._seen = seen
+
+    @classmethod
+    def nested(cls, prefix):
+        """A manager for `prefix` sharing the enclosing trace's seen-set."""
+        current = getattr(NameManager._current, "value", None)
+        seen = current._seen if isinstance(current, cls) else set()
+        return cls(prefix, seen)
+
+    def get(self, name, hint):
+        base = super().get(name, hint)
+        unique = base
+        suffix = 0
+        while unique in self._seen:
+            suffix += 1
+            unique = "%s_%d" % (base, suffix)
+        self._seen.add(unique)
+        return unique
 
 
 class _BlockScope:
@@ -428,12 +457,16 @@ class HybridBlock(Block):
 
     def _build_symbol(self, *inputs):
         """Run hybrid_forward with F=symbol to build a graph; params enter
-        as their ``var()`` placeholders."""
+        as their ``var()`` placeholders.  Node names are namespaced by this
+        block's prefix (reference: symbol composition inside the block's
+        name scope) and deduplicated across the whole trace, so repeated
+        layers get unique graph names."""
         from .. import symbol as sym_mod
         params = {k: v.var() for k, v in self._reg_params.items()}
         self._in_hybrid_forward = True
         try:
-            return self.hybrid_forward(sym_mod, *inputs, **params)
+            with _TraceNames.nested(self._prefix):
+                return self.hybrid_forward(sym_mod, *inputs, **params)
         finally:
             self._in_hybrid_forward = False
 

@@ -31,7 +31,7 @@ class AlexNet(HybridBlock):
                 self.features.add(Dropout(0.5))
             self.output = Dense(classes)
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -4,7 +4,6 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ...nn import (HybridSequential, Conv2D, MaxPool2D, AvgPool2D, BatchNorm,
                    Activation, Dense, GlobalAvgPool2D, Flatten, Dropout)
-from .... import ndarray as nd
 
 
 class _DenseLayer(HybridBlock):
@@ -20,9 +19,9 @@ class _DenseLayer(HybridBlock):
         if dropout:
             self.body.add(Dropout(dropout))
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         out = self.body(x)
-        return nd.concat(x, out, dim=1)
+        return F.concat(x, out, dim=1)
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
@@ -67,7 +66,7 @@ class DenseNet(HybridBlock):
             self.features.add(Flatten())
             self.output = Dense(classes)
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -4,7 +4,6 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ...nn import (HybridSequential, Conv2D, BatchNorm, Activation, MaxPool2D,
                    AvgPool2D, GlobalAvgPool2D, Dropout, Flatten, Dense)
-from .... import ndarray as nd
 
 
 def _make_basic_conv(**kwargs):
@@ -40,8 +39,8 @@ class _Concurrent(HybridBlock):
     def add(self, block):
         self.register_child(block)
 
-    def forward(self, x):
-        return nd.concat(*[child(x) for child in self._children.values()], dim=1)
+    def hybrid_forward(self, F, x):
+        return F.concat(*[child(x) for child in self._children.values()], dim=1)
 
 
 def _make_A(pool_features, prefix):
@@ -96,10 +95,10 @@ class _BranchE(HybridBlock):
         self.left = None
         self.right = None
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         if self.base is not None:
             x = self.base(x)
-        return nd.concat(self.left(x), self.right(x), dim=1)
+        return F.concat(self.left(x), self.right(x), dim=1)
 
 
 def _make_E(prefix):
@@ -151,7 +150,7 @@ class Inception3(HybridBlock):
             self.features.add(Dropout(0.5))
             self.output = Dense(classes)
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -4,7 +4,6 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ...nn import (HybridSequential, Conv2D, BatchNorm, Activation,
                    GlobalAvgPool2D, Flatten, Dense)
-from .... import ndarray as nd
 
 
 class RELU6(HybridBlock):
@@ -38,7 +37,7 @@ class LinearBottleneck(HybridBlock):
                       pad=1, num_group=in_channels * t, relu6=True)
             _add_conv(self.out, channels, active=False, relu6=True)
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         out = self.out(x)
         if self.use_shortcut:
             out = out + x
@@ -65,7 +64,7 @@ class MobileNet(HybridBlock):
                 self.features.add(Flatten())
             self.output = Dense(classes)
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x
@@ -101,7 +100,7 @@ class MobileNetV2(HybridBlock):
                                        prefix="pred_"))
                 self.output.add(Flatten())
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -33,13 +33,12 @@ class BasicBlockV1(HybridBlock):
         else:
             self.downsample = None
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         residual = x
         out = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
-        from .... import ndarray as nd
-        return nd.Activation(residual + out, act_type="relu")
+        return F.Activation(residual + out, act_type="relu")
 
 
 class BottleneckV1(HybridBlock):
@@ -62,13 +61,12 @@ class BottleneckV1(HybridBlock):
         else:
             self.downsample = None
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         residual = x
         out = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
-        from .... import ndarray as nd
-        return nd.Activation(residual + out, act_type="relu")
+        return F.Activation(residual + out, act_type="relu")
 
 
 class BasicBlockV2(HybridBlock):
@@ -84,16 +82,15 @@ class BasicBlockV2(HybridBlock):
         else:
             self.downsample = None
 
-    def forward(self, x):
-        from .... import ndarray as nd
+    def hybrid_forward(self, F, x):
         residual = x
         x = self.bn1(x)
-        x = nd.Activation(x, act_type="relu")
+        x = F.Activation(x, act_type="relu")
         if self.downsample:
             residual = self.downsample(x)
         x = self.conv1(x)
         x = self.bn2(x)
-        x = nd.Activation(x, act_type="relu")
+        x = F.Activation(x, act_type="relu")
         x = self.conv2(x)
         return x + residual
 
@@ -113,19 +110,18 @@ class BottleneckV2(HybridBlock):
         else:
             self.downsample = None
 
-    def forward(self, x):
-        from .... import ndarray as nd
+    def hybrid_forward(self, F, x):
         residual = x
         x = self.bn1(x)
-        x = nd.Activation(x, act_type="relu")
+        x = F.Activation(x, act_type="relu")
         if self.downsample:
             residual = self.downsample(x)
         x = self.conv1(x)
         x = self.bn2(x)
-        x = nd.Activation(x, act_type="relu")
+        x = F.Activation(x, act_type="relu")
         x = self.conv2(x)
         x = self.bn3(x)
-        x = nd.Activation(x, act_type="relu")
+        x = F.Activation(x, act_type="relu")
         x = self.conv3(x)
         return x + residual
 
@@ -163,7 +159,7 @@ class ResNetV1(HybridBlock):
                                 prefix=""))
         return layer
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x
@@ -198,7 +194,7 @@ class ResNetV2(HybridBlock):
 
     _make_layer = ResNetV1._make_layer
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

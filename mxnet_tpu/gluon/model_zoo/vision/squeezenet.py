@@ -4,7 +4,6 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ...nn import (HybridSequential, Conv2D, MaxPool2D, Dropout, AvgPool2D,
                    Flatten, Activation)
-from .... import ndarray as nd
 
 
 def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
@@ -20,9 +19,9 @@ def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
             self.left = left
             self.right = right
 
-        def forward(self, x):
+        def hybrid_forward(self, F, x):
             x = self.squeeze(x)
-            return nd.concat(self.left(x), self.right(x), dim=1)
+            return F.concat(self.left(x), self.right(x), dim=1)
 
     return Fire()
 
@@ -77,7 +76,7 @@ class SqueezeNet(HybridBlock):
             self.output.add(AvgPool2D(13))
             self.output.add(Flatten())
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -39,7 +39,7 @@ class VGG(HybridBlock):
             featurizer.add(MaxPool2D(strides=2))
         return featurizer
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

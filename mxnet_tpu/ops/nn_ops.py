@@ -223,7 +223,7 @@ def _bn_apply(attrs, data, gamma, beta, mean, var):
     return (data - jnp.reshape(mean, bshape)) * inv + jnp.reshape(beta, bshape)
 
 
-@register("BatchNorm", num_outputs=3, mode_dependent=True)
+@register("BatchNorm", num_outputs=3, visible_outputs=1, mode_dependent=True)
 def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     """Batch normalization (src/operator/nn/batch_norm.cc).
 
@@ -873,7 +873,8 @@ alias("_contrib_CTCLoss", "CTCLoss")
 alias("_contrib_ctc_loss", "CTCLoss")
 
 
-@register("_contrib_SyncBatchNorm", num_outputs=3, mode_dependent=True)
+@register("_contrib_SyncBatchNorm", num_outputs=3, visible_outputs=1,
+          mode_dependent=True)
 def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     """Synchronized BatchNorm (src/operator/contrib/sync_batch_norm.cc).
 

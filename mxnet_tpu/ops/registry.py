@@ -47,10 +47,15 @@ class Op:
     """
 
     def __init__(self, name, fcompute, num_outputs=1, needs_rng=False,
-                 mode_dependent=False, no_jit=False, doc=None):
+                 mode_dependent=False, no_jit=False, doc=None,
+                 visible_outputs=None):
         self.name = name
         self.fcompute = fcompute
         self.num_outputs = num_outputs
+        # FNumVisibleOutputs analog (nnvm): outputs beyond this count (e.g.
+        # BatchNorm's mean/var) are hidden when the symbol is composed into
+        # another op, but still bindable/executable on the symbol itself
+        self.visible_outputs = visible_outputs
         self.needs_rng = needs_rng
         self.mode_dependent = mode_dependent
         self.no_jit = no_jit

@@ -3,20 +3,34 @@ python/mxnet/symbol/register.py)."""
 from __future__ import annotations
 
 from ..ops.registry import get_op, list_ops
+from ..ndarray.register import _POS_ATTRS
 from .symbol import Symbol, _create
 
 
 def make_sym_func(op_name):
+    pos_attrs = _POS_ATTRS.get(op_name, [])
+
     def op_func(*args, name=None, attr=None, **kwargs):
         inputs = []
+        trailing = []
         for a in args:
+            if a is None:
+                continue
             if isinstance(a, Symbol):
+                if trailing:
+                    raise TypeError("Symbol argument after scalar argument "
+                                    "in sym.%s" % op_name)
                 inputs.append(a)
             elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
                 inputs.extend(a)
             else:
-                raise TypeError("positional arguments to sym.%s must be Symbol"
+                trailing.append(a)
+        if trailing:
+            if len(trailing) > len(pos_attrs):
+                raise TypeError("too many positional arguments to sym.%s"
                                 % op_name)
+            for attr_name, v in zip(pos_attrs, trailing):
+                kwargs.setdefault(attr_name, v)
         attrs = dict(attr) if attr else {}
         kw_inputs = {}
         for k, v in kwargs.items():

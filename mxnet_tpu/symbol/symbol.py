@@ -352,6 +352,16 @@ def _infer_shapes(sym, specs, partial):
     for n in nodes:
         if n.op is None:
             spec = specs.get(n.name)
+            if spec is None:
+                # a Variable's declared __shape__ (e.g. gluon param.var())
+                # seeds inference when the caller didn't provide one
+                shp = n.attrs.get("__shape__")
+                if shp and all(int(d) > 0 for d in shp):
+                    try:
+                        dt = _np.dtype(n.attrs.get("__dtype__", "float32"))
+                    except TypeError:
+                        dt = _np.dtype(_np.float32)
+                    spec = jax.ShapeDtypeStruct(tuple(int(d) for d in shp), dt)
             shape_env[(id(n), 0)] = spec
     # forward pass with jax.eval_shape per node
     for n in nodes:
@@ -418,6 +428,28 @@ def _infer_shapes(sym, specs, partial):
     return arg_shapes, out_shapes, aux_shapes
 
 
+def _visible_entries(s):
+    """Entries of ``s`` used when composing it into another op.
+
+    When the symbol is the whole output tuple of one node whose op declares
+    ``visible_outputs`` (the nnvm FNumVisibleOutputs analog — BatchNorm's
+    mean/var are hidden from composition), only the visible prefix is used.
+    """
+    entries = s._entries
+    if len(entries) <= 1:
+        return entries
+    node0 = entries[0][0]
+    if node0.op is not None and \
+            all(n is node0 for n, _ in entries) and \
+            [i for _, i in entries] == list(range(node0.num_outputs)):
+        vis = get_op(node0.op).visible_outputs
+        if callable(vis):
+            vis = vis(node0.attrs)
+        if vis is not None:
+            return entries[:vis]
+    return entries
+
+
 def _create(op_name, input_syms, attrs, name=None, kw_inputs=None):
     """Create a Symbol applying op to inputs (generated sym.* functions).
 
@@ -434,10 +466,7 @@ def _create(op_name, input_syms, attrs, name=None, kw_inputs=None):
     for s in input_syms:
         if not isinstance(s, Symbol):
             raise TypeError("inputs must be Symbols, got %s" % type(s))
-        if len(s._entries) != 1:
-            entries.extend(s._entries)
-        else:
-            entries.append(s._entries[0])
+        entries.extend(_visible_entries(s))
 
     op = get_op(op_name)
     spec = op.input_names(merged)
@@ -482,7 +511,7 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if wd_mult is not None:
         attrs["__wd_mult__"] = wd_mult
     if dtype is not None:
-        attrs["__dtype__"] = str(dtype)
+        attrs["__dtype__"] = _np.dtype(dtype).name
     if init is not None:
         attrs["__init__"] = init.dumps() if hasattr(init, "dumps") else str(init)
     attrs.update(kwargs)

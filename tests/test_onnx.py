@@ -1,0 +1,227 @@
+"""ONNX export/import round-trip tests (reference:
+tests/python-pytest/onnx/).  No external onnx package: wire format comes
+from the protoc-generated module in mxnet_tpu/contrib/onnx.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+
+
+def _mlp_symbol():
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(h, name="fc2", num_hidden=10)
+    return sym.softmax(h, name="out", axis=1)
+
+
+def _convnet_symbol():
+    data = sym.Variable("data")
+    h = sym.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    h = sym.BatchNorm(h, name="bn1", fix_gamma=False)
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.Pooling(h, name="pool1", kernel=(2, 2), stride=(2, 2),
+                    pool_type="max")
+    h = sym.Flatten(h, name="flat")
+    h = sym.FullyConnected(h, name="fc", num_hidden=10)
+    return sym.softmax(h, name="out", axis=1)
+
+
+def _init_params(symbol, data_shape):
+    exe = symbol.simple_bind(ctx=mx.cpu(), data=data_shape)
+    rng = np.random.RandomState(0)
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            continue
+        value = rng.uniform(-0.3, 0.3, arr.shape).astype(np.float32)
+        arr[:] = value
+        params[name] = nd.array(value)
+    for name, arr in exe.aux_dict.items():
+        value = (np.zeros(arr.shape, np.float32) if "mean" in name
+                 else np.ones(arr.shape, np.float32))
+        arr[:] = value
+        params[name] = nd.array(value)
+    return exe, params
+
+
+def _forward(symbol, params, aux, x):
+    shapes = {"data": x.shape}
+    exe = symbol.simple_bind(ctx=mx.cpu(), **shapes)
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            arr[:] = x
+        elif name in params:
+            arr[:] = params[name]
+    for name, arr in exe.aux_dict.items():
+        if name in aux:
+            arr[:] = aux[name]
+    return exe.forward()[0].asnumpy()
+
+
+@pytest.mark.parametrize("build,shape", [
+    (_mlp_symbol, (2, 20)),
+    (_convnet_symbol, (2, 3, 8, 8)),
+])
+def test_onnx_roundtrip(tmp_path, build, shape):
+    symbol = build()
+    exe, params = _init_params(symbol, shape)
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    want = exe.forward()[0].asnumpy()
+
+    path = str(tmp_path / "model.onnx")
+    onnx_mxnet.export_model(symbol, params, [shape], np.float32, path)
+
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    got = _forward(sym2, args2, aux2, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_resnet18_roundtrip(tmp_path):
+    """Full model-zoo network: gluon -> traced symbol -> ONNX -> import."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (1, 3, 32, 32)).astype(np.float32))
+    want = net(x).asnumpy()
+    s = net(sym.Variable("data"))
+    params = {name: p.data() for name, p in net.collect_params().items()}
+    path = str(tmp_path / "resnet18.onnx")
+    onnx_mxnet.export_model(s, params, [(1, 3, 32, 32)], np.float32, path)
+    got = _forward(*onnx_mxnet.import_model(path), x.asnumpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_traced_symbol_matches_eager():
+    """gluon -> symbol tracing is numerically exact for a full network."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v2(classes=10)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.uniform(-1, 1, (2, 3, 32, 32)).astype(np.float32))
+    want = net(x).asnumpy()
+    s = net(sym.Variable("data"))
+    params = {name: p.data() for name, p in net.collect_params().items()}
+    exe = s.simple_bind(ctx=mx.cpu(), data=(2, 3, 32, 32))
+    for n, arr in exe.arg_dict.items():
+        arr[:] = x if n == "data" else params[n]
+    for n, arr in exe.aux_dict.items():
+        arr[:] = params[n]
+    got = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_bn_fix_gamma(tmp_path):
+    # fix_gamma=True (the default) forces gamma to 1 at runtime; the export
+    # must bake that in rather than exporting stored gamma values
+    data = sym.Variable("data")
+    out = sym.BatchNorm(data, name="bn")[0]
+    rng = np.random.RandomState(3)
+    gamma = rng.uniform(2.0, 3.0, (4,)).astype(np.float32)  # ignored at runtime
+    params = {"bn_gamma": nd.array(gamma),
+              "bn_beta": nd.array(rng.randn(4).astype(np.float32)),
+              "bn_moving_mean": nd.zeros((4,)),
+              "bn_moving_var": nd.ones((4,))}
+    x = rng.randn(2, 4, 3, 3).astype(np.float32)
+    exe = out.simple_bind(ctx=mx.cpu(), data=x.shape)
+    for n, arr in exe.arg_dict.items():
+        arr[:] = x if n == "data" else params[n]
+    for n, arr in exe.aux_dict.items():
+        arr[:] = params[n]
+    want = exe.forward()[0].asnumpy()
+    path = str(tmp_path / "bn.onnx")
+    onnx_mxnet.export_model(out, params, [x.shape], np.float32, path)
+    got = _forward(*onnx_mxnet.import_model(path), x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_fc_no_flatten(tmp_path):
+    # flatten=False keeps leading dims: (B, T, C) @ W^T -> (B, T, H)
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, name="fc", num_hidden=5, flatten=False)
+    rng = np.random.RandomState(4)
+    params = {"fc_weight": nd.array(rng.randn(5, 6).astype(np.float32)),
+              "fc_bias": nd.array(rng.randn(5).astype(np.float32))}
+    x = rng.randn(2, 3, 6).astype(np.float32)
+    exe = out.simple_bind(ctx=mx.cpu(), data=x.shape)
+    for n, arr in exe.arg_dict.items():
+        arr[:] = x if n == "data" else params[n]
+    want = exe.forward()[0].asnumpy()
+    assert want.shape == (2, 3, 5)
+    path = str(tmp_path / "fc.onnx")
+    onnx_mxnet.export_model(out, params, [x.shape], np.float32, path)
+    got = _forward(*onnx_mxnet.import_model(path), x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_metadata(tmp_path):
+    symbol = _mlp_symbol()
+    _, params = _init_params(symbol, (4, 20))
+    path = str(tmp_path / "meta.onnx")
+    onnx_mxnet.export_model(symbol, params, [(4, 20)], np.float32, path)
+    meta = onnx_mxnet.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (4, 20))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_onnx_export_arg_aux_prefixes(tmp_path):
+    # Module.get_params()-style dicts with arg:/aux: prefixes also work
+    symbol = _convnet_symbol()
+    _, params = _init_params(symbol, (1, 3, 8, 8))
+    prefixed = {}
+    for k, v in params.items():
+        prefix = "aux:" if "moving" in k else "arg:"
+        prefixed[prefix + k] = v
+    path = str(tmp_path / "prefixed.onnx")
+    onnx_mxnet.export_model(symbol, prefixed, [(1, 3, 8, 8)], np.float32, path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    assert any("moving" in k or "mean" in k for k in aux2)
+
+
+def test_onnx_file_is_standard_protobuf(tmp_path):
+    """The serialized file parses with a fresh descriptor (wire sanity)."""
+    symbol = _mlp_symbol()
+    _, params = _init_params(symbol, (2, 20))
+    path = str(tmp_path / "wire.onnx")
+    onnx_mxnet.export_model(symbol, params, [(2, 20)], np.float32, path)
+    from mxnet_tpu.contrib.onnx import onnx_pb2
+    model = onnx_pb2.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    assert model.ir_version == 7
+    assert model.opset_import[0].version == 11
+    assert model.graph.node[0].op_type in ("Flatten", "Gemm")
+    names = {t.name for t in model.graph.initializer}
+    assert "fc1_weight" in names and "fc2_bias" in names
+
+
+def test_onnx_embedding_and_concat_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, name="embed", input_dim=12, output_dim=6)
+    flat = sym.Flatten(emb, name="flatten")
+    both = sym.Concat(flat, flat, dim=1, name="cat")
+    out = sym.FullyConnected(both, name="fc", num_hidden=4)
+    exe = out.simple_bind(ctx=mx.cpu(), data=(3, 5))
+    rng = np.random.RandomState(2)
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            value = rng.uniform(-0.4, 0.4, arr.shape).astype(np.float32)
+            arr[:] = value
+            params[name] = nd.array(value)
+    x = rng.randint(0, 12, (3, 5)).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    want = exe.forward()[0].asnumpy()
+
+    path = str(tmp_path / "emb.onnx")
+    onnx_mxnet.export_model(out, params, [(3, 5)], np.float32, path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    got = _forward(sym2, args2, aux2, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
