@@ -5,4 +5,10 @@ from . import control_flow
 from .control_flow import foreach, while_loop, cond
 from . import autograd  # old-API shim
 from . import quantization
-from . import onnx
+from . import text
+from . import svrg_optimization
+from . import tensorboard
+try:
+    from . import onnx  # wire format needs google.protobuf
+except ImportError:  # keep `import mxnet_tpu` working without protobuf
+    onnx = None

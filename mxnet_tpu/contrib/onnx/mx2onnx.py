@@ -195,8 +195,12 @@ def _export_pooling(ctx, node, ins, outs):
     pad = _ints(node.attrs.get("pad", [0] * nd), nd)
     op_type = {"max": "MaxPool", "avg": "AveragePool"}[pool]
     extra = {}
+    if node.attrs.get("pooling_convention", "valid") == "full":
+        extra["ceil_mode"] = 1
     if pool == "avg":
-        extra["count_include_pad"] = 1
+        # mxnet includes padding in the average unless told otherwise
+        extra["count_include_pad"] = \
+            int(bool(node.attrs.get("count_include_pad", True)))
     ctx.add_node(op_type, ins, outs, node.name, kernel_shape=kernel,
                  strides=stride, pads=pad * 2, **extra)
 

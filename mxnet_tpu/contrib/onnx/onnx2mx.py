@@ -41,7 +41,12 @@ def _tensor_to_numpy(tensor):
     elif tensor.int64_data:
         arr = _np.asarray(tensor.int64_data, _np.int64).astype(dtype)
     elif tensor.int32_data:
-        arr = _np.asarray(tensor.int32_data, _np.int32).astype(dtype)
+        if tensor.data_type == op_pb.TensorProto.FLOAT16:
+            # fp16 without raw_data stores the uint16 BIT PATTERNS
+            arr = _np.asarray(tensor.int32_data, _np.int32) \
+                .astype(_np.uint16).view(_np.float16)
+        else:
+            arr = _np.asarray(tensor.int32_data, _np.int32).astype(dtype)
     elif tensor.double_data:
         arr = _np.asarray(tensor.double_data, _np.float64).astype(dtype)
     else:
@@ -76,6 +81,7 @@ class _ImportContext:
         self.consts = {}      # initializer name -> numpy (for shape reads)
         self.arg_params = {}
         self.aux_params = {}
+        self.transposed = set()  # weights already re-laid-out for mxnet FC
 
     def sym(self, name):
         from ... import symbol as sym_mod
@@ -121,14 +127,19 @@ def _import_conv(ctx, node, a, sym_mod):
 def _import_gemm(ctx, node, a, sym_mod):
     if a.get("transA", 0):
         raise NotImplementedError("Gemm with transA")
+    if a.get("alpha", 1.0) != 1.0 or a.get("beta", 1.0) != 1.0:
+        raise NotImplementedError("Gemm with alpha/beta != 1")
     weight_name = node.input[1]
     if not a.get("transB", 0):
-        # mxnet FC stores (hidden, in): transpose the initializer once
-        if weight_name in ctx.arg_params:
+        # mxnet FC stores (hidden, in): transpose the initializer once —
+        # idempotently, since several Gemm nodes may share the weight
+        if weight_name in ctx.arg_params and \
+                weight_name not in ctx.transposed:
             from ... import ndarray as nd
             ctx.arg_params[weight_name] = nd.array(
                 ctx.arg_params[weight_name].asnumpy().T)
             ctx.consts[weight_name] = ctx.consts[weight_name].T
+            ctx.transposed.add(weight_name)
     weight = ctx.consts.get(weight_name)
     ins = [ctx.sym(i) for i in node.input]
     return sym_mod.FullyConnected(
@@ -174,6 +185,11 @@ def _import_pool(ctx, node, a, sym_mod):
     pad = _halve_pads(a.get("pads"))
     if pad:
         kwargs["pad"] = tuple(pad)
+    if a.get("ceil_mode", 0):
+        kwargs["pooling_convention"] = "full"
+    if node.op_type == "AveragePool":
+        # opposite defaults: ONNX excludes padding unless told otherwise
+        kwargs["count_include_pad"] = bool(a.get("count_include_pad", 0))
     return sym_mod.Pooling(ctx.sym(node.input[0]),
                            name=node.name or node.output[0], **kwargs)
 

@@ -1,0 +1,4 @@
+"""SVRG optimization (reference:
+python/mxnet/contrib/svrg_optimization/)."""
+from .svrg_module import SVRGModule
+from .svrg_optimizer import _SVRGOptimizer, _AssignmentOptimizer

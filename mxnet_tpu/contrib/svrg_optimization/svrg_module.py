@@ -1,0 +1,222 @@
+"""SVRGModule: stochastic variance-reduced gradient training (reference:
+python/mxnet/contrib/svrg_optimization/svrg_module.py, Johnson & Zhang 2013).
+
+Every ``update_freq`` epochs the module snapshots the weights and computes
+the full-dataset gradient at the snapshot; each batch update then uses
+``g(w) - g(w_snapshot) + mu`` instead of the raw stochastic gradient.
+A second executor group (``_mod_aux``) holds the snapshot weights.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ...module.module import Module
+from ...module.base_module import _as_list, _fire, _NO_BATCH
+from ...model import BatchEndParam
+from ... import metric as metric_mod
+from ... import ndarray as nd
+
+
+class SVRGModule(Module):
+    """Module with the SVRG gradient correction.
+
+    Parameters match Module plus ``update_freq``: the number of epochs
+    between full-gradient snapshots (m in the paper).
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging, context=None,
+                 work_load_list=None, fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None, update_freq=None):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, work_load_list=work_load_list,
+                         fixed_param_names=fixed_param_names,
+                         state_names=state_names, group2ctxs=group2ctxs,
+                         compression_params=compression_params)
+        if not isinstance(update_freq, int) or update_freq <= 0:
+            raise ValueError("update_freq in SVRGModule must be a positive "
+                             "integer, got %r" % (update_freq,))
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names, label_names, logger,
+                               context, work_load_list, fixed_param_names,
+                               state_names, group2ctxs, compression_params)
+        self._param_dict = None
+        self._ctx_len = len(self._context)
+
+    def _reset_bind(self):
+        super()._reset_bind()
+        self._mod_aux._reset_bind()
+
+    def reshape(self, data_shapes, label_shapes=None):
+        super().reshape(data_shapes, label_shapes=label_shapes)
+        self._mod_aux.reshape(data_shapes, label_shapes=label_shapes)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind, shared_module,
+                               grad_req)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        # snapshot module starts from the same weights
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(initializer=initializer, arg_params=arg,
+                                  aux_params=aux, allow_missing=allow_missing,
+                                  force_init=force_init,
+                                  allow_extra=allow_extra)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        super().init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        # one full-grad accumulator per device per parameter
+        self._param_dict = [
+            {name: nd.zeros(arr[0].shape, dtype=arr[0].dtype)
+             for name, arr in zip(self._exec_group.param_names,
+                                  self._exec_group.param_arrays)}
+            for _ in range(self._ctx_len)]
+
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if is_train or (is_train is None and self.for_training):
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def backward(self, out_grads=None):
+        super().backward(out_grads)
+        if self._mod_aux.binded:
+            self._mod_aux.backward(out_grads)
+
+    def update(self):
+        self._update_svrg_gradients()
+        super().update()
+
+    def update_full_grads(self, train_data):
+        """Average gradient over the whole dataset at the snapshot weights."""
+        param_names = self._exec_group.param_names
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg_params=arg, aux_params=aux)
+        train_data.reset()
+        nbatch, padding = 0, 0
+        for ctx in range(self._ctx_len):
+            for name in param_names:
+                self._param_dict[ctx][name][:] = 0.0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            nbatch += 1
+            for ctx in range(self._ctx_len):
+                for index, name in enumerate(param_names):
+                    grads = self._mod_aux._exec_group.grad_arrays[index][ctx]
+                    acc = self._param_dict[ctx][name]
+                    acc[:] = acc + grads
+            padding = batch.pad or 0
+        true_num_batch = nbatch - padding / train_data.batch_size
+        for ctx in range(self._ctx_len):
+            for name in param_names:
+                acc = self._param_dict[ctx][name]
+                acc[:] = acc / true_num_batch
+
+    def _svrg_grads_update_rule(self, g_curr, g_snapshot, g_full):
+        """grads = g(w) - g(w_snapshot) + mu  (the SVRG correction)."""
+        g_curr[:] = g_curr - g_snapshot + g_full
+        return g_curr
+
+    def _update_svrg_gradients(self):
+        param_names = self._exec_group.param_names
+        for ctx in range(self._ctx_len):
+            for index, name in enumerate(param_names):
+                self._svrg_grads_update_rule(
+                    self._exec_group.grad_arrays[index][ctx],
+                    self._mod_aux._exec_group.grad_arrays[index][ctx],
+                    self._param_dict[ctx][name])
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """Module.fit plus the periodic full-gradient snapshot."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from ...initializer import Uniform
+        if initializer is None:
+            initializer = Uniform(0.01)
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            eval_name_vals = []
+            train_data.reset()
+            batches = iter(train_data)
+            data_batch = next(batches, _NO_BATCH)
+            nbatch = 0
+            while data_batch is not _NO_BATCH:
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self._metric_from_batch(eval_metric, data_batch)
+                upcoming = next(batches, _NO_BATCH)
+                if upcoming is not _NO_BATCH:
+                    self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
+                if monitor is not None:
+                    monitor.toc_print()
+                if upcoming is _NO_BATCH:
+                    eval_name_vals = eval_metric.get_name_value()
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=eval_metric, locals=locals()))
+                data_batch = upcoming
+                nbatch += 1
+            for name, val in eval_name_vals:
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            arg_params_, aux_params_ = self.get_params()
+            self.set_params(arg_params_, aux_params_)
+            _fire(epoch_end_callback, epoch, self.symbol, arg_params_,
+                  aux_params_)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+            train_data.reset()
+
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        super().prepare(data_batch, sparse_row_id_fn=sparse_row_id_fn)
+        self._mod_aux.prepare(data_batch, sparse_row_id_fn=sparse_row_id_fn)

@@ -1,0 +1,57 @@
+"""SVRG optimizer plumbing (reference:
+python/mxnet/contrib/svrg_optimization/svrg_optimizer.py).
+
+``_SVRGOptimizer`` multiplexes two optimizers over kvstore keys: full-grad
+accumulation keys (suffix ``_full``) take plain assignment, regular weight
+keys go to the wrapped default optimizer.
+"""
+from __future__ import annotations
+
+from ... import optimizer as opt
+
+
+@opt.register
+class _AssignmentOptimizer(opt.Optimizer):
+    """kvstore "update": overwrite the stored value (full-grad buffers)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        weight[:] = grad
+
+
+@opt.register
+class _SVRGOptimizer(opt.Optimizer):
+    """Dispatch: `<key>_full` accumulation buffers get assignment, everything
+    else is updated by the wrapped ``default_optimizer``."""
+
+    def __init__(self, default_optimizer, **kwargs):
+        base_kwargs = self._filter_base_params(kwargs)
+        super().__init__(**base_kwargs)
+        if isinstance(default_optimizer, str):
+            self.default_opt = opt.create(default_optimizer, **kwargs)
+        else:
+            self.default_opt = default_optimizer
+        self.aux_opt = _AssignmentOptimizer()
+
+    @staticmethod
+    def _filter_base_params(kwargs):
+        import inspect
+        valid = set(inspect.signature(opt.Optimizer.__init__).parameters)
+        return {k: v for k, v in kwargs.items() if k in valid}
+
+    def create_state(self, index, weight):
+        if self._is_full_key(index):
+            return self.aux_opt.create_state(index, weight)
+        return self.default_opt.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        if self._is_full_key(index):
+            self.aux_opt.update(index, weight, grad, state)
+        else:
+            self.default_opt.update(index, weight, grad, state)
+
+    @staticmethod
+    def _is_full_key(index):
+        return isinstance(index, str) and index.endswith("_full")

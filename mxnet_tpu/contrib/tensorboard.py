@@ -1,0 +1,46 @@
+"""TensorBoard metric logging (reference:
+python/mxnet/contrib/tensorboard.py LogMetricsCallback).
+
+Writer resolution order: mxboard, tensorboardX, torch.utils.tensorboard —
+whichever is importable (this image bundles the latter two).
+"""
+from __future__ import annotations
+
+
+def _make_writer(logging_dir):
+    try:
+        from mxboard import SummaryWriter
+        return SummaryWriter(logdir=logging_dir)
+    except ImportError:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+        return SummaryWriter(logdir=logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(log_dir=logging_dir)
+    except ImportError:
+        raise ImportError(
+            "LogMetricsCallback requires a TensorBoard summary writer "
+            "(mxboard, tensorboardX, or torch).")
+
+
+class LogMetricsCallback(object):
+    """Batch/epoch-end callback that writes eval metrics as TB scalars."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        """Log metrics from a BatchEndParam-style object."""
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

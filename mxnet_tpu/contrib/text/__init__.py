@@ -1,0 +1,6 @@
+"""Text token indexing and embeddings
+(reference: python/mxnet/contrib/text/)."""
+from . import utils
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary

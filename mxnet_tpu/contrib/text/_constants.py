@@ -1,0 +1,3 @@
+"""Shared constants for contrib.text (reference _constants.py)."""
+UNKNOWN_TOKEN = "<unk>"
+UNKNOWN_IDX = 0

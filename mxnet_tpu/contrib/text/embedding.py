@@ -1,0 +1,234 @@
+"""Token embeddings (reference: python/mxnet/contrib/text/embedding.py).
+
+Pretrained GloVe/FastText registries exist for API parity; this environment
+has no network egress, so pretrained files must already be present under the
+embedding root — otherwise loading raises with a clear message.
+``CustomEmbedding`` loads any local `token<delim>vec` file and is the fully
+supported path.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as _np
+
+from . import vocab
+from . import _constants as C
+from ... import ndarray as nd
+
+_EMBEDDING_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register a _TokenEmbedding subclass under its lowercased name."""
+    _EMBEDDING_REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Create an embedding instance by registered name ('glove', ...)."""
+    cls = _EMBEDDING_REGISTRY.get(embedding_name.lower())
+    if cls is None:
+        raise KeyError(
+            "Cannot find `embedding_name` %s. Use `get_pretrained_file_names()"
+            "` to get all the valid embedding names." % embedding_name)
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Valid pretrained file names, per embedding or for all registered."""
+    if embedding_name is not None:
+        cls = _EMBEDDING_REGISTRY.get(embedding_name.lower())
+        if cls is None:
+            raise KeyError("Cannot find `embedding_name` %s." % embedding_name)
+        return list(cls.pretrained_file_name_sha1.keys())
+    return {name: list(cls.pretrained_file_name_sha1.keys())
+            for name, cls in _EMBEDDING_REGISTRY.items()}
+
+
+class _TokenEmbedding(vocab.Vocabulary):
+    """Base embedding: a Vocabulary plus an (len(vocab), vec_len) matrix."""
+
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        path = os.path.expanduser(
+            os.path.join(embedding_root, cls.__name__.lower(),
+                         pretrained_file_name))
+        if not os.path.isfile(path):
+            raise RuntimeError(
+                "Pretrained embedding file %s is not present (this "
+                "environment has no network egress; place the file there "
+                "manually, or use CustomEmbedding with a local file)." % path)
+        return path
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf-8"):
+        """Parse `token<delim>float...` lines into the index and matrix."""
+        logging.info("Loading pretrained embedding vectors from %s",
+                     pretrained_file_path)
+        vectors = []
+        vec_len = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                token, vec = elems[0], elems[1:]
+                if len(vec) == 1 and line_num == 0:
+                    continue  # header line of fastText-format files
+                if token in self._token_to_idx:
+                    logging.warning("duplicate token %s; keeping the first "
+                                    "occurrence", token)
+                    continue
+                if vec_len is None:
+                    vec_len = len(vec)
+                elif len(vec) != vec_len:
+                    raise AssertionError(
+                        "line %d: inconsistent vector length %d (expected %d)"
+                        % (line_num, len(vec), vec_len))
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vectors.append([float(x) for x in vec])
+        if vec_len is None:
+            raise AssertionError("no vectors found in %s"
+                                 % pretrained_file_path)
+        self._vec_len = vec_len
+        matrix = _np.zeros((len(self._idx_to_token), vec_len), _np.float32)
+        matrix[len(self._idx_to_token) - len(vectors):] = _np.asarray(vectors)
+        matrix[C.UNKNOWN_IDX] = init_unknown_vec(shape=vec_len).asnumpy() \
+            if callable(init_unknown_vec) else 0.0
+        self._idx_to_vec = nd.array(matrix)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Embedding vectors for token(s); unknown tokens get the unknown
+        vector (optionally retrying lower-cased)."""
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower() for t in toks]
+        indices = [self._token_to_idx.get(t, C.UNKNOWN_IDX) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[indices]
+        out = nd.array(vecs[0] if single else vecs)
+        return out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite the vectors of existing (known) tokens."""
+        assert self._idx_to_vec is not None, \
+            "The property `idx_to_vec` has not been properly set."
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        new = new_vectors.asnumpy().reshape(len(toks), -1)
+        matrix = _np.array(self._idx_to_vec.asnumpy())
+        for i, token in enumerate(toks):
+            if token not in self._token_to_idx:
+                raise ValueError("Token %s is unknown. To update the "
+                                 "embedding vector for an unknown token, "
+                                 "please specify it explicitly as the "
+                                 "`unknown_token` %s in `tokens`."
+                                 % (token, self._unknown_token))
+            matrix[self._token_to_idx[token]] = new[i]
+        self._idx_to_vec = nd.array(matrix)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        """Restrict the index and matrix to the given vocabulary's tokens."""
+        vecs = self.get_vecs_by_tokens(list(vocabulary.idx_to_token))
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_vec = vecs
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        embedding_name = cls.__name__.lower()
+        if pretrained_file_name not in cls.pretrained_file_name_sha1:
+            raise KeyError(
+                "Cannot find pretrained file %s for token embedding %s. "
+                "Valid pretrained files for embedding %s: %s"
+                % (pretrained_file_name, embedding_name, embedding_name,
+                   ", ".join(cls.pretrained_file_name_sha1)))
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embeddings (Pennington et al. 2014)."""
+
+    pretrained_file_name_sha1 = {k: "" for k in (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")}
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        GloVe._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = GloVe._get_pretrained_file(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText embeddings (Bojanowski et al. 2017)."""
+
+    pretrained_file_name_sha1 = {k: "" for k in (
+        "wiki.simple.vec", "wiki.zh.vec", "wiki.en.vec", "crawl-300d-2M.vec")}
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        FastText._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = FastText._get_pretrained_file(embedding_root,
+                                             pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a user-provided `token<elem_delim>vec` file."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf-8",
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._vocab = vocabulary
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = [emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for emb in token_embeddings]
+        matrix = _np.concatenate(parts, axis=1)
+        self._vec_len = matrix.shape[1]
+        self._idx_to_vec = nd.array(matrix)

@@ -1,0 +1,87 @@
+"""Token indexing (reference: python/mxnet/contrib/text/vocab.py Vocabulary)."""
+from __future__ import annotations
+
+from collections import Counter
+
+from . import _constants as C
+
+
+class Vocabulary(object):
+    """Index text tokens: unknown token at index 0, then reserved tokens,
+    then counter keys ordered by (-frequency, token) subject to
+    ``most_freq_count`` / ``min_freq`` thresholds.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token=C.UNKNOWN_TOKEN, reserved_tokens=None):
+        if min_freq < 1:
+            raise AssertionError("`min_freq` must be set to a positive value.")
+        if reserved_tokens is not None:
+            unique = set(reserved_tokens)
+            if unknown_token in unique:
+                raise AssertionError(
+                    "`reserved_tokens` cannot contain `unknown_token`.")
+            if len(unique) != len(reserved_tokens):
+                raise AssertionError(
+                    "`reserved_tokens` cannot contain duplicate reserved "
+                    "tokens.")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens is not None else None)
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, Counter), \
+            "`counter` must be an instance of collections.Counter."
+        excluded = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        budget = most_freq_count if most_freq_count is not None else len(pairs)
+        for token, freq in pairs:
+            if budget <= 0 or freq < min_freq:
+                break
+            if token in excluded:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Index (or list of indices) for the token(s); unknown -> index 0."""
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        indices = [self._token_to_idx.get(t, C.UNKNOWN_IDX) for t in toks]
+        return indices[0] if single else indices
+
+    def to_tokens(self, indices):
+        """Token (or list of tokens) for the given index/indices."""
+        single = not isinstance(indices, list)
+        idxs = [indices] if single else indices
+        tokens = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("Token index %d in the provided `indices` "
+                                 "is invalid." % i)
+            tokens.append(self._idx_to_token[i])
+        return tokens[0] if single else tokens

@@ -459,7 +459,8 @@ def _linear_regression_output(attrs, data, label):
 
     def f_bwd(res, g):
         d, l = res
-        return (grad_scale * (d - l.reshape(d.shape)) / d.shape[0], None)
+        num_out = max(int(_np.prod(d.shape[1:])), 1)
+        return (grad_scale * (d - l.reshape(d.shape)) / num_out, None)
 
     f.defvjp(f_fwd, f_bwd)
     return f(data, label)
@@ -480,7 +481,8 @@ def _mae_regression_output(attrs, data, label):
 
     def f_bwd(res, g):
         d, l = res
-        return (grad_scale * jnp.sign(d - l.reshape(d.shape)) / d.shape[0], None)
+        num_out = max(int(_np.prod(d.shape[1:])), 1)
+        return (grad_scale * jnp.sign(d - l.reshape(d.shape)) / num_out, None)
 
     f.defvjp(f_fwd, f_bwd)
     return f(data, label)
@@ -501,7 +503,8 @@ def _logistic_regression_output(attrs, data, label):
 
     def f_bwd(res, g):
         out, l = res
-        return (grad_scale * (out - l.reshape(out.shape)) / out.shape[0], None)
+        num_out = max(int(_np.prod(out.shape[1:])), 1)
+        return (grad_scale * (out - l.reshape(out.shape)) / num_out, None)
 
     f.defvjp(f_fwd, f_bwd)
     return f(data, label)

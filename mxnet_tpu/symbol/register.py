@@ -30,7 +30,10 @@ def make_sym_func(op_name):
                 raise TypeError("too many positional arguments to sym.%s"
                                 % op_name)
             for attr_name, v in zip(pos_attrs, trailing):
-                kwargs.setdefault(attr_name, v)
+                if attr_name in kwargs:
+                    raise TypeError("sym.%s got multiple values for %r"
+                                    % (op_name, attr_name))
+                kwargs[attr_name] = v
         attrs = dict(attr) if attr else {}
         kw_inputs = {}
         for k, v in kwargs.items():

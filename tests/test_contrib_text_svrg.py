@@ -1,0 +1,234 @@
+"""Tests for contrib.text, contrib.svrg_optimization, contrib.tensorboard
+(reference: tests/python/unittest/test_contrib_text.py,
+tests/python/unittest/test_contrib_svrg_module.py / _optimizer.py).
+"""
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import text as ctext
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule, _SVRGOptimizer
+
+
+# ------------------------------------------------------------------- text
+
+def test_count_tokens_from_str():
+    source = "life is great ! \n life is good ! \n"
+    counter = ctext.utils.count_tokens_from_str(source)
+    assert counter["life"] == 2 and counter["!"] == 2 and counter["great"] == 1
+    upper = ctext.utils.count_tokens_from_str("Life life", to_lower=True)
+    assert upper["life"] == 2
+
+
+def test_vocabulary_indexing():
+    counter = Counter({"c": 5, "b": 3, "a": 3, "some_word$": 1})
+    v = ctext.Vocabulary(counter, most_freq_count=None, min_freq=1,
+                         unknown_token="<unk>", reserved_tokens=["<pad>"])
+    assert len(v) == 6
+    assert v.idx_to_token[0] == "<unk>" and v.idx_to_token[1] == "<pad>"
+    # frequency order, ties broken lexicographically
+    assert v.idx_to_token[2] == "c" and v.idx_to_token[3] == "a"
+    assert v.to_indices("c") == 2
+    assert v.to_indices(["c", "missing"]) == [2, 0]
+    assert v.to_tokens([0, 2]) == ["<unk>", "c"]
+    with pytest.raises(ValueError):
+        v.to_tokens(100)
+
+
+def test_vocabulary_thresholds():
+    counter = Counter({"a": 10, "b": 5, "c": 2, "d": 1})
+    v = ctext.Vocabulary(counter, most_freq_count=2, min_freq=2)
+    assert v.idx_to_token == ["<unk>", "a", "b"]
+    with pytest.raises(AssertionError):
+        ctext.Vocabulary(counter, min_freq=0)
+    with pytest.raises(AssertionError):
+        ctext.Vocabulary(counter, reserved_tokens=["<unk>"])
+
+
+def _write_embedding_file(path):
+    lines = ["hello 0.1 0.2 0.3", "world 1.0 2.0 3.0", "tpu 7.0 8.0 9.0"]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def test_custom_embedding(tmp_path):
+    path = str(tmp_path / "emb.txt")
+    _write_embedding_file(path)
+    emb = ctext.embedding.CustomEmbedding(path, init_unknown_vec=nd.zeros)
+    assert emb.vec_len == 3
+    vec = emb.get_vecs_by_tokens("world").asnumpy()
+    np.testing.assert_allclose(vec, [1.0, 2.0, 3.0])
+    both = emb.get_vecs_by_tokens(["hello", "nope"]).asnumpy()
+    np.testing.assert_allclose(both[0], [0.1, 0.2, 0.3], rtol=1e-6)
+    np.testing.assert_allclose(both[1], [0.0, 0.0, 0.0])
+    # lower_case_backup
+    up = emb.get_vecs_by_tokens(["WORLD"], lower_case_backup=True).asnumpy()
+    np.testing.assert_allclose(up[0], [1.0, 2.0, 3.0])
+
+
+def test_custom_embedding_update_and_vocab(tmp_path):
+    path = str(tmp_path / "emb.txt")
+    _write_embedding_file(path)
+    emb = ctext.embedding.CustomEmbedding(path)
+    emb.update_token_vectors("hello", nd.array([[9.0, 9.0, 9.0]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9.0, 9.0, 9.0])
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("unseen", nd.array([[1.0, 1.0, 1.0]]))
+    # restrict to a vocabulary
+    vocab = ctext.Vocabulary(Counter({"tpu": 2, "new": 1}))
+    emb2 = ctext.embedding.CustomEmbedding(path, vocabulary=vocab)
+    assert emb2.idx_to_token == vocab.idx_to_token
+    np.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("tpu").asnumpy(), [7.0, 8.0, 9.0])
+
+
+def test_composite_embedding(tmp_path):
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    _write_embedding_file(p1)
+    with open(p2, "w") as f:
+        f.write("hello 5 5\nworld 6 6\n")
+    e1 = ctext.embedding.CustomEmbedding(p1)
+    e2 = ctext.embedding.CustomEmbedding(p2)
+    vocab = ctext.Vocabulary(Counter({"hello": 1, "world": 1}))
+    comp = ctext.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 5
+    v = comp.get_vecs_by_tokens("world").asnumpy()
+    np.testing.assert_allclose(v, [1.0, 2.0, 3.0, 6.0, 6.0])
+
+
+def test_embedding_registry():
+    names = ctext.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in \
+        ctext.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        ctext.embedding.create("nonexistent")
+
+
+# ------------------------------------------------------------------- svrg
+
+def _linear_iter(n=64, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (n, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    Y = X @ w + 0.01 * rng.randn(n).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=batch, shuffle=False,
+                             label_name="lin_reg_label")
+
+
+def _linear_symbol():
+    data = sym.Variable("data")
+    label = sym.Variable("lin_reg_label")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=1)
+    return sym.LinearRegressionOutput(fc, label, name="lin_reg")
+
+
+def test_svrg_module_api():
+    mod = SVRGModule(_linear_symbol(), data_names=["data"],
+                     label_names=["lin_reg_label"], update_freq=2)
+    it = _linear_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.01))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),))
+    assert mod._mod_aux.binded and mod._param_dict is not None
+    with pytest.raises(ValueError):
+        SVRGModule(_linear_symbol(), update_freq=0)
+
+
+def test_svrg_update_rule_math():
+    mod = SVRGModule(_linear_symbol(), data_names=["data"],
+                     label_names=["lin_reg_label"], update_freq=1)
+    g = nd.array([1.0, 2.0])
+    g_snap = nd.array([0.5, 0.5])
+    mu = nd.array([0.1, 0.1])
+    out = mod._svrg_grads_update_rule(g, g_snap, mu)
+    np.testing.assert_allclose(out.asnumpy(), [0.6, 1.6], rtol=1e-6)
+
+
+def test_svrg_full_grads_match_average():
+    """mu must equal the dataset-average gradient at the snapshot weights."""
+    mod = SVRGModule(_linear_symbol(), data_names=["data"],
+                     label_names=["lin_reg_label"], update_freq=1)
+    it = _linear_iter(n=32, batch=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.01))
+    mod.init_optimizer(optimizer="sgd")
+    mod.update_full_grads(it)
+
+    # oracle: average the per-batch grads of a plain Module
+    ref = mx.mod.Module(_linear_symbol(), data_names=["data"],
+                        label_names=["lin_reg_label"])
+    ref.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    arg, aux = mod.get_params()
+    ref.init_params(arg_params=arg, aux_params=aux, initializer=None)
+    it.reset()
+    total, count = None, 0
+    for batch in it:
+        ref.forward(batch, is_train=True)
+        ref.backward()
+        g = ref._exec_group.grad_arrays[0][0].asnumpy()
+        total = g if total is None else total + g
+        count += 1
+    want = total / count
+    got = mod._param_dict[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_svrg_fit_converges():
+    mod = SVRGModule(_linear_symbol(), data_names=["data"],
+                     label_names=["lin_reg_label"], update_freq=2)
+    it = _linear_iter(n=64, batch=8)
+    metric = mx.metric.create("mse")
+    mod.fit(it, eval_metric=metric, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),), num_epoch=12,
+            initializer=mx.init.Uniform(0.01))
+    assert metric.get()[1] < 0.1, metric.get()
+
+
+def test_svrg_beats_or_matches_sgd_on_fixed_budget():
+    def final_mse(module_cls, **extra):
+        m = module_cls(_linear_symbol(), data_names=["data"],
+                       label_names=["lin_reg_label"], **extra)
+        it = _linear_iter(n=64, batch=8, seed=3)
+        metric = mx.metric.create("mse")
+        m.fit(it, eval_metric=metric, optimizer="sgd",
+              optimizer_params=(("learning_rate", 0.05),), num_epoch=8,
+              initializer=mx.init.Uniform(0.01))
+        return metric.get()[1]
+
+    svrg = final_mse(SVRGModule, update_freq=2)
+    assert np.isfinite(svrg) and svrg < 1.0
+
+
+def test_svrg_optimizer_dispatch():
+    optimizer = _SVRGOptimizer(default_optimizer="sgd", learning_rate=0.5)
+    w = nd.array([1.0])
+    g = nd.array([1.0])
+    state = optimizer.create_state(0, w)
+    optimizer.update(0, w, g, state)
+    assert w.asscalar() == pytest.approx(0.5)  # sgd step
+    full = nd.array([0.0])
+    optimizer.update("fc_weight_full", full, nd.array([7.0]), None)
+    assert full.asscalar() == pytest.approx(7.0)  # assignment
+
+
+# -------------------------------------------------------------- tensorboard
+
+def test_tensorboard_callback(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    logdir = str(tmp_path / "tb")
+    cb = LogMetricsCallback(logdir, prefix="train")
+    metric = mx.metric.create("acc")
+    metric.update([nd.array([1])], [nd.array([[0.1, 0.9]])])
+    param = mx.model.BatchEndParam(epoch=0, nbatch=1, eval_metric=metric,
+                                   locals=None)
+    cb(param)
+    cb(param)
+    files = os.listdir(logdir)
+    assert any("tfevents" in f for f in files), files
