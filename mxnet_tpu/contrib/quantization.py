@@ -44,41 +44,215 @@ def dequantize(data, min_range, max_range, out_type="float32"):
     return _wrap(out, ctx=data.context)
 
 
-def _collect_thresholds(arr, mode="minmax", num_bins=8001):
+def _collect_thresholds(arr, mode="minmax", num_bins=2048, num_quantized=128):
+    """Symmetric calibration range for a tensor.
+
+    minmax: the observed extrema.  entropy: the KL-optimal clip threshold
+    (reference quantization.py _get_optimal_threshold — the TensorRT-style
+    search: for every candidate clip point, compare the clipped reference
+    distribution with its int8-downsampled reconstruction).
+    """
     a = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
     if mode == "minmax":
         return float(a.min()), float(a.max())
-    # entropy (KL) calibration
     amax = float(_np.abs(a).max())
-    hist, edges = _np.histogram(_np.abs(a).ravel(), bins=num_bins, range=(0, amax))
+    if amax == 0.0:
+        return 0.0, 0.0
+    hist, edges = _np.histogram(_np.abs(a).ravel(), bins=num_bins,
+                                range=(0, amax))
     best_t, best_kl = amax, _np.inf
-    total = hist.sum()
-    for i in range(num_bins // 8, num_bins, num_bins // 64):
-        t = edges[i]
-        p = hist[:i].astype(_np.float64).copy()
+    for i in range(num_quantized, num_bins + 1, num_quantized // 2):
+        sliced = hist[:i].astype(_np.float64)
+        # reference distribution: everything past the clip collapses into
+        # the last kept bin
+        p = sliced.copy()
         p[-1] += hist[i:].sum()
-        q_bins = 255
-        factor = i / q_bins
+        # candidate distribution: the kept bins squeezed into int8 levels,
+        # then re-expanded uniformly over the nonzero positions
         q = _np.zeros(i)
-        for j in range(q_bins):
-            lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
-            q[lo:hi] = p[lo:hi].sum() / max(hi - lo, 1)
-        p /= max(p.sum(), 1e-12)
-        q /= max(q.sum(), 1e-12)
-        mask = p > 0
-        kl = float((p[mask] * _np.log(p[mask] / _np.maximum(q[mask], 1e-12))).sum())
+        chunks = _np.array_split(sliced, num_quantized)
+        pos = 0
+        for chunk in chunks:
+            nonzero = _np.count_nonzero(chunk)
+            if nonzero:
+                q[pos:pos + len(chunk)] = _np.where(
+                    chunk > 0, chunk.sum() / nonzero, 0.0)
+            pos += len(chunk)
+        keep = p > 0
+        if not q[keep].all():
+            # smooth zero candidate bins so KL stays finite
+            q = q + 1e-9
+        p_n = p / p.sum()
+        q_n = q / q.sum()
+        kl = float(_np.sum(p_n[keep] * _np.log(p_n[keep] / q_n[keep])))
         if kl < best_kl:
-            best_kl, best_t = kl, t
+            best_kl, best_t = kl, float(edges[i])
     return -best_t, best_t
+
+
+_QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
+                "Convolution": "_contrib_quantized_conv"}
+
+
+def _quantize_params(arg_params, weight_names):
+    """Offline int8 quantization of weights/biases: name_quantized (int8) +
+    name_min/name_max scalar params (quantize_graph_pass.cc param handling)."""
+    qargs = dict(arg_params)
+    for name in sorted(set(weight_names)):
+        arr = arg_params[name].asnumpy()
+        amax = float(max(abs(arr.min()), abs(arr.max()), 1e-12))
+        q = _np.clip(_np.round(arr * (127.0 / amax)), -127, 127)
+        qargs[name + "_quantized"] = array(q.astype(_np.int8))
+        qargs[name + "_min"] = array([-amax])
+        qargs[name + "_max"] = array([amax])
+        del qargs[name]
+    return qargs
+
+
+def _calibrate_ranges(sym, arg_params, aux_params, calib_data, target_inputs,
+                      calib_mode, num_calib_examples=None):
+    """Run the fp graph over calibration batches, recording the value range
+    of every tensor feeding a quantized op (quantization.py:84-206)."""
+    from .. import symbol as sym_mod
+    probes = sym_mod.Group([s for _, s in target_inputs])
+    shapes = {d.name: tuple(d.shape) for d in calib_data.provide_data}
+    exe = probes.simple_bind(ctx=None, grad_req="null", **shapes)
+    for name, arr in exe.arg_dict.items():
+        if name in arg_params:
+            arr[:] = arg_params[name]
+    for name, arr in exe.aux_dict.items():
+        if name in aux_params:
+            arr[:] = aux_params[name]
+    mode = "minmax" if calib_mode in ("naive", "minmax") else "entropy"
+    ranges = {key: (_np.inf, -_np.inf) for key, _ in target_inputs}
+    # entropy needs a value sample; cap per-layer host memory by reservoir
+    # subsampling instead of buffering every activation (the reference keeps
+    # fixed histograms; a bounded sample gives the same KL search input)
+    cap = 1 << 20
+    samples = {key: [] for key, _ in target_inputs}
+    sizes = {key: 0 for key, _ in target_inputs}
+    rng = _np.random.RandomState(0)
+    seen = 0
+    calib_data.reset()
+    for batch in calib_data:
+        for desc, value in zip(calib_data.provide_data, batch.data):
+            if desc.name in exe.arg_dict:
+                exe.arg_dict[desc.name][:] = value
+        outs = exe.forward(is_train=False)
+        for (key, _), out in zip(target_inputs, outs):
+            a = out.asnumpy().ravel()
+            lo, hi = ranges[key]
+            ranges[key] = (min(lo, float(a.min())), max(hi, float(a.max())))
+            if mode == "entropy":
+                if sizes[key] + a.size > cap:
+                    a = rng.choice(a, size=max(cap // 8, 1), replace=False) \
+                        if a.size > cap // 8 else a
+                samples[key].append(a)
+                sizes[key] += a.size
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    if mode == "minmax":
+        return ranges
+    return {key: _collect_thresholds(_np.concatenate(samples[key]), "entropy")
+            for key, _ in target_inputs}
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    excluded_sym_names=None, calib_mode="none", calib_data=None,
                    num_calib_examples=None, quantized_dtype="int8", **kwargs):
-    """Round-1: returns the fp model with recorded thresholds per param
-    (full int8 graph rewrite is a widening item)."""
+    """Rewrite FullyConnected/Convolution nodes to their int8 quantized
+    forms (the quantize_graph_pass.cc analog).
+
+    Weights/biases are quantized offline into ``*_quantized`` int8 params with
+    ``*_min``/``*_max`` ranges; activations get ``_contrib_quantize_v2`` nodes
+    — dynamic min/max under ``calib_mode='none'``, calibrated thresholds
+    (minmax or KL/entropy over ``calib_data``) otherwise.  Returns
+    (quantized symbol, quantized arg_params, aux_params).
+    """
+    from ..symbol.symbol import _Node, Symbol
+    if quantized_dtype != "int8":
+        raise ValueError("quantized_dtype %r is not supported; the int8 "
+                         "MXU path is the TPU-native quantization"
+                         % (quantized_dtype,))
+    if calib_mode != "none" and calib_data is None:
+        raise ValueError("calib_mode %r requires calib_data" % (calib_mode,))
+    excluded = set(excluded_sym_names or [])
+    nodes = sym._topo_nodes()
+
+    def _quantizable(node):
+        """Only Variable weights present in arg_params can be quantized
+        offline; computed or missing weights keep the node in fp32."""
+        if node.op not in _QUANTIZABLE or node.name in excluded:
+            return False
+        n_param = 2 if node.attrs.get("no_bias", False) else 3
+        for inp, _idx in node.inputs[1:n_param]:
+            if inp.op is not None or inp.name not in arg_params:
+                return False
+        return True
+
+    # activation ranges per quantized node, when calibrating
     thresholds = {}
-    for name, arr in arg_params.items():
-        thresholds[name] = _collect_thresholds(
-            arr, "minmax" if calib_mode in ("none", "naive") else "entropy")
-    return sym, arg_params, aux_params
+    if calib_mode != "none" and calib_data is not None:
+        target_inputs = []
+        for node in nodes:
+            if _quantizable(node):
+                inp, idx = node.inputs[0]
+                target_inputs.append((node.name, Symbol([(inp, idx)])))
+        if target_inputs:
+            thresholds = _calibrate_ranges(sym, arg_params, aux_params,
+                                           calib_data, target_inputs,
+                                           calib_mode, num_calib_examples)
+
+    mapping = {}          # id(old node) -> {output idx: (new node, idx)}
+    weight_names = []
+
+    def new_entry(old_node, idx):
+        return mapping[id(old_node)][idx]
+
+    for node in nodes:
+        if node.op is None:
+            mapping[id(node)] = {0: (node, 0)}
+            continue
+        ins = [new_entry(inp, idx) for inp, idx in node.inputs]
+        if _quantizable(node):
+            no_bias = bool(node.attrs.get("no_bias", False))
+            # data -> int8 via quantize_v2 (calibrated when available)
+            q_attrs = {"out_type": "int8"}
+            if node.name in thresholds:
+                mn, mx = thresholds[node.name]
+                q_attrs["min_calib_range"] = float(mn)
+                q_attrs["max_calib_range"] = float(mx)
+            qdata = _Node("_contrib_quantize_v2", node.name + "_quantize",
+                          q_attrs, [ins[0]])
+            # weight/bias -> offline int8 param variables
+            def qvar(pos):
+                var = node.inputs[pos][0]
+                weight_names.append(var.name)
+                attrs = dict(var.attrs)
+                if var.name in arg_params:  # known shape seeds inference
+                    attrs["__shape__"] = tuple(arg_params[var.name].shape)
+                    attrs["__dtype__"] = "int8"
+                qw = _Node(None, var.name + "_quantized", attrs, [])
+                wmin = _Node(None, var.name + "_min", {"__shape__": (1,)}, [])
+                wmax = _Node(None, var.name + "_max", {"__shape__": (1,)}, [])
+                return (qw, 0), (wmin, 0), (wmax, 0)
+            (qw, wmin, wmax) = qvar(1)
+            inputs = [(qdata, 0), qw]
+            if not no_bias:
+                (qb, bmin, bmax) = qvar(2)
+                inputs += [qb]
+            inputs += [(qdata, 1), (qdata, 2), wmin, wmax]
+            if not no_bias:
+                inputs += [bmin, bmax]
+            qnode = _Node(_QUANTIZABLE[node.op], node.name + "_quantized",
+                          dict(node.attrs), inputs)
+            mapping[id(node)] = {0: (qnode, 0), 1: (qnode, 1), 2: (qnode, 2)}
+        else:
+            clone = _Node(node.op, node.name, dict(node.attrs), ins)
+            mapping[id(node)] = {i: (clone, i)
+                                 for i in range(node.num_outputs)}
+
+    qsym = Symbol([new_entry(n, i) for n, i in sym._entries])
+    qargs = _quantize_params(arg_params, weight_names)
+    return qsym, qargs, dict(aux_params)

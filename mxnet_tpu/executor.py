@@ -21,10 +21,17 @@ from . import autograd
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, group2ctx=None):
         from .context import current_context
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # manual model parallelism (reference graph_executor.cc:908
+        # AssignContext): ops whose ctx_group attr maps to a Context run on
+        # that device, with transfers at group boundaries (the
+        # _CrossDeviceCopy analog is jax.device_put between groups)
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        self._group2dev = {name: c.jax_device()
+                           for name, c in (group2ctx or {}).items()}
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         if isinstance(args, (list, tuple)):
@@ -75,7 +82,11 @@ class Executor:
                      if n.op is not None and get_op(n.op).needs_rng]
         rng_index = {id(n): i for i, n in enumerate(rng_nodes)}
 
+        group2dev = self._group2dev
+        default_dev = self._ctx.jax_device() if group2dev else None
+
         def fn(arg_vals, aux_vals, keys):
+            import jax
             env = {}
             for n in nodes:
                 if n.op is None:
@@ -86,12 +97,18 @@ class Executor:
                     continue
                 op = get_op(n.op)
                 attrs = {k: v for k, v in n.attrs.items()
-                         if not k.startswith("__")}
+                         if not k.startswith("__") and k != "ctx_group"}
                 if op.mode_dependent:
                     attrs["_training"] = is_train
                 if op.needs_rng:
                     attrs["_rng_key"] = keys[rng_index[id(n)]]
                 in_vals = [env[(id(inp), idx)] for (inp, idx) in n.inputs]
+                if group2dev:
+                    # cross-device copy onto this op's assigned device;
+                    # ungrouped ops run on the bind context (AssignContext
+                    # default-context behavior)
+                    dev = group2dev.get(n.attrs.get("ctx_group"), default_dev)
+                    in_vals = [jax.device_put(v, dev) for v in in_vals]
                 out = op.fcompute(attrs, *in_vals)
                 outs = out if isinstance(out, (tuple, list)) else [out]
                 for i, o in enumerate(outs):
@@ -139,7 +156,10 @@ class Executor:
         else:
             if self._fwd_infer is None:
                 raw = self._build_fn(False)
-                self._fwd_infer = jax.jit(lambda a, x, k: tuple(raw(a, x, k)))
+                # group2ctx placement needs eager dispatch: inside one jit,
+                # XLA owns placement and per-op device pins are not honored
+                self._fwd_infer = raw if self._group2dev else \
+                    jax.jit(lambda a, x, k: tuple(raw(a, x, k)))
                 self._raw_infer = raw
             keys = self._keys()
             outs = self._fwd_infer(arg_vals, aux_vals, keys)
@@ -160,6 +180,12 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cts = tuple(g._data for g in out_grads)
+        if self._group2dev:
+            # head gradients must live where their outputs were produced —
+            # the reverse pass then threads device_put transposes backwards
+            import jax
+            cts = tuple(jax.device_put(g, list(o._data.devices())[0])
+                        for g, o in zip(cts, self.outputs))
         grads = vjp(cts)
         for name, g in zip(wrt_names, grads):
             req = self.grad_req.get(name, "write")
@@ -176,18 +202,27 @@ class Executor:
         """Return a new executor for new input shapes (XLA recompiles per
         shape; the jit cache keeps previously-seen shapes hot — the analog of
         GraphExecutor::Reshape, graph_executor.cc:786)."""
+        var_groups = self._symbol._variable_groups() if self._group2ctx else {}
+
+        def alloc_ctx(name):
+            group = var_groups.get(name)
+            if self._group2ctx and group in self._group2ctx:
+                return self._group2ctx[group]
+            return self._ctx
+
         new_args = {}
         for n in self.arg_names:
             if n in kwargs:
-                new_args[n] = nd_zeros(kwargs[n], ctx=self._ctx)
+                new_args[n] = nd_zeros(kwargs[n], ctx=alloc_ctx(n))
             else:
                 new_args[n] = self.arg_dict[n]
         new_grads = None
         if self.grad_dict:
-            new_grads = {n: nd_zeros(new_args[n].shape, ctx=self._ctx)
+            new_grads = {n: nd_zeros(new_args[n].shape, ctx=alloc_ctx(n))
                          for n in self.grad_dict if self.grad_dict[n] is not None}
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self.grad_req, dict(self.aux_dict))
+                        self.grad_req, dict(self.aux_dict),
+                        group2ctx=self._group2ctx)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

@@ -54,7 +54,7 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=logging, fixed_param_names=None, grad_req="write",
-                 state_names=None):
+                 state_names=None, group2ctxs=None):
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -66,6 +66,11 @@ class DataParallelExecutorGroup:
         self.logger = logger
         self.fixed_param_names = fixed_param_names or []
         self.state_names = state_names or []
+        # model-parallel placement map; a dict applies to every device, a
+        # list supplies one map per device (reference executor_group.py)
+        if isinstance(group2ctxs, dict):
+            group2ctxs = [group2ctxs] * len(contexts)
+        self.group2ctxs = group2ctxs or [None] * len(contexts)
         self.grad_req = {}
         for name in self.arg_names:
             if name in self.param_names:
@@ -148,7 +153,8 @@ class DataParallelExecutorGroup:
                 args_grad[name] = nd_zeros(shape, ctx=ctx)
         aux = {name: nd_zeros(shape, ctx=ctx)
                for name, shape in zip(self.aux_names, aux_shapes)}
-        return Executor(self.symbol, ctx, args, args_grad, self.grad_req, aux)
+        return Executor(self.symbol, ctx, args, args_grad, self.grad_req, aux,
+                        group2ctx=self.group2ctxs[i])
 
     def _collect_arrays(self):
         self.data_arrays = [[(self.slices[i], e.arg_dict[name])

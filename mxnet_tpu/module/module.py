@@ -62,6 +62,7 @@ class Module(BaseModule):
             setattr(self, attr, None)
         self._params_dirty = False
         self._compression_params = compression_params
+        self._group2ctxs = group2ctxs
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -207,7 +208,7 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group, logger=self.logger,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
-            state_names=self._state_names)
+            state_names=self._state_names, group2ctxs=self._group2ctxs)
         self.binded = True
 
         if self.params_initialized:

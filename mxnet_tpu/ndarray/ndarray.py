@@ -281,6 +281,11 @@ class NDArray:
             else:
                 new = jnp.broadcast_to(jnp.asarray(v, dtype=self._data.dtype),
                                        self.shape).astype(self._data.dtype)
+            if getattr(self._data, "committed", False):
+                # in-place writes keep the array on its device (the reference
+                # NDArray's context is sticky; matters for group2ctx)
+                import jax
+                new = jax.device_put(new, list(self._data.devices())[0])
         else:
             v = jnp.asarray(v).astype(self._data.dtype)
             new = self._data.at[idx].set(v)

@@ -38,9 +38,16 @@ def _quantize(attrs, data, min_range, max_range):
 
 @register("_contrib_quantize_v2", num_outputs=3)
 def _quantize_v2(attrs, data):
+    """Quantize with dynamic min/max, or calibrated thresholds when the
+    min_calib_range/max_calib_range attrs are set (quantize_v2-inl.h)."""
     jnp = _jnp()
-    mn = jnp.min(data)
-    mx = jnp.max(data)
+    if attrs.get("min_calib_range") is not None \
+            and attrs.get("max_calib_range") is not None:
+        mn = jnp.asarray(float(attrs["min_calib_range"]), jnp.float32)
+        mx = jnp.asarray(float(attrs["max_calib_range"]), jnp.float32)
+    else:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
     return _quantize({"out_type": attrs.get("out_type", "int8")},
                      data, mn.reshape((1,)), mx.reshape((1,)))
 
@@ -74,13 +81,21 @@ def _requantize(attrs, data, min_range, max_range):
 
 
 @register("_contrib_quantized_fully_connected", num_outputs=3)
-def _quantized_fc(attrs, data, weight, bias, min_data, max_data, min_w, max_w,
-                  min_b=None, max_b=None):
+def _quantized_fc(attrs, *inputs):
     """int8 x int8 -> fp32 FC (quantized_fully_connected.cc).  The int8 dot
-    hits the MXU's native int8 path (preferred_element_type=int32)."""
+    hits the MXU's native int8 path (preferred_element_type=int32).
+
+    Inputs follow the reference layout: with bias
+    (data, weight, bias, min_data, max_data, min_w, max_w, min_b, max_b),
+    without (data, weight, min_data, max_data, min_w, max_w)."""
     import jax
     jnp = _jnp()
-    num_hidden = int(attrs["num_hidden"])
+    if len(inputs) == 6:
+        data, weight, min_data, max_data, min_w, max_w = inputs
+        bias = min_b = max_b = None
+    else:
+        (data, weight, bias, min_data, max_data, min_w, max_w,
+         min_b, max_b) = inputs
     d_scale = jnp.maximum(jnp.abs(min_data.reshape(())),
                           jnp.abs(max_data.reshape(()))) / 127.0
     w_scale = jnp.maximum(jnp.abs(min_w.reshape(())),
@@ -94,6 +109,48 @@ def _quantized_fc(attrs, data, weight, bias, min_data, max_data, min_w, max_w,
         b_scale = jnp.maximum(jnp.abs(min_b.reshape(())),
                               jnp.abs(max_b.reshape(()))) / 127.0
         out = out + bias.astype(jnp.float32) * b_scale
+    out_min = jnp.min(out).reshape((1,))
+    out_max = jnp.max(out).reshape((1,))
+    return out, out_min, out_max
+
+
+@register("_contrib_quantized_conv", num_outputs=3)
+def _quantized_conv(attrs, *inputs):
+    """int8 x int8 -> fp32 convolution (quantized_conv.cc).  The int8 conv
+    accumulates in int32 (preferred_element_type), hitting the MXU's native
+    int8 path on TPU; the float rescale is a fused epilogue.  Input layout
+    as in _quantized_fc (6 inputs without bias, 9 with)."""
+    import jax
+    from jax import lax
+    jnp = _jnp()
+    if len(inputs) == 6:
+        data, weight, min_data, max_data, min_w, max_w = inputs
+        bias = min_b = max_b = None
+    else:
+        (data, weight, bias, min_data, max_data, min_w, max_w,
+         min_b, max_b) = inputs
+    from .nn_ops import _conv_dims, _pair
+    nd_ = data.ndim - 2
+    stride = _pair(attrs.get("stride", (1,) * nd_), nd_)
+    pad = _pair(attrs.get("pad", (0,) * nd_), nd_)
+    groups = int(attrs.get("num_group", 1))
+    d_scale = jnp.maximum(jnp.abs(min_data.reshape(())),
+                          jnp.abs(max_data.reshape(()))) / 127.0
+    w_scale = jnp.maximum(jnp.abs(min_w.reshape(())),
+                          jnp.abs(max_w.reshape(()))) / 127.0
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dims(data.ndim))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (d_scale * w_scale)
+    if bias is not None and not attrs.get("no_bias", False):
+        b_scale = jnp.maximum(jnp.abs(min_b.reshape(())),
+                              jnp.abs(max_b.reshape(()))) / 127.0
+        out = out + (bias.astype(jnp.float32) * b_scale).reshape(
+            (1, -1) + (1,) * nd_)
     out_min = jnp.min(out).reshape((1,))
     out_max = jnp.max(out).reshape((1,))
     return out, out_min, out_max

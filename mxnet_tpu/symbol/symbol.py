@@ -318,7 +318,12 @@ class Symbol:
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
         return Executor(self, ctx, args or {}, args_grad, grad_req,
-                        aux_states or {})
+                        aux_states or {}, group2ctx=group2ctx)
+
+    def _variable_groups(self):
+        """ctx_group attr per variable name (for group2ctx allocation)."""
+        return {n.name: n.attrs.get("ctx_group")
+                for n in self._topo_nodes() if n.op is None}
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
@@ -331,13 +336,25 @@ class Symbol:
                              "input shapes")
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
-        args = {n: nd_zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)}
-        aux = {n: nd_zeros(s, ctx=ctx) for n, s in zip(aux_names, aux_shapes)}
+        var_groups = self._variable_groups() if group2ctx else {}
+
+        def alloc_ctx(name):
+            # reference AssignContext: variables live on their group's device
+            group = var_groups.get(name)
+            if group2ctx and group in group2ctx:
+                return group2ctx[group]
+            return ctx
+
+        args = {n: nd_zeros(s, ctx=alloc_ctx(n))
+                for n, s in zip(arg_names, arg_shapes)}
+        aux = {n: nd_zeros(s, ctx=alloc_ctx(n))
+               for n, s in zip(aux_names, aux_shapes)}
         args_grad = None
         if grad_req != "null":
-            args_grad = {n: nd_zeros(s, ctx=ctx)
+            args_grad = {n: nd_zeros(s, ctx=alloc_ctx(n))
                          for n, s in zip(arg_names, arg_shapes)}
-        return Executor(self, ctx, args, args_grad, grad_req, aux)
+        return Executor(self, ctx, args, args_grad, grad_req, aux,
+                        group2ctx=group2ctx)
 
     # gradient via executor; symbolic .grad() kept for API parity
     def grad(self, wrt):
@@ -489,6 +506,8 @@ def _create(op_name, input_syms, attrs, name=None, kw_inputs=None):
             else:
                 var_name = "%s_%s" % (name, short)
                 var_attrs = {}
+                if "ctx_group" in merged:  # params follow their op's group
+                    var_attrs["ctx_group"] = merged["ctx_group"]
                 if aux:
                     var_attrs["__is_aux__"] = True
                 if zero:
