@@ -1,0 +1,78 @@
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import quantization as q
+rng = np.random.RandomState(0)
+print('imports done', flush=True)
+
+# Case 1: FC on 4D data (default flatten=True), no explicit Flatten
+data = sym.Variable('data')
+out = sym.FullyConnected(data, name='fc', num_hidden=6)
+exe = out.simple_bind(ctx=mx.cpu(), grad_req='null', data=(2, 3, 4, 4))
+args = {}
+for n, a in exe.arg_dict.items():
+    if n == 'data':
+        continue
+    v = rng.uniform(-0.5, 0.5, a.shape).astype(np.float32)
+    a[:] = v
+    args[n] = nd.array(v)
+qsym, qargs, _ = q.quantize_model(out, args, {})
+try:
+    exe2 = qsym.simple_bind(ctx=mx.cpu(), grad_req='null', data=(2, 3, 4, 4))
+    for n, a in exe2.arg_dict.items():
+        if n == 'data':
+            a[:] = rng.uniform(-1, 1, (2, 3, 4, 4)).astype(np.float32)
+        elif n in qargs:
+            a[:] = qargs[n]
+    o = exe2.forward()[0]
+    print('FC4D OK shape', o.shape, flush=True)
+except Exception as e:
+    print('FC4D FAILED:', type(e).__name__, str(e)[:160], flush=True)
+
+# Case 2: dilated conv
+data = sym.Variable('d2')
+out = sym.Convolution(data, name='c', kernel=(3, 3), num_filter=4,
+                      dilate=(2, 2), pad=(2, 2))
+exe = out.simple_bind(ctx=mx.cpu(), grad_req='null', d2=(1, 2, 8, 8))
+x = rng.uniform(-1, 1, (1, 2, 8, 8)).astype(np.float32)
+args = {}
+for n, a in exe.arg_dict.items():
+    if n == 'd2':
+        a[:] = x
+        continue
+    v = rng.uniform(-0.5, 0.5, a.shape).astype(np.float32)
+    a[:] = v
+    args[n] = nd.array(v)
+want = exe.forward()[0].asnumpy()
+qsym, qargs, _ = q.quantize_model(out, args, {})
+try:
+    exe2 = qsym.simple_bind(ctx=mx.cpu(), grad_req='null', d2=(1, 2, 8, 8))
+    for n, a in exe2.arg_dict.items():
+        if n == 'd2':
+            a[:] = x
+        elif n in qargs:
+            a[:] = qargs[n]
+    got = exe2.forward()[0].asnumpy()
+    print('conv fp shape', want.shape, 'q shape', got.shape, flush=True)
+    if got.shape == want.shape:
+        print('conv maxdiff', float(np.abs(got - want).max()), flush=True)
+except Exception as e:
+    print('CONV FAILED:', type(e).__name__, str(e)[:160], flush=True)
+
+# Case 3: shared weight between quantized and excluded op
+data = sym.Variable('d3')
+w = sym.Variable('shared_weight')
+a1 = sym.FullyConnected(data, weight=w, name='fca', num_hidden=5, no_bias=True)
+a2 = sym.FullyConnected(data, weight=w, name='fcb', num_hidden=5, no_bias=True)
+out = a1 + a2
+exe = out.simple_bind(ctx=mx.cpu(), grad_req='null', d3=(2, 5))
+args = {}
+for n, a in exe.arg_dict.items():
+    if n == 'd3':
+        continue
+    v = rng.uniform(-0.5, 0.5, a.shape).astype(np.float32)
+    a[:] = v
+    args[n] = nd.array(v)
+qsym, qargs, _ = q.quantize_model(out, args, {}, excluded_sym_names=['fcb'])
+print('qsym args:', sorted(qsym.list_arguments()), flush=True)
+print('shared_weight in qargs:', 'shared_weight' in qargs, flush=True)

@@ -94,9 +94,11 @@ _QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
                 "Convolution": "_contrib_quantized_conv"}
 
 
-def _quantize_params(arg_params, weight_names):
+def _quantize_params(arg_params, weight_names, still_needed=()):
     """Offline int8 quantization of weights/biases: name_quantized (int8) +
-    name_min/name_max scalar params (quantize_graph_pass.cc param handling)."""
+    name_min/name_max scalar params (quantize_graph_pass.cc param handling).
+    fp originals are kept when a non-quantized consumer still references
+    them (shared/tied weights)."""
     qargs = dict(arg_params)
     for name in sorted(set(weight_names)):
         arr = arg_params[name].asnumpy()
@@ -105,7 +107,8 @@ def _quantize_params(arg_params, weight_names):
         qargs[name + "_quantized"] = array(q.astype(_np.int8))
         qargs[name + "_min"] = array([-amax])
         qargs[name + "_max"] = array([amax])
-        del qargs[name]
+        if name not in still_needed:
+            del qargs[name]
     return qargs
 
 
@@ -143,10 +146,9 @@ def _calibrate_ranges(sym, arg_params, aux_params, calib_data, target_inputs,
             a = out.asnumpy().ravel()
             lo, hi = ranges[key]
             ranges[key] = (min(lo, float(a.min())), max(hi, float(a.max())))
-            if mode == "entropy":
-                if sizes[key] + a.size > cap:
-                    a = rng.choice(a, size=max(cap // 8, 1), replace=False) \
-                        if a.size > cap // 8 else a
+            if mode == "entropy" and sizes[key] < cap:
+                if sizes[key] + a.size > cap and a.size > cap // 8:
+                    a = rng.choice(a, size=cap // 8, replace=False)
                 samples[key].append(a)
                 sizes[key] += a.size
         seen += batch.data[0].shape[0]
@@ -206,6 +208,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     mapping = {}          # id(old node) -> {output idx: (new node, idx)}
     weight_names = []
+    qvar_cache = {}       # shared weights quantize to ONE variable triple
 
     def new_entry(old_node, idx):
         return mapping[id(old_node)][idx]
@@ -228,6 +231,8 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             # weight/bias -> offline int8 param variables
             def qvar(pos):
                 var = node.inputs[pos][0]
+                if var.name in qvar_cache:
+                    return qvar_cache[var.name]
                 weight_names.append(var.name)
                 attrs = dict(var.attrs)
                 if var.name in arg_params:  # known shape seeds inference
@@ -236,7 +241,8 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                 qw = _Node(None, var.name + "_quantized", attrs, [])
                 wmin = _Node(None, var.name + "_min", {"__shape__": (1,)}, [])
                 wmax = _Node(None, var.name + "_max", {"__shape__": (1,)}, [])
-                return (qw, 0), (wmin, 0), (wmax, 0)
+                qvar_cache[var.name] = (qw, 0), (wmin, 0), (wmax, 0)
+                return qvar_cache[var.name]
             (qw, wmin, wmax) = qvar(1)
             inputs = [(qdata, 0), qw]
             if not no_bias:
@@ -254,5 +260,6 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                                  for i in range(node.num_outputs)}
 
     qsym = Symbol([new_entry(n, i) for n, i in sym._entries])
-    qargs = _quantize_params(arg_params, weight_names)
+    qargs = _quantize_params(arg_params, weight_names,
+                             still_needed=set(qsym.list_arguments()))
     return qsym, qargs, dict(aux_params)

@@ -70,6 +70,10 @@ class DataParallelExecutorGroup:
         # list supplies one map per device (reference executor_group.py)
         if isinstance(group2ctxs, dict):
             group2ctxs = [group2ctxs] * len(contexts)
+        if group2ctxs and len(group2ctxs) != len(contexts):
+            raise ValueError("group2ctxs must supply one map per context "
+                             "(%d maps for %d contexts)"
+                             % (len(group2ctxs), len(contexts)))
         self.group2ctxs = group2ctxs or [None] * len(contexts)
         self.grad_req = {}
         for name in self.arg_names:

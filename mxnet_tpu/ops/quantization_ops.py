@@ -96,6 +96,8 @@ def _quantized_fc(attrs, *inputs):
     else:
         (data, weight, bias, min_data, max_data, min_w, max_w,
          min_b, max_b) = inputs
+    if bool(attrs.get("flatten", True)) and data.ndim > 2:
+        data = data.reshape((data.shape[0], -1))  # fp FC flattens implicitly
     d_scale = jnp.maximum(jnp.abs(min_data.reshape(())),
                           jnp.abs(max_data.reshape(()))) / 127.0
     w_scale = jnp.maximum(jnp.abs(min_w.reshape(())),
@@ -133,6 +135,7 @@ def _quantized_conv(attrs, *inputs):
     nd_ = data.ndim - 2
     stride = _pair(attrs.get("stride", (1,) * nd_), nd_)
     pad = _pair(attrs.get("pad", (0,) * nd_), nd_)
+    dilate = _pair(attrs.get("dilate", (1,) * nd_), nd_)
     groups = int(attrs.get("num_group", 1))
     d_scale = jnp.maximum(jnp.abs(min_data.reshape(())),
                           jnp.abs(max_data.reshape(()))) / 127.0
@@ -143,6 +146,7 @@ def _quantized_conv(attrs, *inputs):
     acc = lax.conv_general_dilated(
         data.astype(jnp.int8), weight.astype(jnp.int8),
         window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=groups,
         preferred_element_type=jnp.int32)
     out = acc.astype(jnp.float32) * (d_scale * w_scale)

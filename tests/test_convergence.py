@@ -1,0 +1,129 @@
+"""In-tree training convergence tests (reference:
+tests/python/train/test_mlp.py, test_conv.py).
+
+Synthetic class-separable data stands in for MNIST so CI needs no dataset;
+the criterion (final train accuracy above a hard threshold) mirrors the
+reference's accuracy assertion.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _blob_data(n=512, dim=32, classes=10, seed=0):
+    """Gaussian blobs: linearly separable given enough margin."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype(np.float32) * 3.0
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _mlp_symbol(classes=10):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=32)
+    net = sym.Activation(net, act_type="relu", name="relu2")
+    net = sym.FullyConnected(net, name="fc3", num_hidden=classes)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_mlp_module_convergence():
+    """Module.fit drives an MLP to high train accuracy (ref test_mlp.py)."""
+    X, Y = _blob_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    metric = mx.metric.create("acc")
+    mod.fit(train, eval_metric=metric, num_epoch=12,
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+            initializer=mx.init.Xavier())
+    assert metric.get()[1] > 0.95, metric.get()
+    # scoring API agrees with the training metric
+    score = mod.score(train, mx.metric.create("acc"))[0][1]
+    assert score > 0.95
+
+
+def test_mlp_adam_convergence():
+    X, Y = _blob_data(seed=1)
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    metric = mx.metric.create("acc")
+    mod.fit(train, eval_metric=metric, num_epoch=10, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=mx.init.Xavier())
+    assert metric.get()[1] > 0.95, metric.get()
+
+
+def test_convnet_convergence():
+    """A small conv net fits image-shaped blobs (ref test_conv.py)."""
+    rng = np.random.RandomState(2)
+    n, classes = 256, 4
+    y = rng.randint(0, classes, n)
+    # each class lights up a distinct quadrant
+    x = rng.randn(n, 1, 8, 8).astype(np.float32) * 0.3
+    for i, cls in enumerate(y):
+        r, c = divmod(int(cls), 2)
+        x[i, 0, r * 4:(r + 1) * 4, c * 4:(c + 1) * 4] += 2.0
+    data = sym.Variable("data")
+    net = sym.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8,
+                          pad=(1, 1))
+    net = sym.Activation(net, act_type="relu", name="r1")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                      name="p1")
+    net = sym.Flatten(net, name="flat")
+    net = sym.FullyConnected(net, name="fc", num_hidden=classes)
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    train = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size=32,
+                              shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    metric = mx.metric.create("acc")
+    mod.fit(train, eval_metric=metric, num_epoch=10, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+            initializer=mx.init.Xavier())
+    assert metric.get()[1] > 0.95, metric.get()
+
+
+def test_gluon_trainer_convergence():
+    """The gluon Trainer path reaches the same quality (ref gluon tests)."""
+    from mxnet_tpu import gluon
+    X, Y = _blob_data(n=256, seed=3)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xs, ys = nd.array(X), nd.array(Y)
+    for _ in range(60):
+        with mx.autograd.record():
+            loss = loss_fn(net(xs), ys)
+        loss.backward()
+        trainer.step(X.shape[0])
+    pred = net(xs).asnumpy().argmax(axis=1)
+    assert (pred == Y).mean() > 0.95
+
+
+def test_checkpoint_resume_continues_convergence():
+    """save_checkpoint/load + resumed fit keeps improving (ref test_mlp)."""
+    import tempfile, os
+    X, Y = _blob_data(n=256, seed=4)
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=3, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.init.Xavier())
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "mlp")
+        mod.save_checkpoint(prefix, 3)
+        mod2 = mx.mod.Module.load(prefix, 3)
+        metric = mx.metric.create("acc")
+        mod2.fit(train, eval_metric=metric, num_epoch=10, begin_epoch=3,
+                 optimizer="sgd",
+                 optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+        assert metric.get()[1] > 0.95, metric.get()

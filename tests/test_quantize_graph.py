@@ -108,6 +108,36 @@ def test_quantize_model_calibrated(mode):
     assert (got.argmax(axis=1) == want.argmax(axis=1)).all()
 
 
+def test_quantize_fc_implicit_flatten():
+    # FC flattens >2D input implicitly; the quantized FC must too
+    data = sym.Variable("data")
+    h = sym.Convolution(data, name="c", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    out = sym.FullyConnected(h, name="fc", num_hidden=6)  # no Flatten node
+    _, args = _init(out, (2, 2, 4, 4))
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (2, 2, 4, 4)).astype(np.float32)
+    want = _run(out, args, {}, x)
+    qsym, qargs, _ = q.quantize_model(out, args, {})
+    got = _run(qsym, qargs, {}, x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.05)
+
+
+def test_quantize_dilated_conv():
+    data = sym.Variable("data")
+    out = sym.Convolution(data, name="c", kernel=(3, 3), num_filter=2,
+                          dilate=(2, 2), pad=(2, 2))
+    _, args = _init(out, (1, 2, 8, 8))
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-1, 1, (1, 2, 8, 8)).astype(np.float32)
+    want = _run(out, args, {}, x)
+    qsym, qargs, _ = q.quantize_model(out, args, {})
+    got = _run(qsym, qargs, {}, x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.05)
+
+
 def test_quantize_no_bias_path():
     data = sym.Variable("data")
     out = sym.FullyConnected(data, name="fc", num_hidden=6, no_bias=True)
