@@ -19,6 +19,9 @@ import traceback
 
 import numpy as np
 
+# knob defaults live in mxnet_tpu/env.py (the env_var.md registry); read
+# them lazily here because bench.py must emit a JSON error line even when
+# the package fails to import
 BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 IMG = int(os.environ.get("BENCH_IMG", "224"))
 BASELINE_IMGS_PER_SEC = 298.51  # V100 fp32 train, docs/faq/perf.md:208-217

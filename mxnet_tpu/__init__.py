@@ -30,6 +30,7 @@ from .kvstore import create as _kvstore_create
 from . import engine
 from . import profiler
 from . import util
+from . import env
 
 init = initializer  # mx.init.Xavier() style access
 kvstore = kvs

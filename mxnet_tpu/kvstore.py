@@ -208,10 +208,13 @@ class KVStoreDist(KVStoreTPUSync):
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
         import os
-        self._rank = int(os.environ.get("MX_KV_RANK",
-                                        os.environ.get("DMLC_WORKER_ID", "0")))
-        self._num_workers = int(os.environ.get("MX_KV_NUM_WORKERS",
-                                               os.environ.get("DMLC_NUM_WORKER", "1")))
+        from . import env as _env
+        self._rank = int(_env.get("MX_KV_RANK")
+                         if _env.get("MX_KV_RANK") is not None
+                         else _env.get("DMLC_WORKER_ID"))
+        self._num_workers = int(_env.get("MX_KV_NUM_WORKERS")
+                                if _env.get("MX_KV_NUM_WORKERS") is not None
+                                else _env.get("DMLC_NUM_WORKER"))
         self._initialized_dist = False
         if self._num_workers > 1:
             self._init_distributed()
@@ -219,8 +222,12 @@ class KVStoreDist(KVStoreTPUSync):
     def _init_distributed(self):
         import os
         import jax
-        coord = os.environ.get("MX_KV_ROOT_URI", os.environ.get("DMLC_PS_ROOT_URI"))
-        port = os.environ.get("MX_KV_ROOT_PORT", os.environ.get("DMLC_PS_ROOT_PORT", "9876"))
+        from . import env as _env
+        coord = (_env.get("MX_KV_ROOT_URI") if _env.get("MX_KV_ROOT_URI")
+                 is not None else _env.get("DMLC_PS_ROOT_URI"))
+        port = str(_env.get("MX_KV_ROOT_PORT")
+                   if _env.get("MX_KV_ROOT_PORT") is not None
+                   else _env.get("DMLC_PS_ROOT_PORT"))
         if coord is None:
             # silently skipping would leave every worker training a
             # diverging model with no cross-host reduce

@@ -238,3 +238,4 @@ def test_scheduler_in_optimizer():
     for _ in range(5):
         opt.update(0, w, g, state)  # zero grads: only lr schedule advances
     assert w.asscalar() == pytest.approx(1.0)
+
