@@ -28,7 +28,7 @@ class EnvVar:
         if raw is None:
             return self.default
         if self.type is bool:
-            return raw not in ("0", "false", "False", "")
+            return raw.strip().lower() not in ("0", "false", "no", "off", "")
         return self.type(raw)
 
 
@@ -93,6 +93,18 @@ ABSORBED = {
 def get(name):
     """Typed value of a registered knob (env override or default)."""
     return VARIABLES[name].read()
+
+
+def get_first(*names):
+    """First non-None value along an override chain, else the last default.
+
+    Expresses precedence rules like MX_KV_RANK > DMLC_WORKER_ID once, here,
+    where they are documented."""
+    for name in names:
+        val = get(name)
+        if val is not None:
+            return val
+    return VARIABLES[names[-1]].default
 
 
 def describe(file=None):

@@ -131,7 +131,18 @@ class KVStore:
 
     # ------------------------------------------------------------------
     def _reduce(self, vlist):
-        """Reduce a list of per-device arrays to one (CommDevice analog)."""
+        """Reduce a list of per-device arrays to one (CommDevice analog).
+
+        All-row_sparse input reduces sparsely (indices-union add, the
+        CommCPU row_sparse reduce at src/kvstore/comm.h:182) — a (1e6, d)
+        embedding gradient with few touched rows never densifies."""
+        if all(getattr(v, "stype", "default") == "row_sparse" for v in vlist):
+            if len(vlist) == 1:
+                return vlist[0].copy()   # sparse copy() clones aux fields
+            out = vlist[0]
+            for v in vlist[1:]:
+                out = invoke("elemwise_add", [out, v], {})
+            return out
         if len(vlist) == 1:
             return vlist[0].copy()
         return invoke("add_n", list(vlist), {})
@@ -209,12 +220,9 @@ class KVStoreDist(KVStoreTPUSync):
         super().__init__(kv_type)
         import os
         from . import env as _env
-        self._rank = int(_env.get("MX_KV_RANK")
-                         if _env.get("MX_KV_RANK") is not None
-                         else _env.get("DMLC_WORKER_ID"))
-        self._num_workers = int(_env.get("MX_KV_NUM_WORKERS")
-                                if _env.get("MX_KV_NUM_WORKERS") is not None
-                                else _env.get("DMLC_NUM_WORKER"))
+        self._rank = int(_env.get_first("MX_KV_RANK", "DMLC_WORKER_ID"))
+        self._num_workers = int(_env.get_first("MX_KV_NUM_WORKERS",
+                                               "DMLC_NUM_WORKER"))
         self._initialized_dist = False
         if self._num_workers > 1:
             self._init_distributed()
@@ -223,11 +231,8 @@ class KVStoreDist(KVStoreTPUSync):
         import os
         import jax
         from . import env as _env
-        coord = (_env.get("MX_KV_ROOT_URI") if _env.get("MX_KV_ROOT_URI")
-                 is not None else _env.get("DMLC_PS_ROOT_URI"))
-        port = str(_env.get("MX_KV_ROOT_PORT")
-                   if _env.get("MX_KV_ROOT_PORT") is not None
-                   else _env.get("DMLC_PS_ROOT_PORT"))
+        coord = _env.get_first("MX_KV_ROOT_URI", "DMLC_PS_ROOT_URI")
+        port = str(_env.get_first("MX_KV_ROOT_PORT", "DMLC_PS_ROOT_PORT"))
         if coord is None:
             # silently skipping would leave every worker training a
             # diverging model with no cross-host reduce

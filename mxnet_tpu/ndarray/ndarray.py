@@ -188,8 +188,10 @@ class NDArray:
         if isinstance(other, NDArray):
             if other is self:
                 return other
+            # other.dtype, not other._data.dtype: reading _data on a lazy
+            # sparse target would densify it just to learn the dtype
             other._set_data(jax.device_put(self._data, other._ctx.jax_device())
-                            .astype(other._data.dtype))
+                            .astype(other.dtype))
             return other
         if isinstance(other, Context):
             return _wrap(jax.device_put(self._data, other.jax_device()), ctx=other)
@@ -500,6 +502,27 @@ def invoke(op_name, inputs, attrs, out=None):
     if op.needs_rng:
         from .. import random as _random
         attrs["_rng_key"] = _random.next_key()
+
+    # FComputeEx dispatch: a sparse-aware implementation consumes NDArray
+    # inputs directly (aux fields, no densification).  Skipped while the
+    # tape records — sparse handlers aren't traceable, so gradients route
+    # through the dense fallback (the reference's storage fallback,
+    # src/common/exec_utils.h).
+    if op.fcompute_ex is not None and not autograd.is_recording() and any(
+            getattr(i, "_stype", "default") != "default" for i in inputs):
+        ex_result = op.fcompute_ex(attrs, *inputs)
+        if ex_result is not NotImplemented:
+            ex_outputs = (list(ex_result) if isinstance(ex_result, (tuple, list))
+                          else [ex_result])
+            if out is not None:
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for o, r in zip(outs, ex_outputs):
+                    if getattr(r, "_stype", "default") != "default":
+                        r.copyto(o)
+                    else:
+                        o._set_data(r._data.astype(o._data.dtype))
+                return out
+            return ex_outputs if isinstance(ex_result, (tuple, list)) else ex_result
 
     vals = [(i._data if isinstance(i, NDArray) else i) for i in inputs]
     result = op.apply(attrs, *vals)

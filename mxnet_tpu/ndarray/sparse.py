@@ -1,14 +1,21 @@
-"""Sparse NDArrays: row_sparse and CSR.
+"""Sparse NDArrays: row_sparse and CSR — genuinely index-backed.
 
 Reference: python/mxnet/ndarray/sparse.py + src/ndarray (stypes at
 include/mxnet/ndarray.h:61-65) — RowSparseNDArray (indices + values rows,
 the large-embedding/gradient format pulled via kvstore PullRowSparse) and
-CSRNDArray.
+CSRNDArray (data/indices/indptr).
 
-TPU-native: backed by jax.experimental.sparse BCOO where ops need it, with
-explicit (indices, data) fields matching the reference layout.  Round-1 scope:
-construction, conversion to/from dense, retain, basic arithmetic via
-densification; sparse-aware dot and optimizer updates widen later.
+TPU-native design: a sparse array stores ONLY its aux fields (values +
+indices [+ indptr]); the dense buffer is materialized lazily, and only when
+an op without a sparse-aware implementation touches it — the analog of the
+reference's storage fallback (src/common/exec_utils.h casts non-default
+storage to dense before a plain FCompute).  Sparse-aware ops (dot,
+elemwise_add, the lazy-update optimizer kernels — the FComputeEx analogs,
+registered via ops.registry.register_sparse) consume the aux fields
+directly, so a (1e6, d) embedding gradient with 100 touched rows costs
+O(100*d) memory and compute, never O(1e6*d).  A dense write into a sparse
+handle (e.g. ``copyto``) invalidates the aux fields, which are re-extracted
+lazily on access — mirroring the reference's cast_storage round trip.
 """
 from __future__ import annotations
 
@@ -17,119 +24,253 @@ import numpy as _np
 from .ndarray import NDArray, _wrap, array, zeros as nd_zeros
 from ..base import MXNetError
 
-__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
-           "cast_storage", "rand_sparse_ndarray", "retain"]
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage",
+           "rand_sparse_ndarray", "retain", "zeros"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _as_jax(x, dtype=None):
+    import jax.numpy as jnp
+    v = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    if dtype is not None:
+        v = v.astype(dtype)
+    return v
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ("_aux",)
+    """Common lazy-densify machinery for row_sparse / CSR.
+
+    ``_aux`` holds the sparse fields (jax arrays); ``_data_buf`` stays None
+    until something actually needs the dense view.  ``_shape_`` carries the
+    logical dense shape (aux fields alone don't determine it)."""
+
+    __slots__ = ("_aux", "_shape_")
+
+    def __init__(self, shape, ctx=None):
+        # NDArray.__init__ routes through the _data setter; None keeps the
+        # dense buffer unmaterialized.
+        super().__init__(None, ctx=ctx)
+        self._shape_ = tuple(int(s) for s in shape)
+        self._aux = None
+
+    # -- lazy dense buffer ------------------------------------------------
+    @property
+    def _data(self):
+        if self._data_buf is None:
+            # bump version via the setter so views/autograd stay coherent
+            NDArray._data.fset(self, self._densify())
+        return self._data_buf
+
+    @_data.setter
+    def _data(self, value):
+        NDArray._data.fset(self, value)
+        if value is not None:
+            # dense write: aux fields are stale; re-extract on demand
+            self._aux = None
+            self._shape_ = tuple(int(s) for s in value.shape)
+
+    def _densify(self):
+        raise NotImplementedError
+
+    def _extract_aux(self):
+        """Rebuild aux fields from the dense buffer after a dense write."""
+        raise NotImplementedError
+
+    def _get_aux(self):
+        if self._aux is None:
+            self._extract_aux()
+        return self._aux
+
+    # -- shape/dtype without materializing dense --------------------------
+    @property
+    def shape(self):
+        return self._shape_
+
+    @property
+    def ndim(self):
+        return len(self._shape_)
+
+    @property
+    def dtype(self):
+        dt = self._get_aux()["data"].dtype
+        try:
+            return _np.dtype(dt)
+        except TypeError:
+            return dt
+
+    @property
+    def nnz(self):
+        return int(self._get_aux()["data"].shape[0])
+
+    def wait_to_read(self):
+        if self._data_buf is not None:
+            self._data_buf.block_until_ready()
+        else:
+            for v in self._get_aux().values():
+                v.block_until_ready()
 
     def asnumpy(self):
         return self.todense().asnumpy()
 
     def todense(self):
-        raise NotImplementedError
+        return _wrap(self._data, ctx=self._ctx)
 
     def tostype(self, stype):
         if stype == "default":
             return self.todense()
         return cast_storage(self, stype)
 
-
-class RowSparseNDArray(BaseSparseNDArray):
-    """(indices, values-rows) pair: data[indices[i]] = values[i]."""
-
-    def __init__(self, data, indices, shape, ctx=None):
-        import jax.numpy as jnp
-        dense = jnp.zeros(shape, dtype=data._data.dtype if isinstance(data, NDArray)
-                          else _np.float32)
-        super().__init__(dense, ctx=ctx)
-        self._stype = "row_sparse"
-        self._aux = {"data": data, "indices": indices}
-        idx = indices._data.astype("int32") if isinstance(indices, NDArray) else indices
-        vals = data._data if isinstance(data, NDArray) else data
-        self._data = dense.at[idx].set(vals)
-
-    @property
-    def data(self):
-        return self._aux["data"]
-
-    @property
-    def indices(self):
-        return self._aux["indices"]
-
-    def todense(self):
-        return _wrap(self._data, ctx=self._ctx)
-
-    def retain(self, row_ids):
-        import jax.numpy as jnp
-        rid = row_ids._data.astype("int32")
-        rows = self._data[rid]
-        return row_sparse_array((_wrap(rows), _wrap(rid)),
-                                shape=self.shape, ctx=self._ctx)
+    def copy(self):
+        """Clone without densifying: aux fields are immutable jax arrays, so
+        sharing them is safe; in-place ops on the clone re-extract."""
+        out = object.__new__(type(self))
+        NDArray.__init__(out, None, ctx=self._ctx)
+        out._shape_ = self._shape_
+        out._stype = self._stype
+        out._aux = dict(self._get_aux())
+        return out
 
     def copyto(self, other):
+        if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
+            other._shape_ = self._shape_
+            other._aux = dict(self._get_aux())
+            NDArray._data.fset(other, None)
+            return other
         if isinstance(other, NDArray):
             other._set_data(self._data)
             return other
         return super().copyto(other)
 
 
-class CSRNDArray(BaseSparseNDArray):
-    def __init__(self, data, indices, indptr, shape, ctx=None):
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values-rows) pair: dense[indices[i]] = values[i].
+
+    Indices are kept sorted (the reference's invariant for row_sparse ops,
+    src/operator/tensor/sparse_retain-inl.h relies on it)."""
+
+    def __init__(self, data, indices, shape, ctx=None):
         import jax.numpy as jnp
-        vals = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        idx = (indices._data if isinstance(indices, NDArray)
-               else jnp.asarray(indices)).astype("int32")
-        ptr = (indptr._data if isinstance(indptr, NDArray)
-               else jnp.asarray(indptr)).astype("int32")
-        dense = _np.zeros(shape, dtype=_np.asarray(vals).dtype)
-        ptr_np = _np.asarray(ptr)
-        idx_np = _np.asarray(idx)
-        vals_np = _np.asarray(vals)
-        for r in range(shape[0]):
-            for j in range(ptr_np[r], ptr_np[r + 1]):
-                dense[r, idx_np[j]] = vals_np[j]
-        super().__init__(jnp.asarray(dense), ctx=ctx)
-        self._stype = "csr"
-        self._aux = {"data": _wrap(vals), "indices": _wrap(idx), "indptr": _wrap(ptr)}
+        super().__init__(shape, ctx=ctx)
+        self._stype = "row_sparse"
+        vals = _as_jax(data)
+        idx = _as_jax(indices).astype(jnp.int32)
+        if idx.shape[0] > 1 and not bool((_np.diff(_np.asarray(idx)) > 0).all()):
+            order = jnp.argsort(idx)
+            idx, vals = idx[order], vals[order]
+        self._aux = {"data": vals, "indices": idx}
+
+    def _densify(self):
+        jnp = _jnp()
+        aux = self._get_aux()
+        dense = jnp.zeros(self._shape_, dtype=aux["data"].dtype)
+        if aux["data"].shape[0]:
+            dense = dense.at[aux["indices"]].set(aux["data"])
+        return dense
+
+    def _extract_aux(self):
+        dense = _np.asarray(self._data_buf)
+        nz = _np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+        jnp = _jnp()
+        self._aux = {"data": jnp.asarray(dense[nz]),
+                     "indices": jnp.asarray(nz.astype(_np.int32))}
 
     @property
     def data(self):
-        return self._aux["data"]
+        return _wrap(self._get_aux()["data"], ctx=self._ctx)
 
     @property
     def indices(self):
-        return self._aux["indices"]
+        return _wrap(self._get_aux()["indices"], ctx=self._ctx)
+
+    def retain(self, row_ids):
+        """Keep only the requested rows — pure aux-field compute, O(nnz).
+
+        (reference sparse_retain, src/operator/tensor/sparse_retain-inl.h)"""
+        jnp = _jnp()
+        aux = self._get_aux()
+        idx, vals = aux["indices"], aux["data"]
+        rid = _as_jax(row_ids).astype(jnp.int32)
+        if vals.shape[0] == 0:
+            empty = jnp.zeros((0,) + tuple(self._shape_[1:]), vals.dtype)
+            return RowSparseNDArray(empty, rid[:0], self._shape_, ctx=self._ctx)
+        pos = jnp.searchsorted(idx, rid)
+        posc = jnp.clip(pos, 0, idx.shape[0] - 1)
+        hit = idx[posc] == rid
+        rows = jnp.where(hit.reshape((-1,) + (1,) * (vals.ndim - 1)),
+                         vals[posc], 0)
+        return RowSparseNDArray(rows, rid, self._shape_, ctx=self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        import jax.numpy as jnp
+        super().__init__(shape, ctx=ctx)
+        self._stype = "csr"
+        self._aux = {"data": _as_jax(data),
+                     "indices": _as_jax(indices).astype(jnp.int32),
+                     "indptr": _as_jax(indptr).astype(jnp.int32)}
+
+    def _densify(self):
+        jnp = _jnp()
+        aux = self._get_aux()
+        dense = jnp.zeros(self._shape_, dtype=aux["data"].dtype)
+        nnz = int(aux["data"].shape[0])
+        if nnz:
+            rows = _csr_row_of_nnz(aux["indptr"], nnz)
+            dense = dense.at[rows, aux["indices"]].set(aux["data"])
+        return dense
+
+    def _extract_aux(self):
+        dense = _np.asarray(self._data_buf)
+        jnp = _jnp()
+        rows, cols = _np.nonzero(dense)
+        indptr = _np.zeros(dense.shape[0] + 1, dtype=_np.int32)
+        _np.add.at(indptr, rows + 1, 1)
+        self._aux = {"data": jnp.asarray(dense[rows, cols]),
+                     "indices": jnp.asarray(cols.astype(_np.int32)),
+                     "indptr": jnp.asarray(_np.cumsum(indptr).astype(_np.int32))}
+
+    @property
+    def data(self):
+        return _wrap(self._get_aux()["data"], ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return _wrap(self._get_aux()["indices"], ctx=self._ctx)
 
     @property
     def indptr(self):
-        return self._aux["indptr"]
+        return _wrap(self._get_aux()["indptr"], ctx=self._ctx)
 
-    def todense(self):
-        return _wrap(self._data, ctx=self._ctx)
+
+def _csr_row_of_nnz(indptr, nnz):
+    """Row id of each stored element: searchsorted keeps this O(nnz log m)
+    and static-shaped (jit-friendly), no per-row python loop."""
+    jnp = _jnp()
+    return (jnp.searchsorted(indptr, jnp.arange(nnz, dtype=jnp.int32),
+                             side="right") - 1).astype(jnp.int32)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
-        return CSRNDArray(array(_np.asarray(data, dtype=dtype or _np.float32)),
-                          array(_np.asarray(indices)),
-                          array(_np.asarray(indptr)), shape, ctx=ctx)
+        if not isinstance(data, NDArray):
+            data = array(_np.asarray(data, dtype=dtype or _np.float32))
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx)
     # dense input
     dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1,
                         dtype=dtype or _np.float32)
-    indptr = [0]
-    indices = []
-    data = []
-    for row in dense:
-        nz = _np.nonzero(row)[0]
-        indices.extend(nz.tolist())
-        data.extend(row[nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(array(_np.array(data, dtype=dense.dtype)),
-                      array(_np.array(indices, dtype=_np.int64)),
-                      array(_np.array(indptr, dtype=_np.int64)),
+    rows, cols = _np.nonzero(dense)
+    indptr = _np.zeros(dense.shape[0] + 1, dtype=_np.int64)
+    _np.add.at(indptr, rows + 1, 1)
+    return CSRNDArray(array(dense[rows, cols]),
+                      array(cols.astype(_np.int64)),
+                      array(_np.cumsum(indptr)),
                       dense.shape, ctx=ctx)
 
 
@@ -149,14 +290,34 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
                             dense.shape, ctx=ctx)
 
 
+def zeros(stype, shape, ctx=None, dtype=None):
+    """All-zero sparse array with empty aux fields (no dense allocation)."""
+    dtype = dtype or _np.float32
+    if stype == "row_sparse":
+        return row_sparse_array(
+            (_np.zeros((0,) + tuple(shape[1:]), dtype=dtype),
+             _np.zeros((0,), dtype=_np.int64)), shape=shape, ctx=ctx)
+    if stype == "csr":
+        return csr_matrix(
+            (_np.zeros((0,), dtype=dtype), _np.zeros((0,), dtype=_np.int64),
+             _np.zeros((shape[0] + 1,), dtype=_np.int64)), shape=shape, ctx=ctx)
+    if stype == "default":
+        return nd_zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError("unknown stype %s" % stype)
+
+
 def cast_storage(arr, stype):
     if stype == "default":
         if isinstance(arr, BaseSparseNDArray):
             return arr.todense()
         return arr
     if stype == "row_sparse":
+        if isinstance(arr, RowSparseNDArray):
+            return arr
         return row_sparse_array(arr, shape=arr.shape, ctx=arr.context)
     if stype == "csr":
+        if isinstance(arr, CSRNDArray):
+            return arr
         return csr_matrix(arr, shape=arr.shape, ctx=arr.context)
     raise MXNetError("unknown stype %s" % stype)
 

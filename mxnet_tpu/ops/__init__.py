@@ -15,3 +15,4 @@ from . import linalg_ops      # noqa: F401
 from . import contrib_ops     # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import pallas_ops      # noqa: F401
+from . import sparse_ops      # noqa: F401

@@ -24,7 +24,7 @@ import functools
 
 from ..base import attrs_key, MXNetError
 
-__all__ = ["Op", "register", "get_op", "list_ops", "alias"]
+__all__ = ["Op", "register", "register_sparse", "get_op", "list_ops", "alias"]
 
 _OP_REGISTRY = {}
 
@@ -71,6 +71,12 @@ class Op:
         # parameter-input shapes from the data shape (the NNVM InferShape
         # bidirectional-propagation analog, used by simple_bind)
         self.param_shape_fn = None
+        # fcompute_ex(attrs, *ndarrays) -> NDArray(s) | NotImplemented:
+        # sparse-aware NDArray-level implementation (the FComputeEx analog,
+        # include/mxnet/op_attr_types.h:225).  Returning NotImplemented
+        # falls back to the dense fcompute path after storage fallback
+        # (src/common/exec_utils.h SetupDefaultBlobsInOut analog).
+        self.fcompute_ex = None
 
     def input_names(self, attrs):
         spec = self.arg_spec
@@ -132,6 +138,19 @@ def register(name, **kwargs):
             raise MXNetError("op %s already registered" % name)
         _OP_REGISTRY[name] = Op(name, fcompute, **kwargs)
         return fcompute
+    return deco
+
+
+def register_sparse(name):
+    """Decorator: attach a sparse-aware fcompute_ex to an existing op.
+
+    The handler receives NDArray inputs (so it can read aux fields without
+    densifying) and returns NDArray output(s), or NotImplemented to fall
+    back to the dense path — the FComputeEx + storage-fallback contract of
+    the reference (op_attr_types.h:225, exec_utils.h)."""
+    def deco(fn):
+        get_op(name).fcompute_ex = fn
+        return fn
     return deco
 
 

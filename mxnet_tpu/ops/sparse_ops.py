@@ -1,0 +1,181 @@
+"""Sparse-aware op implementations (FComputeEx analogs).
+
+Reference: the storage-type-dispatched kernels in src/operator/tensor/
+dot.cc (csr dot dense, forward + transposed), elemwise_binary_op_basic.cc
+(row_sparse add), and the sparse optimizer kernels in
+src/operator/optimizer_op.cc (SGD/Adam "lazy update": only the rows present
+in a row_sparse gradient are touched).
+
+Each handler consumes NDArray inputs so it can read the sparse aux fields
+without densifying, and returns NotImplemented for storage combinations it
+does not cover — invoke() then falls back to the dense path, exactly the
+reference's storage-fallback contract (src/common/exec_utils.h).
+
+TPU note: the kernels are built from gather / segment_sum / scatter-add,
+which XLA lowers to the TPU's dynamic-gather path; cost is O(nnz·d), never
+O(rows·d).  This is what makes 1e6-row embedding tables practical — the
+capability behind kvstore PullRowSparse (SURVEY §2.5.6).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register_sparse
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _is_stype(x, stype):
+    return getattr(x, "_stype", "default") == stype
+
+
+def _wrap(data, like):
+    from ..ndarray.ndarray import _wrap as w
+    return w(data, ctx=like._ctx)
+
+
+# ---------------------------------------------------------------------------
+# dot(csr, dense) / dot(csr.T, dense)
+# ---------------------------------------------------------------------------
+
+@register_sparse("dot")
+def _dot_ex(attrs, lhs, rhs):
+    if not (_is_stype(lhs, "csr") and _is_stype(rhs, "default")):
+        return NotImplemented
+    if bool(attrs.get("transpose_b", False)):
+        return NotImplemented
+    import jax
+    jnp = _jnp()
+    aux = lhs._get_aux()
+    data, cols, indptr = aux["data"], aux["indices"], aux["indptr"]
+    m, n = lhs.shape
+    nnz = int(data.shape[0])
+    b = rhs._data
+    vec = b.ndim == 1
+    bmat = b.reshape(b.shape[0], -1)
+    k = bmat.shape[1]
+    ta = bool(attrs.get("transpose_a", False))
+    if nnz == 0:
+        out = jnp.zeros((n if ta else m, k), dtype=bmat.dtype)
+    else:
+        from ..ndarray.sparse import _csr_row_of_nnz
+        rows = _csr_row_of_nnz(indptr, nnz)
+        if ta:
+            # out[n, k] += data[j] * b[row[j]]  scattered to col[j]
+            contrib = data[:, None] * bmat[rows]
+            out = jnp.zeros((n, k), dtype=contrib.dtype).at[cols].add(contrib)
+        else:
+            # out[m, k] = segment-sum over nnz of data[j] * b[col[j]]
+            contrib = data[:, None] * bmat[cols]
+            out = jax.ops.segment_sum(contrib, rows, num_segments=m)
+    if vec:
+        out = out.reshape(out.shape[0])
+    return _wrap(out, lhs)
+
+
+# ---------------------------------------------------------------------------
+# row_sparse + row_sparse
+# ---------------------------------------------------------------------------
+
+@register_sparse("elemwise_add")
+def _add_ex(attrs, lhs, rhs):
+    if not (_is_stype(lhs, "row_sparse") and _is_stype(rhs, "row_sparse")
+            and lhs.shape == rhs.shape):
+        return NotImplemented
+    import jax
+    jnp = _jnp()
+    from ..ndarray.sparse import RowSparseNDArray
+    la, ra = lhs._get_aux(), rhs._get_aux()
+    li, rv = la["indices"], ra["data"]
+    # union of touched rows (host-side: indices are concrete + small)
+    uni = _np.union1d(_np.asarray(li), _np.asarray(ra["indices"]))
+    uni_j = jnp.asarray(uni.astype(_np.int32))
+    nseg = uni.shape[0]
+    if nseg == 0:
+        return lhs.retain(_wrap(jnp.zeros((0,), jnp.int32), lhs))
+    pos_l = jnp.searchsorted(uni_j, la["indices"])
+    pos_r = jnp.searchsorted(uni_j, ra["indices"])
+    vals = jax.ops.segment_sum(
+        jnp.concatenate([la["data"], rv], axis=0),
+        jnp.concatenate([pos_l, pos_r], axis=0), num_segments=nseg)
+    return RowSparseNDArray(_wrap(vals, lhs), _wrap(uni_j, lhs),
+                            lhs.shape, ctx=lhs._ctx)
+
+
+# ---------------------------------------------------------------------------
+# lazy-update optimizer kernels (row_sparse gradient)
+# ---------------------------------------------------------------------------
+
+def _common(attrs):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = float(attrs.get("clip_gradient", -1.0))
+    return lr, wd, rescale, clip
+
+
+def _prep(jnp, g, rescale, clip):
+    g = g * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _rows(grad):
+    aux = grad._get_aux()
+    return aux["data"], aux["indices"]
+
+
+@register_sparse("sgd_update")
+def _sgd_update_ex(attrs, weight, grad):
+    if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")):
+        return NotImplemented
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    g_rows, idx = _rows(grad)
+    w = weight._data
+    w_rows = w[idx]
+    g = _prep(jnp, g_rows.astype(w.dtype), rescale, clip)
+    new_rows = w_rows - lr * (g + wd * w_rows)
+    return _wrap(w.at[idx].set(new_rows), weight)
+
+
+@register_sparse("sgd_mom_update")
+def _sgd_mom_update_ex(attrs, weight, grad, mom):
+    if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")
+            and _is_stype(mom, "default")):
+        return NotImplemented
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    momentum = float(attrs.get("momentum", 0.0))
+    g_rows, idx = _rows(grad)
+    w, m = weight._data, mom._data
+    w_rows, m_rows = w[idx], m[idx]
+    g = _prep(jnp, g_rows.astype(w.dtype), rescale, clip)
+    m_new = momentum * m_rows - lr * (g + wd * w_rows)
+    return (_wrap(w.at[idx].set(w_rows + m_new), weight),
+            _wrap(m.at[idx].set(m_new), mom))
+
+
+@register_sparse("adam_update")
+def _adam_update_ex(attrs, weight, grad, mean, var):
+    if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")):
+        return NotImplemented
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    beta1 = float(attrs.get("beta1", 0.9))
+    beta2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g_rows, idx = _rows(grad)
+    w, m, v = weight._data, mean._data, var._data
+    w_rows, m_rows, v_rows = w[idx], m[idx], v[idx]
+    g = _prep(jnp, g_rows.astype(w.dtype), rescale, clip) + wd * w_rows
+    m_new = beta1 * m_rows + (1 - beta1) * g
+    v_new = beta2 * v_rows + (1 - beta2) * g * g
+    w_new = w_rows - lr * m_new / (jnp.sqrt(v_new) + eps)
+    return (_wrap(w.at[idx].set(w_new), weight),
+            _wrap(m.at[idx].set(m_new), mean),
+            _wrap(v.at[idx].set(v_new), var))

@@ -116,3 +116,111 @@ def test_sparse_embedding_grad():
     assert g[1].sum() == 8.0  # row 1 gathered twice
     assert g[3].sum() == 4.0
     assert g[0].sum() == 0.0
+
+
+def test_sparse_is_lazily_densified():
+    """The dense buffer must NOT be materialized by construction, aux access,
+    retain, or sparse-aware dot — the memory win behind PullRowSparse
+    (SURVEY §2.5.6; reference keeps row_sparse as indices+values)."""
+    big = (1_000_000, 16)
+    vals = np.random.uniform(size=(3, 16)).astype(np.float32)
+    rsp = sparse.row_sparse_array((vals, np.array([5, 70, 99_999])), shape=big)
+    assert rsp._data_buf is None
+    assert rsp.shape == big and rsp.nnz == 3
+    _ = rsp.data.asnumpy(); _ = rsp.indices.asnumpy()
+    ret = rsp.retain(nd.array([70, 99_999], dtype="int32"))
+    assert rsp._data_buf is None and ret._data_buf is None
+    assert_almost_equal(ret.data.asnumpy()[0], vals[1])
+
+    csr, dense = sparse.rand_sparse_ndarray((50, 40), "csr", density=0.1)
+    rhs = np.random.uniform(size=(40, 8)).astype(np.float32)
+    out = nd.dot(csr, nd.array(rhs))
+    assert csr._data_buf is None, "sparse dot must not densify the csr lhs"
+    assert_almost_equal(out.asnumpy(), dense.dot(rhs), rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_dot_transpose():
+    csr, dense = sparse.rand_sparse_ndarray((30, 20), "csr", density=0.15)
+    rhs = np.random.uniform(size=(30, 6)).astype(np.float32)
+    out = nd.dot(csr, nd.array(rhs), transpose_a=True)
+    assert csr._data_buf is None
+    assert_almost_equal(out.asnumpy(), dense.T.dot(rhs), rtol=1e-4, atol=1e-5)
+
+
+def test_row_sparse_add():
+    a_dense = np.zeros((10, 4), dtype=np.float32); a_dense[[1, 5]] = 1.5
+    b_dense = np.zeros((10, 4), dtype=np.float32); b_dense[[5, 7]] = 2.0
+    a = sparse.row_sparse_array(a_dense)
+    b = sparse.row_sparse_array(b_dense)
+    out = nd.elemwise_add(a, b)
+    assert out.stype == "row_sparse" and out._data_buf is None
+    assert_almost_equal(out.asnumpy(), a_dense + b_dense)
+
+
+def test_sparse_lazy_sgd_update():
+    """Row-sparse grad touches ONLY its rows (reference lazy update,
+    src/operator/optimizer_op.cc sparse SGD kernels)."""
+    from mxnet_tpu.ndarray import invoke
+    w0 = np.random.uniform(size=(100, 4)).astype(np.float32)
+    weight = nd.array(w0)
+    mom = nd.zeros((100, 4))
+    g_rows = np.random.uniform(size=(2, 4)).astype(np.float32)
+    grad = sparse.row_sparse_array((g_rows, np.array([3, 42])), shape=(100, 4))
+    attrs = {"lr": "0.1", "momentum": "0.9", "wd": "0.0"}
+    invoke("sgd_mom_update", [weight, grad, mom], attrs, out=[weight, mom])
+    w1 = weight.asnumpy()
+    untouched = np.setdiff1d(np.arange(100), [3, 42])
+    assert_almost_equal(w1[untouched], w0[untouched])
+    assert_almost_equal(w1[3], w0[3] - 0.1 * g_rows[0], rtol=1e-5)
+    m1 = mom.asnumpy()
+    assert abs(m1[untouched]).max() == 0 and abs(m1[42]).max() > 0
+
+
+def test_sparse_lazy_adam_update():
+    from mxnet_tpu.ndarray import invoke
+    w0 = np.random.uniform(size=(50, 3)).astype(np.float32)
+    weight, mean, var = nd.array(w0), nd.zeros((50, 3)), nd.zeros((50, 3))
+    g_rows = np.random.uniform(0.1, 1, size=(1, 3)).astype(np.float32)
+    grad = sparse.row_sparse_array((g_rows, np.array([7])), shape=(50, 3))
+    invoke("adam_update", [weight, grad, mean, var],
+           {"lr": "0.01"}, out=[weight, mean, var])
+    w1 = weight.asnumpy()
+    untouched = np.setdiff1d(np.arange(50), [7])
+    assert_almost_equal(w1[untouched], w0[untouched])
+    assert not np.allclose(w1[7], w0[7])
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (1000, 8))
+    assert z.nnz == 0 and z._data_buf is None
+    z = sparse.zeros("csr", (1000, 8))
+    assert z.nnz == 0 and z._data_buf is None
+    assert z.asnumpy().sum() == 0
+
+
+def test_sparse_dense_write_invalidates_aux():
+    """A dense write through the handle re-extracts aux lazily (the
+    cast_storage round-trip semantics)."""
+    rsp = sparse.row_sparse_array(np.eye(4, dtype=np.float32))
+    dense = nd.array(np.zeros((4, 4), dtype=np.float32) + 2)
+    dense.copyto(rsp)
+    assert_almost_equal(rsp.indices.asnumpy(), [0, 1, 2, 3])
+    assert_almost_equal(rsp.asnumpy(), np.full((4, 4), 2.0))
+
+
+def test_kvstore_sparse_push_stays_sparse():
+    """Pushing row_sparse gradients reduces via the indices-union sparse add
+    (comm.h:182 CommCPU row_sparse reduce analog) — no densification."""
+    kv = mx.kvstore.create("local")
+    shape = (500_000, 8)
+    kv.init("w", nd.zeros(shape))
+    g1 = sparse.row_sparse_array(
+        (np.ones((2, 8), np.float32), np.array([3, 9])), shape=shape)
+    g2 = sparse.row_sparse_array(
+        (np.ones((2, 8), np.float32), np.array([9, 11])), shape=shape)
+    merged = kv._comm_reduce if hasattr(kv, "_comm_reduce") else None
+    out = kv._reduce([g1, g2])
+    assert out.stype == "row_sparse" and out._data_buf is None
+    assert g1._data_buf is None and g2._data_buf is None
+    assert_almost_equal(out.indices.asnumpy(), [3, 9, 11])
+    assert_almost_equal(out.data.asnumpy()[1], np.full(8, 2.0))
