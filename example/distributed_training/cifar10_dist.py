@@ -33,9 +33,10 @@ def load_cifar(batch_size, rank, num_workers, seed=0):
     if os.path.isdir(root) and os.listdir(root):
         from mxnet_tpu.gluon.data.vision import CIFAR10
         train = CIFAR10(root=root, train=True)
-        x = np.stack([np.asarray(im.asnumpy()) for im, _ in train]) \
-            .transpose(0, 3, 1, 2).astype(np.float32) / 255.0
-        y = np.array([int(l) for _, l in train], dtype=np.float32)
+        imgs, labels = zip(*((np.asarray(im.asnumpy()), int(l))
+                             for im, l in train))
+        x = np.stack(imgs).transpose(0, 3, 1, 2).astype(np.float32) / 255.0
+        y = np.array(labels, dtype=np.float32)
         shard = slice(rank * len(x) // num_workers,
                       (rank + 1) * len(x) // num_workers)
         return mx.io.NDArrayIter(x[shard], y[shard], batch_size=batch_size,

@@ -96,7 +96,8 @@ def get(name):
 
 
 def get_first(*names):
-    """First non-None value along an override chain, else the last default.
+    """First non-None value along an override chain (each name's own default
+    already folds in via read()); None when the whole chain is unset.
 
     Expresses precedence rules like MX_KV_RANK > DMLC_WORKER_ID once, here,
     where they are documented."""
@@ -104,7 +105,7 @@ def get_first(*names):
         val = get(name)
         if val is not None:
             return val
-    return VARIABLES[names[-1]].default
+    return None
 
 
 def describe(file=None):

@@ -198,6 +198,10 @@ class KVStoreTPUSync(KVStore):
         self._jit_reduce = None
 
     def _reduce(self, vlist):
+        if all(getattr(v, "stype", "default") == "row_sparse" for v in vlist):
+            # indices-union sparse add from the base class — dist embedding
+            # gradients must not densify either
+            return KVStore._reduce(self, vlist)
         if len(vlist) == 1:
             return vlist[0].copy()
         import jax

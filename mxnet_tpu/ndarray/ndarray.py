@@ -520,7 +520,9 @@ def invoke(op_name, inputs, attrs, out=None):
                     if getattr(r, "_stype", "default") != "default":
                         r.copyto(o)
                     else:
-                        o._set_data(r._data.astype(o._data.dtype))
+                        # o.dtype, not o._data.dtype — the latter would
+                        # densify a lazy sparse out target just to read it
+                        o._set_data(r._data.astype(o.dtype))
                 return out
             return ex_outputs if isinstance(ex_result, (tuple, list)) else ex_result
 
@@ -540,7 +542,8 @@ def invoke(op_name, inputs, attrs, out=None):
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o, r in zip(outs, outputs):
-            o._set_data(r._data.astype(o._data.dtype))
+            # o.dtype, not o._data.dtype (densifies a lazy sparse target)
+            o._set_data(r._data.astype(o.dtype))
             o._ag_entry = r._ag_entry
         return out
     if multi:

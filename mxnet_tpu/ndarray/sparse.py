@@ -153,13 +153,16 @@ class RowSparseNDArray(BaseSparseNDArray):
     Indices are kept sorted (the reference's invariant for row_sparse ops,
     src/operator/tensor/sparse_retain-inl.h relies on it)."""
 
-    def __init__(self, data, indices, shape, ctx=None):
+    def __init__(self, data, indices, shape, ctx=None, _sorted=False):
         import jax.numpy as jnp
         super().__init__(shape, ctx=ctx)
         self._stype = "row_sparse"
         vals = _as_jax(data)
         idx = _as_jax(indices).astype(jnp.int32)
-        if idx.shape[0] > 1 and not bool((_np.diff(_np.asarray(idx)) > 0).all()):
+        if not _sorted and idx.shape[0] > 1:
+            # device-side sort (no host round-trip, keeps dispatch async);
+            # internal constructors that already produce sorted indices
+            # pass _sorted=True to skip it
             order = jnp.argsort(idx)
             idx, vals = idx[order], vals[order]
         self._aux = {"data": vals, "indices": idx}
@@ -287,7 +290,7 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     nz_rows = _np.nonzero(_np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
     return RowSparseNDArray(array(dense[nz_rows]),
                             array(nz_rows.astype(_np.int64)),
-                            dense.shape, ctx=ctx)
+                            dense.shape, ctx=ctx, _sorted=True)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):

@@ -54,7 +54,6 @@ def _dot_ex(attrs, lhs, rhs):
     m, n = lhs.shape
     nnz = int(data.shape[0])
     b = rhs._data
-    vec = b.ndim == 1
     bmat = b.reshape(b.shape[0], -1)
     k = bmat.shape[1]
     ta = bool(attrs.get("transpose_a", False))
@@ -71,8 +70,9 @@ def _dot_ex(attrs, lhs, rhs):
             # out[m, k] = segment-sum over nnz of data[j] * b[col[j]]
             contrib = data[:, None] * bmat[cols]
             out = jax.ops.segment_sum(contrib, rows, num_segments=m)
-    if vec:
-        out = out.reshape(out.shape[0])
+    # restore the rhs trailing dims (dot contracts lhs last axis with rhs
+    # first axis; output = (m|n,) + rhs.shape[1:], matching the dense path)
+    out = out.reshape((out.shape[0],) + b.shape[1:])
     return _wrap(out, lhs)
 
 
@@ -102,26 +102,15 @@ def _add_ex(attrs, lhs, rhs):
         jnp.concatenate([la["data"], rv], axis=0),
         jnp.concatenate([pos_l, pos_r], axis=0), num_segments=nseg)
     return RowSparseNDArray(_wrap(vals, lhs), _wrap(uni_j, lhs),
-                            lhs.shape, ctx=lhs._ctx)
+                            lhs.shape, ctx=lhs._ctx, _sorted=True)
 
 
 # ---------------------------------------------------------------------------
 # lazy-update optimizer kernels (row_sparse gradient)
 # ---------------------------------------------------------------------------
 
-def _common(attrs):
-    lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
-    rescale = float(attrs.get("rescale_grad", 1.0))
-    clip = float(attrs.get("clip_gradient", -1.0))
-    return lr, wd, rescale, clip
-
-
-def _prep(jnp, g, rescale, clip):
-    g = g * rescale
-    if clip > 0:
-        g = jnp.clip(g, -clip, clip)
-    return g
+# shared with the dense kernels so attr parsing cannot diverge
+from .optimizer_ops import _common, _prep_grad as _prep
 
 
 def _rows(grad):
@@ -129,9 +118,16 @@ def _rows(grad):
     return aux["data"], aux["indices"]
 
 
+def _lazy(attrs):
+    """Reference optimizer kernels honor lazy_update: when False, every row
+    must be decayed each step, which only the dense path does."""
+    return bool(attrs.get("lazy_update", True))
+
+
 @register_sparse("sgd_update")
 def _sgd_update_ex(attrs, weight, grad):
-    if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")):
+    if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")
+            and _lazy(attrs)):
         return NotImplemented
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -146,7 +142,7 @@ def _sgd_update_ex(attrs, weight, grad):
 @register_sparse("sgd_mom_update")
 def _sgd_mom_update_ex(attrs, weight, grad, mom):
     if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")
-            and _is_stype(mom, "default")):
+            and _is_stype(mom, "default") and _lazy(attrs)):
         return NotImplemented
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -162,7 +158,8 @@ def _sgd_mom_update_ex(attrs, weight, grad, mom):
 
 @register_sparse("adam_update")
 def _adam_update_ex(attrs, weight, grad, mean, var):
-    if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")):
+    if not (_is_stype(grad, "row_sparse") and _is_stype(weight, "default")
+            and _lazy(attrs)):
         return NotImplemented
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)

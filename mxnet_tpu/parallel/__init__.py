@@ -22,3 +22,6 @@ from .collectives import (allreduce, allgather, reduce_scatter, ppermute_ring,
                           barrier_sync)
 from .data_parallel import make_data_parallel_train_step, shard_batch
 from .ring_attention import ring_attention, sequence_parallel_attention
+from .pipeline import pipeline_apply, make_pipeline_step
+from .ulysses import ulysses_attention_local, ulysses_parallel_attention
+from .moe import moe_apply, make_expert_parallel_moe

@@ -553,8 +553,82 @@ def load(fname):
         return load_json(f.read())
 
 
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage")
+_CURRENT_JSON_VERSION = 10300  # matches the version save() stamps
+
+
+def _upgrade_json(conf):
+    """Upgrade graph JSON saved by older reference versions
+    (src/nnvm/legacy_json_util.cc LoadLegacyJSONPass):
+
+      * <1.0.0 saved hidden attr keys bare ("lr_mult") — rewrite to the
+        "__lr_mult__" form (UpgradeJSON_FixParsing, kHiddenKeys at
+        src/c_api/c_api_symbolic.cc:41);
+      * <0.9.0 did not store aux-state inputs (BatchNorm moving stats) —
+        append default-named variable nodes (UpgradeJSON_000800_000900);
+      * <0.9.5 stored argmin/argmax axis=-1 for "all axes" — drop the attr
+        (UpgradeJSON_000904_000905 optional-axis change).
+    """
+    version = conf.get("attrs", {}).get("mxnet_version", ["int", 800])[1]
+    if version >= _CURRENT_JSON_VERSION:
+        return conf
+    nodes = conf["nodes"]
+    for nc in nodes:
+        attrs = nc.get("attrs", nc.get("param"))
+        if not attrs:
+            continue
+        for key in list(attrs):
+            if key in _HIDDEN_KEYS:
+                attrs["__%s__" % key] = attrs.pop(key)
+                continue
+            for hk in _HIDDEN_KEYS:
+                # "<argname>_<hidden>" attaches to the matching input
+                # variable (FixParsing's suffix rule)
+                if key.endswith("_" + hk):
+                    argname = key[:-(len(hk) + 1)]
+                    val = attrs.pop(key)
+                    placed = False
+                    for (i, _idx, *_r) in nc.get("inputs", []):
+                        inp = nodes[i]
+                        if inp["op"] == "null" and \
+                                inp["name"].endswith(argname):
+                            inp.setdefault("attrs", {})["__%s__" % hk] = val
+                            placed = True
+                            break
+                    if not placed:
+                        attrs["__%s__" % hk] = val
+                    break
+        if version < 905 and nc["op"] in ("argmin", "argmax") \
+                and str(attrs.get("axis")) == "-1":
+            del attrs["axis"]
+    if version < 900:
+        # append missing aux-variable inputs using each op's input list
+        for i, nc in enumerate(nodes):
+            if nc["op"] == "null":
+                continue
+            try:
+                spec = get_op(nc["op"]).input_names(
+                    nc.get("attrs", nc.get("param", {})) or {})
+            except MXNetError:
+                spec = None
+            if not spec:
+                continue
+            missing = spec[len(nc.get("inputs", [])):]
+            for slot in missing:
+                name = slot.split(":")[-1]
+                var_name = "%s_%s" % (nc["name"], name) if nc["name"] else name
+                var_attrs = {"__is_aux__": True} if slot.startswith("aux:") \
+                    else {}
+                nodes.append({"op": "null", "name": var_name,
+                              "attrs": var_attrs, "inputs": []})
+                nc.setdefault("inputs", []).append([len(nodes) - 1, 0, 0])
+        # arg_nodes/node_row_ptr become stale; load_json ignores them
+    return conf
+
+
 def load_json(json_str):
-    conf = json.loads(json_str)
+    conf = _upgrade_json(json.loads(json_str))
     import ast
     nodes_conf = conf["nodes"]
     nodes = []
@@ -576,18 +650,22 @@ def load_json(json_str):
             out = tuple(out)
         return out
 
+    # two passes: the legacy upgrader may append aux-variable nodes after
+    # their consumer, so forward references are legal in the node list
     for nc in nodes_conf:
         attrs = {k: parse_attr(v)
                  for k, v in nc.get("attrs", nc.get("param", {})).items()}
         op = nc["op"] if nc["op"] != "null" else None
-        inputs = [(nodes[i], idx) for (i, idx, *_rest) in nc.get("inputs", [])]
         node = _Node.__new__(_Node)
         node.op = op
         node.name = nc["name"]
         node.attrs = attrs
-        node.inputs = inputs
+        node.inputs = []
         node.num_outputs = get_op(op).n_outputs(attrs) if op else 1
         nodes.append(node)
+    for node, nc in zip(nodes, nodes_conf):
+        node.inputs = [(nodes[i], idx)
+                       for (i, idx, *_rest) in nc.get("inputs", [])]
     heads = conf.get("heads")
     if heads:
         entries = [(nodes[i], idx) for (i, idx, *_r) in heads]

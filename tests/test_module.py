@@ -127,3 +127,39 @@ def test_bucketing_module():
     mod.backward()
     mod.update()
     assert mod.get_outputs()[0].shape == (4, 4)
+
+
+def test_python_loss_module():
+    """PythonLossModule: pass-through forward, softmax-CE input grad
+    (reference module/python_module.py:243)."""
+    from mxnet_tpu.module import PythonLossModule
+    from mxnet_tpu.io import DataBatch
+    m = PythonLossModule()
+    m.bind(data_shapes=[("data", (4, 3))],
+           label_shapes=[("softmax_label", (4,))])
+    m.init_params()
+    scores = nd.array(np.random.uniform(-1, 1, (4, 3)).astype(np.float32))
+    labels = nd.array(np.array([0, 2, 1, 2], np.float32))
+    m.forward(DataBatch(data=[scores], label=[labels]), is_train=True)
+    out = m.get_outputs()[0]
+    assert out.shape == (4, 3)
+    m.backward()
+    g = m.get_input_grads()[0].asnumpy()
+    p = np.exp(scores.asnumpy()); p /= p.sum(1, keepdims=True)
+    expect = p.copy()
+    for i, l in enumerate([0, 2, 1, 2]):
+        expect[i, l] -= 1
+    np.testing.assert_allclose(g, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_python_loss_module_custom_grad():
+    from mxnet_tpu.module import PythonLossModule
+    from mxnet_tpu.io import DataBatch
+    m = PythonLossModule(grad_func=lambda s, l: s * 0 + 7)
+    m.bind(data_shapes=[("data", (2, 2))],
+           label_shapes=[("softmax_label", (2,))])
+    m.init_params()
+    m.forward(DataBatch(data=[nd.zeros((2, 2))], label=[nd.zeros((2,))]),
+              is_train=True)
+    m.backward()
+    assert (m.get_input_grads()[0].asnumpy() == 7).all()

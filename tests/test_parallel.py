@@ -229,3 +229,169 @@ def test_kvstore_tpu_sync_multi_value_push():
     kv.pull("9", out=out)
     expected = sum(range(1, _ndev() + 1))
     np.testing.assert_allclose(out.asnumpy(), expected)
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism
+# ---------------------------------------------------------------------------
+
+def _mlp_stage(p, h):
+    import jax.numpy as jnp
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def test_pipeline_forward_matches_sequential():
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import make_pipeline_step
+    from jax.sharding import Mesh
+    import jax
+    S, d, B, M = 4, 8, 16, 4
+    mesh = Mesh(np.array(jax.devices())[:S], ("pp",))
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.normal(0, 0.5, (S, d, d)).astype(np.float32)),
+              "b": jnp.asarray(rng.normal(0, 0.1, (S, d)).astype(np.float32))}
+    x = jnp.asarray(rng.normal(0, 1, (B, d)).astype(np.float32))
+
+    run = make_pipeline_step(_mlp_stage, mesh, n_microbatches=M)
+    with mesh:
+        y = np.asarray(run(params, x))
+
+    h = np.asarray(x)
+    for s in range(S):
+        h = np.tanh(h @ np.asarray(params["w"][s]) + np.asarray(params["b"][s]))
+    np.testing.assert_allclose(y, h, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_backward_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import make_pipeline_step
+    S, d, B, M = 2, 6, 8, 4
+    mesh = Mesh(np.array(jax.devices())[:S], ("pp",))
+    rng = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rng.normal(0, 0.5, (S, d, d)).astype(np.float32)),
+              "b": jnp.zeros((S, d), jnp.float32)}
+    x = jnp.asarray(rng.normal(0, 1, (B, d)).astype(np.float32))
+    tgt = jnp.asarray(rng.normal(0, 1, (B, d)).astype(np.float32))
+
+    def loss_fn(y, labels):
+        return jnp.mean((y - labels) ** 2)
+
+    run = make_pipeline_step(_mlp_stage, mesh, n_microbatches=M,
+                             loss_fn=loss_fn)
+    with mesh:
+        loss, grads = run(params, x, tgt)
+
+    def ref_loss(p):
+        h = x
+        for s in range(S):
+            h = jnp.tanh(h @ p["w"][s] + p["b"][s])
+        return jnp.mean((h - tgt) ** 2)
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(grads["w"]), np.asarray(ref_g["w"]),
+                               rtol=2e-3, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses all-to-all sequence parallelism
+# ---------------------------------------------------------------------------
+
+def test_ulysses_matches_dense():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import ulysses_parallel_attention
+    n = 8
+    mesh = Mesh(np.array(jax.devices())[:n], ("sp",))
+    B, H, T, D = 2, 8, 64, 16
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+               for _ in range(3))
+    for causal in (False, True):
+        with mesh:
+            out = np.asarray(ulysses_parallel_attention(mesh, q, k, v,
+                                                        causal=causal))
+        s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) / np.sqrt(D)
+        if causal:
+            mask = np.tril(np.ones((T, T), dtype=bool))
+            s = np.where(mask[None, None], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import ulysses_parallel_attention
+    n = len(jax.devices())
+    if n == 1:
+        pytest.skip("every head count divides a 1-device axis")
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q = jnp.zeros((1, 2 * n - 1, 16, 4))  # 2n-1 is never divisible by n>1
+    with pytest.raises(ValueError):
+        ulysses_parallel_attention(mesh, q, q, q)
+
+
+# ---------------------------------------------------------------------------
+# expert-parallel MoE
+# ---------------------------------------------------------------------------
+
+def test_moe_matches_dense_dispatch():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import make_expert_parallel_moe
+    n, E, d, B = 4, 8, 16, 32
+    mesh = Mesh(np.array(jax.devices())[:n], ("ep",))
+    rng = np.random.RandomState(3)
+    expert_params = {
+        "w": jnp.asarray(rng.normal(0, 0.3, (E, d, d)).astype(np.float32))}
+    gate_w = jnp.asarray(rng.normal(0, 1, (d, E)).astype(np.float32))
+    x = jnp.asarray(rng.normal(0, 1, (B, d)).astype(np.float32))
+
+    def expert_fn(p, tokens):
+        return jnp.tanh(tokens @ p["w"])
+
+    # generous capacity: nothing dropped -> must equal the dense reference
+    moe = make_expert_parallel_moe(mesh, expert_fn, k=2, capacity_factor=8.0)
+    with mesh:
+        out = np.asarray(moe(expert_params, gate_w, x))
+
+    gates = jax.nn.softmax(x @ gate_w, axis=-1)
+    top2 = jax.lax.top_k(gates, 2)
+    ref = np.zeros((B, d), np.float32)
+    for t in range(B):
+        vals = np.asarray(top2[0][t]); idx = np.asarray(top2[1][t])
+        vals = vals / vals.sum()
+        for j in range(2):
+            e = int(idx[j])
+            y = np.tanh(np.asarray(x[t]) @ np.asarray(expert_params["w"][e]))
+            ref[t] += vals[j] * y
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_moe_capacity_drops_tokens():
+    """Tiny capacity: overflow tokens contribute zero (Switch overflow rule),
+    output stays finite and shaped."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import make_expert_parallel_moe
+    mesh = Mesh(np.array(jax.devices())[:2], ("ep",))
+    rng = np.random.RandomState(4)
+    E, d, B = 2, 8, 16
+    expert_params = {"w": jnp.asarray(rng.normal(0, 0.3, (E, d, d)).astype(np.float32))}
+    gate_w = jnp.asarray(np.zeros((d, E), np.float32))  # uniform gate -> expert 0 hot
+    x = jnp.asarray(rng.normal(0, 1, (B, d)).astype(np.float32))
+
+    def expert_fn(p, tokens):
+        return tokens @ p["w"]
+
+    moe = make_expert_parallel_moe(mesh, expert_fn, k=1, capacity_factor=0.25)
+    with mesh:
+        out = np.asarray(moe(expert_params, gate_w, x))
+    assert out.shape == (B, d) and np.isfinite(out).all()
+    assert (np.abs(out).sum(axis=1) == 0).any()  # some tokens dropped

