@@ -21,7 +21,7 @@ _SRC_DIR = os.path.join(_REPO_ROOT, "src")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libmxtpu.so")
 
-_SOURCES = ["recordio.cc"]
+_SOURCES = ["recordio.cc", "pipeline.cc"]
 
 
 def _build():
@@ -29,14 +29,29 @@ def _build():
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
     newest_src = max((os.path.getmtime(s) for s in srcs if os.path.exists(s)),
                      default=0)
-    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= newest_src:
+    fallback_marker = os.path.join(_BUILD_DIR, ".recordio_only")
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= newest_src \
+            and not os.path.exists(fallback_marker):
         return True
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB_PATH] + srcs
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except Exception:
-        return False
+    base = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB_PATH]
+    # full build first; without libjpeg, fall back to recordio-only so the
+    # native RecordIO fast path never regresses (pipeline users get the
+    # python backend instead).  The marker forces a full-build retry next
+    # session — e.g. after libjpeg gets installed.
+    for attempt_srcs in (srcs, [s for s in srcs if "pipeline" not in s]):
+        full = attempt_srcs is srcs
+        cmd = base + attempt_srcs + (["-ljpeg"] if full else []) + ["-lpthread"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            if full:
+                if os.path.exists(fallback_marker):
+                    os.remove(fallback_marker)
+            else:
+                open(fallback_marker, "w").close()
+            return True
+        except Exception:
+            continue
+    return False
 
 
 def get_lib():
@@ -72,5 +87,22 @@ def get_lib():
         lib.mxtpu_recio_writer_tell.restype = ctypes.c_int64
         lib.mxtpu_recio_writer_tell.argtypes = [ctypes.c_void_p]
         lib.mxtpu_recio_writer_close.argtypes = [ctypes.c_void_p]
+        # threaded image pipeline (src/pipeline.cc) — absent when the
+        # recordio-only fallback build ran (no libjpeg on the host)
+        if not hasattr(lib, "mxtpu_pipe_open"):
+            _lib = lib
+            return _lib
+        lib.mxtpu_pipe_open.restype = ctypes.c_void_p
+        lib.mxtpu_pipe_open.argtypes = [ctypes.c_char_p] + [ctypes.c_int] * 6
+        lib.mxtpu_pipe_next_batch.restype = ctypes.c_int64
+        lib.mxtpu_pipe_next_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float)]
+        lib.mxtpu_pipe_reset.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_skipped.restype = ctypes.c_int64
+        lib.mxtpu_pipe_skipped.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_read_errors.restype = ctypes.c_int64
+        lib.mxtpu_pipe_read_errors.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib

@@ -202,6 +202,22 @@ def _toposort(head_entries):
     return order
 
 
+def _acc(a, b):
+    """Accumulate two cotangents; either may be a RowSparseCotangent
+    (sparse+sparse merges without densifying; mixed densifies — the
+    storage-fallback rule applied to gradients)."""
+    from .ndarray.sparse import RowSparseCotangent
+    a_sp = isinstance(a, RowSparseCotangent)
+    b_sp = isinstance(b, RowSparseCotangent)
+    if a_sp and b_sp:
+        return a.merge(b)
+    if a_sp:
+        return a.todense() + b
+    if b_sp:
+        return a + b.todense()
+    return a + b
+
+
 def _propagate(order, cts):
     """Reverse-propagate cotangents through tape nodes (shared by backward/grad)."""
     import jax
@@ -213,6 +229,7 @@ def _propagate(order, cts):
             primals_out, vjp_fn = jax.vjp(node.fn, *node.input_vals)
         if not isinstance(primals_out, (tuple, list)):
             primals_out = (primals_out,)
+        from .ndarray.sparse import RowSparseCotangent
         out_cts = []
         any_ct = False
         for i, ent in enumerate(node.out_entries):
@@ -221,6 +238,10 @@ def _propagate(order, cts):
                 ct = jnp.zeros_like(primals_out[i])
             else:
                 any_ct = True
+                if isinstance(ct, RowSparseCotangent):
+                    # a dense vjp closure consumes this output: storage
+                    # fallback (sparse cts stay sparse only leaf-to-leaf)
+                    ct = ct.todense()
             out_cts.append(ct)
         if not any_ct:
             continue
@@ -232,7 +253,7 @@ def _propagate(order, cts):
             if getattr(g, "dtype", None) is not None and str(g.dtype) == "float0":
                 continue
             if id(e) in cts:
-                cts[id(e)] = cts[id(e)] + g
+                cts[id(e)] = _acc(cts[id(e)], g)
             else:
                 cts[id(e)] = g
 
@@ -284,6 +305,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
         if e.node is None and e.array_ref is not None:
             leaves.add(e)
 
+    from .ndarray.sparse import (RowSparseCotangent, RowSparseNDArray,
+                                 assign_row_sparse)
     for e in leaves:
         arr = e.array_ref
         g = cts.get(id(e))
@@ -292,10 +315,20 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
         req = getattr(arr, "_ag_grad_req", "write")
         if req == "null" or arr.grad is None:
             continue
+        gbuf = arr.grad
+        if isinstance(g, RowSparseCotangent):
+            if isinstance(gbuf, RowSparseNDArray):
+                rsp = g.to_row_sparse(ctx=arr.context)
+                if req == "add" and gbuf.nnz:
+                    from .ndarray.ndarray import invoke as _invoke
+                    rsp = _invoke("elemwise_add", [gbuf, rsp], {})
+                assign_row_sparse(gbuf, rsp)
+                continue
+            g = g.todense()   # dense grad buffer: storage fallback
         if req == "add":
-            arr.grad._data = arr.grad._data + g
+            gbuf._data = gbuf._data + g
         else:
-            arr.grad._data = g
+            gbuf._data = g
 
     if not retain_graph:
         for h in heads:
@@ -331,13 +364,18 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     order = _toposort(head_entries)
     _propagate(order, cts)
 
+    from .ndarray.sparse import RowSparseCotangent
     results = []
     for v in variables:
         e = getattr(v, "_ag_entry", None)
         if e is None or id(e) not in cts:
             raise MXNetError("one of the variables does not participate in the "
                              "computation of heads")
-        results.append(_wrap(cts[id(e)], ctx=v.context))
+        ct = cts[id(e)]
+        if isinstance(ct, RowSparseCotangent):
+            results.append(ct.to_row_sparse(ctx=v.context))
+        else:
+            results.append(_wrap(ct, ctx=v.context))
     return results
 
 

@@ -1,4 +1,5 @@
-"""gluon.contrib (reference: python/mxnet/gluon/contrib/ — SyncBatchNorm,
-VariationalDropoutCell, etc.).  Round-1 subset."""
+"""gluon.contrib (reference: python/mxnet/gluon/contrib/): Concurrent/
+Identity/SparseEmbedding/SyncBatchNorm layers, VariationalDropoutCell,
+LSTMPCell, and the ConvRNN/ConvLSTM/ConvGRU cell family."""
 from . import nn
 from . import rnn

@@ -1,1 +1,1 @@
-from .basic_layers import Concurrent, HybridConcurrent, Identity, SyncBatchNorm
+from .basic_layers import (Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm)

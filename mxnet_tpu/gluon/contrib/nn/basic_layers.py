@@ -61,3 +61,35 @@ class SyncBatchNorm(BatchNorm):
                          running_variance_initializer=running_variance_initializer,
                          in_channels=in_channels, **kwargs)
         self._num_devices = num_devices
+
+
+class SparseEmbedding(Block):
+    """Embedding whose weight gradient is row_sparse — only the looked-up
+    rows cost memory in backward, so 1e6+-row tables train practically
+    (reference gluon/contrib/nn/basic_layers.py:116; pairs with kvstore
+    row_sparse push/pull and the lazy sparse optimizer kernels).
+
+    Not hybridizable (like the reference): the sparse-gradient recording is
+    an eager-tape feature; under a compiled step use a plain Embedding and
+    let XLA fuse the gather/scatter.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim}
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, grad_stype="row_sparse")
+
+    def forward(self, x):
+        from ....ndarray.sparse import sparse_embedding
+        from .... import autograd as _ag
+        weight = self.weight.data(x.context)
+        if _ag.is_recording():
+            return sparse_embedding(x, weight)
+        return nd.Embedding(x, weight, **self._kwargs)
+
+    def __repr__(self):
+        return "SparseEmbedding({input_dim} -> {output_dim})".format(
+            **self._kwargs)

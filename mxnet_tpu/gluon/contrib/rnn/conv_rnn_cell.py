@@ -1,0 +1,208 @@
+"""Convolutional recurrent cells: ConvRNN / ConvLSTM / ConvGRU in 1/2/3-D.
+
+Reference: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py (Shi et al.'s
+ConvLSTM family).  The recurrence is the standard cell with every matmul
+replaced by a convolution: i2h convolves the input, h2h convolves the
+hidden state with "same" padding (odd h2h kernels only, so spatial dims are
+preserved across time).
+
+TPU note: unrolled under hybridize/CachedOp the per-step convs compile into
+one XLA module and pipeline on the MXU; channel-first ('NC...') layouts
+only, matching the framework's Convolution op API.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        assert len(v) == n, "expected %d-tuple, got %r" % (n, v)
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+class _ConvCellBase(HybridRecurrentCell):
+    """Shared machinery: shapes, conv parameters, the two convolutions."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, dims, conv_layout, activation,
+                 prefix, params):
+        super().__init__(prefix=prefix, params=params)
+        if conv_layout not in ("NCW", "NCHW", "NCDHW")[dims - 1:dims]:
+            raise MXNetError("conv_layout %r unsupported: channel-first "
+                             "('NC...') only on this build" % (conv_layout,))
+        self._dims = dims
+        self._input_shape = tuple(int(s) for s in input_shape)
+        self._hidden_channels = int(hidden_channels)
+        self._activation = activation
+        self._i2h_kernel = _tuple(i2h_kernel, dims)
+        self._h2h_kernel = _tuple(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise MXNetError("h2h_kernel must be odd (same-padded "
+                                 "recurrence); got %r" % (self._h2h_kernel,))
+        self._i2h_pad = _tuple(i2h_pad, dims)
+        self._i2h_dilate = _tuple(i2h_dilate, dims)
+        self._h2h_dilate = _tuple(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+
+        in_c = self._input_shape[0]
+        # stride-1 conv output spatial size
+        self._state_spatial = tuple(
+            (x + 2 * p - d * (k - 1) - 1) + 1
+            for x, p, d, k in zip(self._input_shape[1:], self._i2h_pad,
+                                  self._i2h_dilate, self._i2h_kernel))
+        ng = self._num_gates
+        h = self._hidden_channels
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(ng * h, in_c) + self._i2h_kernel,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(ng * h, h) + self._h2h_kernel,
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * h,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * h,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[3 - self._dims:]}
+                ] * self._num_states
+
+    def _convs(self, F, inputs, state, i2h_weight, h2h_weight, i2h_bias,
+               h2h_bias):
+        ng = self._num_gates
+        prefix = "t%d_" % self._counter
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            dilate=self._i2h_dilate,
+                            num_filter=ng * self._hidden_channels,
+                            name=prefix + "i2h")
+        h2h = F.Convolution(state, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            dilate=self._h2h_dilate,
+                            num_filter=ng * self._hidden_channels,
+                            name=prefix + "h2h")
+        return i2h, h2h
+
+    def _act(self, F, x):
+        act = self._activation
+        if callable(act):
+            return act(x)
+        return F.Activation(x, act_type=act)
+
+
+class _ConvRNNCell(_ConvCellBase):
+    _num_gates = 1
+    _num_states = 1
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        output = self._act(F, i2h + h2h)
+        return output, [output]
+
+
+class _ConvLSTMCell(_ConvCellBase):
+    _num_gates = 4
+    _num_states = 2
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        in_g, forget_g, cell_g, out_g = F.SliceChannel(
+            gates, num_outputs=4, axis=1)
+        i = F.sigmoid(in_g)
+        f = F.sigmoid(forget_g)
+        c = f * states[1] + i * self._act(F, cell_g)
+        o = F.sigmoid(out_g)
+        h = o * self._act(F, c)
+        return h, [h, c]
+
+
+class _ConvGRUCell(_ConvCellBase):
+    _num_gates = 3
+    _num_states = 1
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        # reset/update gates see i2h+h2h; the candidate's recurrent term is
+        # gated by r BEFORE the sum (the reference/cuDNN GRU formulation)
+        ng = self._num_gates
+        prefix = "t%d_" % self._counter
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            dilate=self._i2h_dilate,
+                            num_filter=ng * self._hidden_channels,
+                            name=prefix + "i2h")
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            dilate=self._h2h_dilate,
+                            num_filter=ng * self._hidden_channels,
+                            name=prefix + "h2h")
+        i2h_r, i2h_z, i2h_c = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_c = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(i2h_r + h2h_r)
+        z = F.sigmoid(i2h_z + h2h_z)
+        cand = self._act(F, i2h_c + r * h2h_c)
+        out = (1 - z) * cand + z * states[0]
+        return out, [out]
+
+
+def _make_cell(base, dims, default_layout, alias_name, doc):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     i2h_weight_initializer=None, h2h_weight_initializer=None,
+                     i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                     conv_layout=default_layout, activation="tanh",
+                     prefix=None, params=None):
+            super().__init__(
+                input_shape=input_shape, hidden_channels=hidden_channels,
+                i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel,
+                i2h_pad=i2h_pad, i2h_dilate=i2h_dilate, h2h_dilate=h2h_dilate,
+                i2h_weight_initializer=i2h_weight_initializer,
+                h2h_weight_initializer=h2h_weight_initializer,
+                i2h_bias_initializer=i2h_bias_initializer,
+                h2h_bias_initializer=h2h_bias_initializer,
+                dims=dims, conv_layout=conv_layout, activation=activation,
+                prefix=prefix, params=params)
+
+    Cell.__name__ = Cell.__qualname__ = alias_name
+    Cell.__doc__ = doc
+    return Cell
+
+
+_DOC = ("%dD convolutional %s cell (reference "
+        "gluon/contrib/rnn/conv_rnn_cell.py). input_shape is channel-first "
+        "(C, spatial...); state spatial dims follow the i2h convolution.")
+
+Conv1DRNNCell = _make_cell(_ConvRNNCell, 1, "NCW", "Conv1DRNNCell", _DOC % (1, "RNN"))
+Conv2DRNNCell = _make_cell(_ConvRNNCell, 2, "NCHW", "Conv2DRNNCell", _DOC % (2, "RNN"))
+Conv3DRNNCell = _make_cell(_ConvRNNCell, 3, "NCDHW", "Conv3DRNNCell", _DOC % (3, "RNN"))
+Conv1DLSTMCell = _make_cell(_ConvLSTMCell, 1, "NCW", "Conv1DLSTMCell", _DOC % (1, "LSTM"))
+Conv2DLSTMCell = _make_cell(_ConvLSTMCell, 2, "NCHW", "Conv2DLSTMCell", _DOC % (2, "LSTM"))
+Conv3DLSTMCell = _make_cell(_ConvLSTMCell, 3, "NCDHW", "Conv3DLSTMCell", _DOC % (3, "LSTM"))
+Conv1DGRUCell = _make_cell(_ConvGRUCell, 1, "NCW", "Conv1DGRUCell", _DOC % (1, "GRU"))
+Conv2DGRUCell = _make_cell(_ConvGRUCell, 2, "NCHW", "Conv2DGRUCell", _DOC % (2, "GRU"))
+Conv3DGRUCell = _make_cell(_ConvGRUCell, 3, "NCDHW", "Conv3DGRUCell", _DOC % (3, "GRU"))

@@ -57,6 +57,66 @@ class VariationalDropoutCell(ModifierCell):
         return output, next_states
 
 
-class Conv1DRNNCell(HybridRecurrentCell):
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError("ConvRNN cells: planned widening item")
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a recurrent projection layer (LSTMP, Sak et al. 2014;
+    reference gluon/contrib/rnn/rnn_cell.py:197).
+
+    The cell state keeps ``hidden_size`` units; the output/recurrent state
+    is projected down to ``projection_size`` — cuts the h2h matmul cost for
+    large hidden sizes (on TPU both matmuls stay MXU-shaped)."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def _shape_hook(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        prefix = "t%d_" % self._counter
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "i2h")
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "h2h")
+        gates = i2h + h2h
+        in_g, forget_g, cell_g, out_g = F.SliceChannel(
+            gates, num_outputs=4, axis=1)
+        c = (F.sigmoid(forget_g) * states[1]
+             + F.sigmoid(in_g) * F.Activation(cell_g, act_type="tanh"))
+        hidden = F.sigmoid(out_g) * F.Activation(c, act_type="tanh")
+        proj = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                                num_hidden=self._projection_size,
+                                name=prefix + "proj")
+        return proj, [proj, c]

@@ -1,0 +1,153 @@
+"""Native threaded ImageRecord iterator over src/pipeline.cc.
+
+The reference's image training input is fully native (ImageRecordIOParser2
+decode threads + batch loader + prefetcher, src/io/iter_image_recordio_2.cc,
+iter_batchloader.h, iter_prefetcher.h).  This iterator is that pipeline for
+the TPU build: record reading, JPEG decode, and resize run on C++ threads;
+Python only receives filled uint8 batches and hands them to the device.
+
+Augmentation beyond resize (random crop/flip/color) is intentionally NOT in
+C++: on TPU those are best expressed as XLA ops fused into the input side of
+the step (or via the python ImageIter when full augmenter parity is needed
+— io.ImageRecordIter picks the backend accordingly).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as _np
+
+from .io import DataIter, DataBatch, DataDesc
+from ..base import MXNetError
+
+
+class NativeImageRecordIter(DataIter):
+    """Batches from a .rec file via the C++ decode pipeline.
+
+    Parameters mirror the reference ImageRecordIter: ``path_imgrec``,
+    ``data_shape`` (C, H, W), ``batch_size``, ``label_width``,
+    ``preprocess_threads``, plus ``round_batch`` (pad the last batch by
+    wrapping, the reference's default) and ``prefetch_capacity``.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 preprocess_threads=4, round_batch=True,
+                 prefetch_capacity=256, data_name="data", label_name="softmax_label",
+                 layout="NCHW", **unsupported):
+        super().__init__(batch_size)
+        if unsupported:
+            raise MXNetError(
+                "native ImageRecordIter does not support %s — augmentation/"
+                "shuffle belong to the python backend (backend='python') or "
+                "to XLA-side transforms" % sorted(unsupported))
+        if layout not in ("NCHW", "NHWC"):
+            raise ValueError("layout must be NCHW or NHWC")
+        # NHWC hands the C++ buffer to the device as uint8 unchanged — the
+        # TPU-preferred layout, with cast/normalize fused into the step by
+        # XLA; NCHW (reference parity) transposes+casts on host.
+        self._layout = layout
+        from .._native import get_lib
+        lib = get_lib()
+        if lib is None or not hasattr(lib, "mxtpu_pipe_open"):
+            raise MXNetError("native pipeline unavailable (g++/libjpeg "
+                             "missing); use io.ImageRecordIter backend='python'")
+        self._lib = lib
+        c, h, w = (int(x) for x in data_shape)
+        self._shape = (c, h, w)
+        self._label_width = int(label_width)
+        self._round_batch = round_batch
+        self._data_name, self._label_name = data_name, label_name
+        self._handle = lib.mxtpu_pipe_open(
+            path_imgrec.encode(), w, h, c, self._label_width,
+            int(preprocess_threads), int(prefetch_capacity))
+        if not self._handle:
+            raise MXNetError("cannot open record file %s" % path_imgrec)
+        self._hwc = (h, w, c)
+        self._data_buf = _np.empty((batch_size,) + self._hwc, dtype=_np.uint8)
+        self._label_buf = _np.empty((batch_size, self._label_width),
+                                    dtype=_np.float32)
+        self._batch = None
+        self._pad = 0
+        self._exhausted = False
+
+    @property
+    def provide_data(self):
+        if self._layout == "NHWC":
+            return [DataDesc(self._data_name, (self.batch_size,) + self._hwc,
+                             _np.uint8)]
+        return [DataDesc(self._data_name, (self.batch_size,) + self._shape,
+                         _np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self._label_width == 1
+                 else (self.batch_size, self._label_width))
+        return [DataDesc(self._label_name, shape, _np.float32)]
+
+    def reset(self):
+        self._lib.mxtpu_pipe_reset(self._handle)
+        self._exhausted = False
+
+    @property
+    def skipped(self):
+        """Records dropped by the decoder (corrupt/truncated JPEGs)."""
+        return int(self._lib.mxtpu_pipe_skipped(self._handle))
+
+    def iter_next(self):
+        if self._exhausted:
+            return False
+        n = int(self._lib.mxtpu_pipe_next_batch(
+            self._handle, self.batch_size,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
+        if n == 0:
+            self._exhausted = True
+            errs = int(self._lib.mxtpu_pipe_read_errors(self._handle))
+            if errs:
+                raise MXNetError(
+                    "corrupt RecordIO frame truncated the stream "
+                    "(%d read error(s)); the epoch is incomplete" % errs)
+            return False
+        self._pad = self.batch_size - n
+        if n < self.batch_size:
+            self._exhausted = True
+            if not self._round_batch:
+                return False
+            # pad by repeating the first delivered sample (reference pads
+            # with wrapped data; content beyond pad is masked by `pad`)
+            self._data_buf[n:] = self._data_buf[0]
+            self._label_buf[n:] = self._label_buf[0]
+        from .. import ndarray as nd
+        if self._layout == "NHWC":
+            chw = self._data_buf.copy()  # buffer is reused next batch
+        else:
+            chw = self._data_buf.transpose(0, 3, 1, 2).astype(_np.float32)
+        labels = (self._label_buf[:, 0] if self._label_width == 1
+                  else self._label_buf)
+        self._batch = DataBatch(
+            data=[nd.array(chw, dtype=chw.dtype)], label=[nd.array(labels)],
+            pad=self._pad, index=None,
+            provide_data=self.provide_data, provide_label=self.provide_label)
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self._batch
+        raise StopIteration
+
+    def getdata(self):
+        return self._batch.data
+
+    def getlabel(self):
+        return self._batch.label
+
+    def getpad(self):
+        return self._pad
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.mxtpu_pipe_close(self._handle)
+                self._handle = None
+        except Exception:
+            pass

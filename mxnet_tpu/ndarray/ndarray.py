@@ -229,7 +229,15 @@ class NDArray:
     def attach_grad(self, grad_req="write", stype=None):
         self._ag_is_leaf = True
         self._ag_grad_req = grad_req
-        self.grad = _wrap(_jnp().zeros_like(self._data), ctx=self._ctx)
+        if stype in ("row_sparse", "csr"):
+            # sparse grad buffer: backward writes touched rows only (the
+            # reference Embedding sparse_grad path); never densified unless
+            # a dense cotangent actually arrives
+            from . import sparse as _sp
+            self.grad = _sp.zeros(stype, self.shape, ctx=self._ctx,
+                                  dtype=self.dtype)
+        else:
+            self.grad = _wrap(_jnp().zeros_like(self._data), ctx=self._ctx)
         self._ag_entry = None
 
     def detach(self):
@@ -495,6 +503,12 @@ def invoke(op_name, inputs, attrs, out=None):
     """Imperative op invocation — the analog of Imperative::Invoke
     (src/imperative/imperative.cc:87): resolve op, apply (jit-cached),
     wrap/record/write-out."""
+    if (op_name == "Embedding" and out is None and autograd.is_recording()
+            and str(attrs.get("sparse_grad", False)).lower() in ("true", "1")):
+        # sparse_grad: record a row-sparse weight cotangent instead of the
+        # dense scatter jax.vjp would produce
+        from .sparse import sparse_embedding
+        return sparse_embedding(inputs[0], inputs[1])
     op = get_op(op_name)
     attrs = dict(attrs)
     if op.mode_dependent:

@@ -339,3 +339,94 @@ def rand_sparse_ndarray(shape, stype, density=0.05, dtype=None):
     if stype == "csr":
         return csr_matrix(dense, shape=shape), dense
     raise MXNetError("unknown stype %s" % stype)
+
+
+# ---------------------------------------------------------------------------
+# row-sparse gradients (reference Embedding sparse_grad / SparseEmbedding)
+# ---------------------------------------------------------------------------
+
+class RowSparseCotangent:
+    """A row-sparse cotangent flowing through the autograd tape.
+
+    Holds (indices, values) for the touched rows of a (rows, d) leaf —
+    duplicates allowed (summed on materialization).  The tape's accumulation
+    helper and leaf router understand this type; everything else densifies
+    via ``todense`` (the storage-fallback rule applied to gradients).
+    Reference: Embedding's sparse_grad option emits a row_sparse gradient
+    (src/operator/tensor/indexing_op.cc EmbeddingOpBackward storage type).
+    """
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices      # jax int array (nnz,), duplicates ok
+        self.values = values        # jax (nnz, d)
+        self.shape = tuple(shape)
+
+    def todense(self):
+        jnp = _jnp()
+        dense = jnp.zeros(self.shape, dtype=self.values.dtype)
+        if self.values.shape[0]:
+            dense = dense.at[self.indices].add(self.values)
+        return dense
+
+    def merge(self, other):
+        jnp = _jnp()
+        assert self.shape == other.shape
+        return RowSparseCotangent(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]), self.shape)
+
+    def to_row_sparse(self, ctx=None):
+        """Deduplicated, sorted RowSparseNDArray."""
+        import jax
+        jnp = _jnp()
+        idx = _np.asarray(self.indices)
+        uni, inv = _np.unique(idx, return_inverse=True)
+        vals = jax.ops.segment_sum(self.values,
+                                   jnp.asarray(inv.astype(_np.int32)),
+                                   num_segments=len(uni))
+        return RowSparseNDArray(_wrap(vals), _wrap(jnp.asarray(
+            uni.astype(_np.int32))), self.shape, ctx=ctx, _sorted=True)
+
+
+def assign_row_sparse(target, source):
+    """Overwrite a RowSparseNDArray's contents in place (keeps aliasing —
+    Parameter/Trainer hold the same grad buffer object)."""
+    assert isinstance(target, RowSparseNDArray)
+    target._aux = dict(source._get_aux())
+    target._shape_ = source._shape_
+    NDArray._data.fset(target, None)
+    return target
+
+
+def sparse_embedding(data, weight, out=None):
+    """Embedding lookup whose recorded weight-gradient is row_sparse.
+
+    Forward = the plain Embedding gather; on the tape the weight's
+    cotangent is a :class:`RowSparseCotangent` carrying only the gathered
+    rows — an embedding table of 1e6 rows with a 32-token batch costs a
+    (32, d) gradient, never (1e6, d).  (reference sparse_grad path,
+    python/mxnet/gluon/contrib/nn/basic_layers.py SparseEmbedding)
+    """
+    from .. import autograd
+    from .ndarray import invoke
+
+    attrs = {"input_dim": weight.shape[0], "output_dim": weight.shape[1]}
+    with autograd.pause():
+        out_nd = invoke("Embedding", [data, weight], attrs)
+    if autograd.is_recording():
+        idx_vals = data._data
+        w_shape = weight.shape
+        out_primal = out_nd._data
+
+        def vjp(out_cts):
+            og = out_cts[0] if isinstance(out_cts, (tuple, list)) else out_cts
+            flat_idx = idx_vals.reshape(-1).astype("int32")
+            flat_g = og.reshape((-1, og.shape[-1]))
+            return (None, RowSparseCotangent(flat_idx, flat_g, w_shape))
+
+        autograd.record_op(None, [data, weight], [out_nd],
+                           name="SparseEmbedding", vjp_fn=vjp,
+                           primals_out=(out_primal,))
+    return out_nd

@@ -21,8 +21,6 @@ from __future__ import annotations
 
 import functools
 
-import numpy as _np
-
 
 def _one_hot_dispatch(gates, k, capacity):
     """Build dispatch/combine tensors from gate probs (T, E).

@@ -28,8 +28,6 @@ from __future__ import annotations
 
 import functools
 
-import numpy as _np
-
 
 def pipeline_apply(stage_fn, stage_params, x_microbatches, axis_name="pp"):
     """Run inside shard_map: apply an S-stage pipeline to M microbatches.

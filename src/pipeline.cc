@@ -1,0 +1,367 @@
+// Native threaded image-record pipeline.
+//
+// The reference's ImageNet-rate data path is C++: ImageRecordIOParser2 (N
+// JPEG-decode threads over RecordIO chunks, src/io/iter_image_recordio_2.cc)
+// chained into a batch loader (iter_batchloader.h) and a background
+// prefetcher (iter_prefetcher.h).  This file is the TPU build's native
+// equivalent, bound via ctypes (mxnet_tpu/io/native_image_iter.py):
+//
+//   producer thread -> bounded raw-record queue -> N decode workers
+//   (libjpeg decode + bilinear resize to the target shape) -> bounded
+//   sample queue -> mxtpu_pipe_next_batch fills caller buffers.
+//
+// Records use the reference image-record layout: IRHeader
+// [u32 flag][f32 label][u64 id][u64 id2] (+flag extra label floats when
+// flag>0) followed by JPEG bytes (python/mxnet/recordio.py pack_img).
+//
+// Batches come out HWC uint8 + float32 labels; layout/normalization/augment
+// stay on the JAX side where XLA fuses them into the input pipeline.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* mxtpu_recio_reader_open(const char* path);
+int64_t mxtpu_recio_reader_next(void* handle, uint8_t** out);
+void mxtpu_recio_reader_reset(void* handle);
+void mxtpu_recio_reader_close(void* handle);
+}
+
+namespace {
+
+// ---------------------------------------------------------------- jpeg
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+bool DecodeJpeg(const uint8_t* buf, size_t len, int channels,
+                std::vector<uint8_t>* out, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = channels == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(static_cast<size_t>(*w) * (*h) * channels);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row =
+        out->data() + static_cast<size_t>(cinfo.output_scanline) * (*w) * channels;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear HWC uint8 resize (the parser's default resize; augmentation
+// beyond this is python/XLA-side).
+void ResizeBilinear(const std::vector<uint8_t>& src, int sw, int sh, int c,
+                    uint8_t* dst, int dw, int dh) {
+  if (sw == dw && sh == dh) {
+    std::memcpy(dst, src.data(), src.size());
+    return;
+  }
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = fy < 0 ? 0 : static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = fx < 0 ? 0 : static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int k = 0; k < c; ++k) {
+        float v00 = src[(static_cast<size_t>(y0) * sw + x0) * c + k];
+        float v01 = src[(static_cast<size_t>(y0) * sw + x1) * c + k];
+        float v10 = src[(static_cast<size_t>(y1) * sw + x0) * c + k];
+        float v11 = src[(static_cast<size_t>(y1) * sw + x1) * c + k];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(static_cast<size_t>(y) * dw + x) * c + k] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// ------------------------------------------------------------- queues
+
+template <typename T>
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t cap) : cap_(cap) {}
+
+  // false = queue finished and drained
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty() || done_; });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  void Push(T v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < cap_ || done_; });
+    if (done_) return;  // shutting down: drop
+    q_.push_back(std::move(v));
+    not_empty_.notify_one();
+  }
+
+  void Finish() {
+    std::lock_guard<std::mutex> lk(mu_);
+    done_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  void Reset() {
+    std::lock_guard<std::mutex> lk(mu_);
+    q_.clear();
+    done_ = false;
+  }
+
+ private:
+  size_t cap_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<T> q_;
+  bool done_ = false;
+};
+
+// ------------------------------------------------------------ pipeline
+
+struct Sample {
+  uint64_t seq = 0;            // file-order position (delivery is in-order)
+  bool valid = false;          // false: corrupt record, hole in the sequence
+  std::vector<uint8_t> data;   // dh*dw*c
+  std::vector<float> label;    // label_width
+};
+
+struct RawRec {
+  uint64_t seq;
+  std::vector<uint8_t> bytes;
+};
+
+struct Pipeline {
+  void* reader = nullptr;
+  int dw, dh, c, label_width, nthreads;
+  BoundedQueue<RawRec> raw_q;
+  BoundedQueue<Sample> out_q;
+  std::vector<std::thread> threads;
+  std::atomic<int> live_workers{0};
+  std::atomic<int64_t> skipped{0};
+  std::atomic<int64_t> read_errors{0};
+  std::atomic<bool> stop{false};
+  bool running = false;
+  // reorder state (consumer side only, no lock needed)
+  std::map<uint64_t, Sample> reorder;
+  uint64_t next_seq = 0;
+
+  Pipeline(int dw_, int dh_, int c_, int lw, int nt, int qcap)
+      : dw(dw_), dh(dh_), c(c_), label_width(lw), nthreads(nt),
+        raw_q(qcap), out_q(qcap) {}
+};
+
+constexpr size_t kIRHeaderBytes = 4 + 4 + 8 + 8;  // flag, label, id, id2
+
+// Decode one record into *s; returns false (an invalid sample, a hole in
+// the delivery sequence) on parse/decode failure.
+bool DecodeRecord(Pipeline* p, const std::vector<uint8_t>& rec,
+                  std::vector<uint8_t>* pixels, Sample* s) {
+  if (rec.size() < kIRHeaderBytes) return false;
+  uint32_t flag;
+  float label0;
+  std::memcpy(&flag, rec.data(), 4);
+  std::memcpy(&label0, rec.data() + 4, 4);
+  size_t off = kIRHeaderBytes;
+  s->label.assign(p->label_width, 0.f);
+  if (flag > 0) {
+    size_t nl = flag;
+    if (off + nl * 4 > rec.size()) return false;
+    for (size_t i = 0; i < nl && i < s->label.size(); ++i)
+      std::memcpy(&s->label[i], rec.data() + off + i * 4, 4);
+    off += nl * 4;
+  } else {
+    s->label[0] = label0;
+  }
+  int w = 0, h = 0;
+  if (!DecodeJpeg(rec.data() + off, rec.size() - off, p->c, pixels, &w, &h))
+    return false;
+  s->data.resize(static_cast<size_t>(p->dw) * p->dh * p->c);
+  ResizeBilinear(*pixels, w, h, p->c, s->data.data(), p->dw, p->dh);
+  return true;
+}
+
+void WorkerLoop(Pipeline* p) {
+  RawRec rec;
+  std::vector<uint8_t> pixels;
+  while (p->raw_q.Pop(&rec)) {
+    Sample s;
+    s.seq = rec.seq;
+    s.valid = DecodeRecord(p, rec.bytes, &pixels, &s);
+    if (!s.valid) {
+      ++p->skipped;
+      s.data.clear();
+    }
+    // invalid samples are still pushed so the consumer's reorder window
+    // never stalls waiting for a hole in the sequence
+    p->out_q.Push(std::move(s));
+  }
+  if (--p->live_workers == 0) p->out_q.Finish();
+}
+
+void ProducerLoop(Pipeline* p) {
+  uint8_t* ptr = nullptr;
+  int64_t n = -1;
+  uint64_t seq = 0;
+  // the stop flag lets a mid-epoch reset/close return without scanning the
+  // rest of a multi-GB file
+  while (!p->stop && (n = mxtpu_recio_reader_next(p->reader, &ptr)) >= 0) {
+    p->raw_q.Push(RawRec{seq++, std::vector<uint8_t>(ptr, ptr + n)});
+  }
+  // -1 = clean EOF; -2 = corrupt frame (cannot resync; the tail of the
+  // file is lost — surface it via read_errors instead of silent truncation)
+  if (n == -2) ++p->read_errors;
+  p->raw_q.Finish();
+}
+
+void StartEpoch(Pipeline* p) {
+  p->stop = false;
+  p->raw_q.Reset();
+  p->out_q.Reset();
+  p->reorder.clear();
+  p->next_seq = 0;
+  p->live_workers = p->nthreads;
+  p->threads.emplace_back(ProducerLoop, p);
+  for (int i = 0; i < p->nthreads; ++i) p->threads.emplace_back(WorkerLoop, p);
+  p->running = true;
+}
+
+void JoinEpoch(Pipeline* p) {
+  if (!p->running) return;
+  p->stop = true;
+  p->raw_q.Finish();
+  p->out_q.Finish();
+  for (auto& t : p->threads) t.join();
+  p->threads.clear();
+  p->running = false;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_pipe_open(const char* path, int width, int height, int channels,
+                      int label_width, int nthreads, int queue_cap) {
+  void* reader = mxtpu_recio_reader_open(path);
+  if (!reader) return nullptr;
+  auto* p = new Pipeline(width, height, channels, label_width,
+                         nthreads > 0 ? nthreads : 4,
+                         queue_cap > 0 ? queue_cap : 256);
+  p->reader = reader;
+  StartEpoch(p);
+  return p;
+}
+
+// Fills data_out (n*h*w*c uint8) and label_out (n*label_width f32).
+// Returns number of samples delivered; 0 = epoch exhausted.  Samples are
+// delivered in file order (the reference parser's contract) via a reorder
+// window keyed on the producer's sequence number.
+int64_t mxtpu_pipe_next_batch(void* handle, int64_t n, uint8_t* data_out,
+                              float* label_out) {
+  auto* p = static_cast<Pipeline*>(handle);
+  const size_t stride = static_cast<size_t>(p->dw) * p->dh * p->c;
+  int64_t got = 0;
+  bool drained = false;
+  while (got < n) {
+    // emit everything in-order from the reorder window first
+    auto it = p->reorder.find(p->next_seq);
+    if (it != p->reorder.end()) {
+      if (it->second.valid) {
+        std::memcpy(data_out + got * stride, it->second.data.data(), stride);
+        std::memcpy(label_out + got * p->label_width, it->second.label.data(),
+                    p->label_width * sizeof(float));
+        ++got;
+      }
+      p->reorder.erase(it);
+      ++p->next_seq;
+      continue;
+    }
+    if (drained) break;
+    Sample s;
+    if (!p->out_q.Pop(&s)) {
+      drained = true;
+      continue;
+    }
+    p->reorder.emplace(s.seq, std::move(s));
+  }
+  return got;
+}
+
+// Restart from the beginning of the file (next epoch).
+void mxtpu_pipe_reset(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  JoinEpoch(p);
+  mxtpu_recio_reader_reset(p->reader);
+  StartEpoch(p);
+}
+
+int64_t mxtpu_pipe_skipped(void* handle) {
+  return static_cast<Pipeline*>(handle)->skipped.load();
+}
+
+// Nonzero when a corrupt RecordIO frame truncated the stream (distinct from
+// per-record decode skips): the epoch silently lost its tail — callers
+// should raise, not continue.
+int64_t mxtpu_pipe_read_errors(void* handle) {
+  return static_cast<Pipeline*>(handle)->read_errors.load();
+}
+
+void mxtpu_pipe_close(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  JoinEpoch(p);
+  mxtpu_recio_reader_close(p->reader);
+  delete p;
+}
+
+}  // extern "C"

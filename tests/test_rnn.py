@@ -228,3 +228,83 @@ def test_rnn_checkpoint_roundtrip(tmp_path):
     s2, a2, _ = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
     for k, v in args.items():
         np.testing.assert_allclose(a2[k].asnumpy(), v.asnumpy(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# convolutional recurrent cells (gluon.contrib.rnn.conv_rnn_cell)
+# ---------------------------------------------------------------------------
+
+def test_conv_rnn_cell_shapes():
+    from mxnet_tpu.gluon.contrib.rnn import (Conv2DRNNCell, Conv2DLSTMCell,
+                                             Conv2DGRUCell, Conv1DLSTMCell,
+                                             Conv3DGRUCell)
+    B, T = 2, 3
+    for Cell, nstates in ((Conv2DRNNCell, 1), (Conv2DLSTMCell, 2),
+                          (Conv2DGRUCell, 1)):
+        cell = Cell(input_shape=(4, 8, 8), hidden_channels=6,
+                    i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = [nd.array(np.random.rand(B, 4, 8, 8).astype(np.float32))
+             for _ in range(T)]
+        outs, states = cell.unroll(T, x, merge_outputs=False)
+        assert len(outs) == T and len(states) == nstates
+        assert outs[-1].shape == (B, 6, 8, 8)
+        for s in states:
+            assert s.shape == (B, 6, 8, 8)
+    # 1-D and 3-D variants
+    c1 = Conv1DLSTMCell(input_shape=(2, 10), hidden_channels=3,
+                        i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c1.initialize()
+    o, s = c1(nd.array(np.random.rand(B, 2, 10).astype(np.float32)),
+              c1.begin_state(batch_size=B))
+    assert o.shape == (B, 3, 10)
+    c3 = Conv3DGRUCell(input_shape=(2, 4, 4, 4), hidden_channels=3,
+                       i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c3.initialize()
+    o, s = c3(nd.array(np.random.rand(B, 2, 4, 4, 4).astype(np.float32)),
+              c3.begin_state(batch_size=B))
+    assert o.shape == (B, 3, 4, 4, 4)
+
+
+def test_conv_lstm_matches_manual():
+    """ConvLSTM step equals the hand-computed recurrence."""
+    from mxnet_tpu.gluon.contrib.rnn import Conv2DLSTMCell
+    import jax.numpy as jnp
+    cell = Conv2DLSTMCell(input_shape=(1, 5, 5), hidden_channels=2,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    B = 1
+    x = nd.array(np.random.rand(B, 1, 5, 5).astype(np.float32))
+    h0, c0 = cell.begin_state(batch_size=B)
+    out, (h1, c1) = cell(x, [h0, c0])
+
+    # manual recurrence with the framework's own conv op
+    w_i2h = cell.i2h_weight.data()
+    w_h2h = cell.h2h_weight.data()
+    b_i2h = cell.i2h_bias.data()
+    b_h2h = cell.h2h_bias.data()
+    i2h = nd.Convolution(x, w_i2h, b_i2h, kernel=(3, 3), pad=(1, 1),
+                         num_filter=8)
+    h2h = nd.Convolution(h0, w_h2h, b_h2h, kernel=(3, 3), pad=(1, 1),
+                         num_filter=8)
+    g = (i2h + h2h).asnumpy()
+    ig, fg, cg, og = np.split(g, 4, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c_ref = sig(fg) * c0.asnumpy() + sig(ig) * np.tanh(cg)
+    h_ref = sig(og) * np.tanh(c_ref)
+    np.testing.assert_allclose(c1.asnumpy(), c_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h1.asnumpy(), h_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out.asnumpy(), h_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lstmp_cell():
+    """LSTMP: projected recurrent state (contrib rnn_cell.py:197)."""
+    from mxnet_tpu.gluon.contrib.rnn import LSTMPCell
+    cell = LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    B, T = 2, 4
+    x = [nd.array(np.random.rand(B, 5).astype(np.float32)) for _ in range(T)]
+    outs, states = cell.unroll(T, x, merge_outputs=False)
+    assert outs[-1].shape == (B, 3)          # projected
+    assert states[0].shape == (B, 3)         # h: projection size
+    assert states[1].shape == (B, 8)         # c: hidden size

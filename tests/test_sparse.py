@@ -263,3 +263,106 @@ def test_tpu_sync_kvstore_sparse_reduce():
     out = kv._reduce([g1, g2])
     assert out.stype == "row_sparse" and out._data_buf is None
     assert_almost_equal(out.data.asnumpy(), np.full((1, 4), 2.0))
+
+
+def test_sparse_embedding_row_sparse_grad_end_to_end():
+    """SparseEmbedding: backward writes a row_sparse grad buffer holding
+    ONLY the looked-up rows; the lazy SGD kernel consumes it; untouched
+    rows never materialize (the full reference sparse_grad chain:
+    Embedding sparse_grad -> row_sparse grad -> sparse optimizer)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    vocab, dim = 100_000, 8
+    layer = SparseEmbedding(vocab, dim)
+    layer.initialize(mx.init.Xavier())
+    idx = nd.array(np.array([3, 42, 3, 77]), dtype="int32")
+    with autograd.record():
+        emb = layer(idx)
+        loss = (emb * emb).sum()
+    loss.backward()
+    g = layer.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert g._data_buf is None, "sparse grad must not densify"
+    assert g.nnz == 3   # rows 3, 42, 77 (3 appears twice, summed)
+    w = layer.weight.data().asnumpy()
+    got = dict(zip(g.indices.asnumpy().tolist(),
+                   g.data.asnumpy().tolist()))
+    np.testing.assert_allclose(got[3], 2 * (w[3] + w[3]), rtol=1e-5)
+    np.testing.assert_allclose(got[77], 2 * w[77], rtol=1e-5)
+
+    # the lazy optimizer consumes it without touching other rows
+    from mxnet_tpu.ndarray import invoke
+    w_nd = layer.weight.data()
+    w_before = w_nd.asnumpy().copy()
+    invoke("sgd_update", [w_nd, g], {"lr": "0.5"}, out=w_nd)
+    w_after = w_nd.asnumpy()
+    untouched = np.setdiff1d(np.arange(vocab), [3, 42, 77])[:50]
+    np.testing.assert_array_equal(w_after[untouched], w_before[untouched])
+    assert not np.allclose(w_after[3], w_before[3])
+
+
+def test_embedding_sparse_grad_attr():
+    """nd.Embedding(..., sparse_grad=True) records the row-sparse path."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    weight = nd.array(np.random.uniform(-1, 1, (50, 4)).astype(np.float32))
+    weight.attach_grad(stype="row_sparse")
+    idx = nd.array([1, 3], dtype="int32")
+    with autograd.record():
+        out = nd.Embedding(idx, weight, input_dim=50, output_dim=4,
+                           sparse_grad=True)
+        out.sum().backward()
+    assert isinstance(weight.grad, RowSparseNDArray)
+    assert weight.grad.nnz == 2
+    np.testing.assert_allclose(weight.grad.data.asnumpy(),
+                               np.ones((2, 4), np.float32))
+
+
+def test_autograd_grad_returns_row_sparse():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray, sparse_embedding
+    weight = nd.array(np.random.uniform(-1, 1, (30, 3)).astype(np.float32))
+    weight.attach_grad()
+    idx = nd.array([7, 7, 2], dtype="int32")
+    with autograd.record():
+        out = sparse_embedding(idx, weight)
+        s = out.sum()
+    g = autograd.grad(s, weight)[0]
+    assert isinstance(g, RowSparseNDArray) and g.nnz == 2
+    got = dict(zip(g.indices.asnumpy().tolist(), g.data.asnumpy().tolist()))
+    np.testing.assert_allclose(got[7], [2, 2, 2])
+    np.testing.assert_allclose(got[2], [1, 1, 1])
+
+
+def test_sparse_grad_through_non_leaf_weight_densifies():
+    """RowSparseCotangent reaching a dense vjp falls back to dense (no
+    crash; the storage-fallback rule for gradients)."""
+    from mxnet_tpu import autograd
+    weight = nd.array(np.random.uniform(-1, 1, (20, 3)).astype(np.float32))
+    weight.attach_grad()
+    idx = nd.array([4, 9], dtype="int32")
+    from mxnet_tpu.ndarray.sparse import sparse_embedding
+    with autograd.record():
+        w2 = weight * 2.0          # weight is now a non-leaf input
+        out = sparse_embedding(idx, w2)
+        out.sum().backward()
+    g = weight.grad.asnumpy()
+    assert g[4].sum() == 6.0 and g[9].sum() == 6.0  # 2 * ones * 3 dims
+    assert g[0].sum() == 0.0
+
+
+def test_sparse_zero_grad_stays_sparse():
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+    from mxnet_tpu import autograd
+    layer = SparseEmbedding(500_000, 4)
+    layer.initialize()
+    idx = nd.array([1, 2], dtype="int32")
+    with autograd.record():
+        layer(idx).sum().backward()
+    p = layer.weight
+    assert p.grad().nnz == 2
+    p.zero_grad()
+    g = p.grad()
+    assert g.nnz == 0 and g._data_buf is None
