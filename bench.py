@@ -24,11 +24,15 @@ import numpy as np
 # the package fails to import
 BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 IMG = int(os.environ.get("BENCH_IMG", "224"))
-BASELINE_IMGS_PER_SEC = 298.51  # V100 fp32 train, docs/faq/perf.md:208-217
+# BENCH_MODE=train (default, the driver metric) | inference
+# (docs/faq/perf.md:150-180: 1076.81 img/s fp32 / 2085.51 fp16 on V100)
+MODE = os.environ.get("BENCH_MODE", "train")
+BASELINE_IMGS_PER_SEC = 298.51 if MODE == "train" else 2085.51
 # the baseline ratio is only meaningful for the headline config
 IS_HEADLINE = (BATCH == 32 and IMG == 224)
-METRIC = ("resnet50_train_imgs_per_sec_bs32" if IS_HEADLINE
-          else "resnet50_train_imgs_per_sec_bs%d_img%d" % (BATCH, IMG))
+_KIND = "train" if MODE == "train" else "infer"
+METRIC = ("resnet50_%s_imgs_per_sec_bs32" % _KIND if IS_HEADLINE
+          else "resnet50_%s_imgs_per_sec_bs%d_img%d" % (_KIND, BATCH, IMG))
 
 
 def _init_backend():
@@ -94,6 +98,32 @@ def main():
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.uniform(-1, 1, (BATCH, 3, IMG, IMG)).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, BATCH).astype(np.int32))
+
+    if MODE == "inference":
+        # weights AND moving stats in bf16: fp32 stats would promote the
+        # activations and break the all-bf16 conv chain
+        all_params = {n: v.astype(dtype) for n, v in params.items()}
+
+        @jax.jit
+        def infer_step(p, xb):
+            outs, _ = functional_call(net, p, xb.astype(dtype), training=False)
+            return outs[0]
+
+        infer_step(all_params, x).block_until_ready()
+        iters = int(os.environ.get("BENCH_ITERS", "50"))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = infer_step(all_params, x)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": METRIC,
+            "value": round(BATCH * iters / dt, 2),
+            "unit": "images/sec",
+            "vs_baseline": (round(BATCH * iters / dt / BASELINE_IMGS_PER_SEC, 3)
+                            if IS_HEADLINE else None),
+        }))
+        return
 
     # compile + warmup
     train_params, momenta, aux_params, loss = train_step(

@@ -38,8 +38,15 @@ def _build():
     # native RecordIO fast path never regresses (pipeline users get the
     # python backend instead).  The marker forces a full-build retry next
     # session — e.g. after libjpeg gets installed.
+    lib_current = (os.path.exists(_LIB_PATH)
+                   and os.path.getmtime(_LIB_PATH) >= newest_src)
     for attempt_srcs in (srcs, [s for s in srcs if "pipeline" not in s]):
         full = attempt_srcs is srcs
+        if not full and lib_current:
+            # full build still failing (libjpeg absent) and the fallback
+            # .so on disk is already up to date — don't recompile it on
+            # every process start
+            return True
         cmd = base + attempt_srcs + (["-ljpeg"] if full else []) + ["-lpthread"]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)

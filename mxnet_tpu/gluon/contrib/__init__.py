@@ -3,3 +3,4 @@ Identity/SparseEmbedding/SyncBatchNorm layers, VariationalDropoutCell,
 LSTMPCell, and the ConvRNN/ConvLSTM/ConvGRU cell family."""
 from . import nn
 from . import rnn
+from . import data

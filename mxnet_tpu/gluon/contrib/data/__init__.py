@@ -1,0 +1,3 @@
+"""Contrib datasets & samplers (reference: gluon/contrib/data/)."""
+from .sampler import IntervalSampler
+from .text import WikiText2, WikiText103

@@ -1,0 +1,91 @@
+"""Language-model datasets (reference: gluon/contrib/data/text.py —
+WikiText2/WikiText103).
+
+Zero-egress build: the archives cannot be downloaded here; stage the
+extracted ``wiki.<segment>.tokens`` files under
+``$MXNET_HOME/datasets/wikitext-2`` (or pass ``root``).  Tokenization,
+vocabulary construction (via contrib.text.vocab.Vocabulary), and the
+(data, label)=next-token framing match the reference.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from ....base import data_dir, MXNetError
+from ...data.dataset import Dataset
+from ... import data as _gdata
+from .... import ndarray as nd
+
+EOS_TOKEN = "<eos>"
+
+
+class _WikiText(Dataset):
+    def __init__(self, root, segment, seq_len, vocab, namespace):
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        self._vocab = vocab
+        self._counter = None
+        self._namespace = namespace
+        self._get_data()
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _get_data(self):
+        fname = os.path.join(self._root, "wiki.%s.tokens" % self._segment)
+        if not os.path.exists(fname):
+            raise MXNetError(
+                "%s not found. No network egress in this build — stage the "
+                "extracted %s archive under %s" %
+                (fname, self._namespace, self._root))
+        with open(fname, encoding="utf8") as fin:
+            content = fin.read()
+        from ....contrib.text import utils as text_utils, vocab as text_vocab
+        if self._counter is None:
+            self._counter = text_utils.count_tokens_from_str(content)
+        if self._vocab is None:
+            self._vocab = text_vocab.Vocabulary(counter=self._counter,
+                                                reserved_tokens=[EOS_TOKEN])
+        lines = [l.strip().split() for l in content.splitlines()]
+        tokens = []
+        for line in lines:
+            if line:
+                tokens.extend(line)
+                tokens.append(EOS_TOKEN)
+        idx = self._vocab.to_indices(tokens)
+        data, label = idx[:-1], idx[1:]
+        n = (len(data) // self._seq_len) * self._seq_len
+        self._data = nd.array(_np.asarray(data[:n], dtype=_np.int32)
+                              .reshape(-1, self._seq_len), dtype="int32")
+        self._label = nd.array(_np.asarray(label[:n], dtype=_np.int32)
+                               .reshape(-1, self._seq_len), dtype="int32")
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (Merity et al. 2016); segments train/val/test."""
+
+    def __init__(self, root=os.path.join(data_dir(), "datasets", "wikitext-2"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, segment, seq_len, vocab, "wikitext-2")
+
+
+class WikiText103(_WikiText):
+    """WikiText-103; segments train/val/test."""
+
+    def __init__(self, root=os.path.join(data_dir(), "datasets", "wikitext-103"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, segment, seq_len, vocab, "wikitext-103")

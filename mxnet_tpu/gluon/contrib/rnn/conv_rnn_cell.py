@@ -13,14 +13,8 @@ only, matching the framework's Convolution op API.
 from __future__ import annotations
 
 from ....base import MXNetError
+from ...nn.conv_layers import _pair as _tuple
 from ...rnn.rnn_cell import HybridRecurrentCell
-
-
-def _tuple(v, n):
-    if isinstance(v, (list, tuple)):
-        assert len(v) == n, "expected %d-tuple, got %r" % (n, v)
-        return tuple(int(x) for x in v)
-    return (int(v),) * n
 
 
 class _ConvCellBase(HybridRecurrentCell):
@@ -147,19 +141,10 @@ class _ConvGRUCell(_ConvCellBase):
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
         # reset/update gates see i2h+h2h; the candidate's recurrent term is
-        # gated by r BEFORE the sum (the reference/cuDNN GRU formulation)
-        ng = self._num_gates
-        prefix = "t%d_" % self._counter
-        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
-                            kernel=self._i2h_kernel, pad=self._i2h_pad,
-                            dilate=self._i2h_dilate,
-                            num_filter=ng * self._hidden_channels,
-                            name=prefix + "i2h")
-        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
-                            kernel=self._h2h_kernel, pad=self._h2h_pad,
-                            dilate=self._h2h_dilate,
-                            num_filter=ng * self._hidden_channels,
-                            name=prefix + "h2h")
+        # gated by r BEFORE the sum (the reference/cuDNN GRU formulation),
+        # so i2h and h2h stay separate rather than pre-summed
+        i2h, h2h = self._convs(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
         i2h_r, i2h_z, i2h_c = F.SliceChannel(i2h, num_outputs=3, axis=1)
         h2h_r, h2h_z, h2h_c = F.SliceChannel(h2h, num_outputs=3, axis=1)
         r = F.sigmoid(i2h_r + h2h_r)

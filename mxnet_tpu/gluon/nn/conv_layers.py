@@ -7,9 +7,12 @@ from ..block import HybridBlock
 
 
 def _pair(v, n):
+    """Normalize int-or-sequence to an n-tuple of ints (shared with the
+    contrib ConvRNN cells)."""
     if isinstance(v, (list, tuple)):
-        return tuple(v)
-    return (v,) * n
+        assert len(v) == n, "expected %d-tuple, got %r" % (n, v)
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
 
 
 class _Conv(HybridBlock):

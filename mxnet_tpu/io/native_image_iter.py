@@ -53,6 +53,11 @@ class NativeImageRecordIter(DataIter):
                              "missing); use io.ImageRecordIter backend='python'")
         self._lib = lib
         c, h, w = (int(x) for x in data_shape)
+        if c not in (1, 3):
+            raise MXNetError("native pipeline decodes 1 (grayscale) or 3 "
+                             "(RGB) channels; got data_shape=%r" % (data_shape,))
+        if int(label_width) < 1:
+            raise MXNetError("label_width must be >= 1, got %r" % label_width)
         self._shape = (c, h, w)
         self._label_width = int(label_width)
         self._round_batch = round_batch
@@ -100,13 +105,16 @@ class NativeImageRecordIter(DataIter):
             self._handle, self.batch_size,
             self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
-        if n == 0:
-            self._exhausted = True
+        if n < self.batch_size:
+            # stream ended (fully or mid-batch): a corrupt frame means the
+            # epoch silently lost its tail — fail loudly either way
             errs = int(self._lib.mxtpu_pipe_read_errors(self._handle))
             if errs:
                 raise MXNetError(
                     "corrupt RecordIO frame truncated the stream "
                     "(%d read error(s)); the epoch is incomplete" % errs)
+        if n == 0:
+            self._exhausted = True
             return False
         self._pad = self.batch_size - n
         if n < self.batch_size:

@@ -139,12 +139,20 @@ class KVStore:
         if all(getattr(v, "stype", "default") == "row_sparse" for v in vlist):
             if len(vlist) == 1:
                 return vlist[0].copy()   # sparse copy() clones aux fields
+            # gather to one device first (aux-field transfer, stays sparse)
+            ctx0 = vlist[0].context
             out = vlist[0]
             for v in vlist[1:]:
+                if v.context != ctx0:
+                    v = v.as_in_context(ctx0)
                 out = invoke("elemwise_add", [out, v], {})
             return out
         if len(vlist) == 1:
             return vlist[0].copy()
+        # gather to the first value's device before the reduce (CommCPU
+        # copies to CPU then sums, comm.h:103; jit rejects mixed placement)
+        ctx0 = vlist[0].context
+        vlist = [vlist[0]] + [v.as_in_context(ctx0) for v in vlist[1:]]
         return invoke("add_n", list(vlist), {})
 
     def _key_to_int(self, k):
@@ -208,7 +216,10 @@ class KVStoreTPUSync(KVStore):
         if self._jit_reduce is None:
             self._jit_reduce = jax.jit(lambda *xs: sum(xs[1:], xs[0]))
         from .ndarray import _wrap
-        return _wrap(self._jit_reduce(*[v._data for v in vlist]), ctx=vlist[0].context)
+        ctx0 = vlist[0].context
+        vals = [vlist[0]._data] + [v.as_in_context(ctx0)._data
+                                   for v in vlist[1:]]
+        return _wrap(self._jit_reduce(*vals), ctx=ctx0)
 
 
 class KVStoreDist(KVStoreTPUSync):

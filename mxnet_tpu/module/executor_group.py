@@ -215,19 +215,26 @@ class DataParallelExecutorGroup:
         if is_train is None:
             is_train = self.for_training
         # scatter data
+        import jax
+
+        def scatter(src, name, i, e):
+            # the slice must land on executor i's device — a raw buffer
+            # handoff would leave it on the source device and jit would
+            # reject the mixed placement
+            sl = self.slices[i]
+            val = src[sl.start:sl.stop]._data if len(self.execs) > 1 \
+                else src._data
+            if len(self.contexts) > 1:
+                val = jax.device_put(val, self.contexts[i].jax_device())
+            e.arg_dict[name]._set_data(val)
+
         for j, desc in enumerate(self.data_shapes):
-            src = data_batch.data[j]
             for i, e in enumerate(self.execs):
-                sl = self.slices[i]
-                e.arg_dict[desc.name]._set_data(src[sl.start:sl.stop]._data
-                                                if len(self.execs) > 1 else src._data)
+                scatter(data_batch.data[j], desc.name, i, e)
         if self.label_shapes is not None and data_batch.label:
             for j, desc in enumerate(self.label_shapes):
-                src = data_batch.label[j]
                 for i, e in enumerate(self.execs):
-                    sl = self.slices[i]
-                    e.arg_dict[desc.name]._set_data(src[sl.start:sl.stop]._data
-                                                    if len(self.execs) > 1 else src._data)
+                    scatter(data_batch.label[j], desc.name, i, e)
         for e in self.execs:
             e.forward(is_train=is_train)
 

@@ -323,6 +323,8 @@ class NDArray:
     def __truediv__(self, o):  return self._binop(o, "broadcast_div", "_div_scalar")
     def __rtruediv__(self, o): return self._binop(o, "broadcast_div", "_div_scalar", True)
     def __mod__(self, o):  return self._binop(o, "broadcast_mod", "_mod_scalar")
+    def __matmul__(self, o):
+        return invoke("dot", [self, o], {})
     def __rmod__(self, o): return self._binop(o, "broadcast_mod", "_mod_scalar", True)
     def __pow__(self, o):  return self._binop(o, "broadcast_power", "_power_scalar")
     def __rpow__(self, o): return self._binop(o, "broadcast_power", "_power_scalar", True)

@@ -136,11 +136,21 @@ class BaseSparseNDArray(NDArray):
         return out
 
     def copyto(self, other):
+        from ..context import Context
         if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
             other._shape_ = self._shape_
             other._aux = dict(self._get_aux())
             NDArray._data.fset(other, None)
             return other
+        if isinstance(other, Context):
+            # device move stays sparse: transfer only the aux fields
+            import jax
+            dev = other.jax_device()
+            out = self.copy()
+            out._ctx = other
+            out._aux = {k: jax.device_put(v, dev)
+                        for k, v in out._aux.items()}
+            return out
         if isinstance(other, NDArray):
             other._set_data(self._data)
             return other

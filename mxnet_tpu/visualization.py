@@ -6,10 +6,25 @@ import json
 
 
 def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Print a layer-by-layer summary of a Symbol graph."""
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    heads = {t[0] for t in conf.get("heads", [])}
+    """Layer-by-layer summary with output shapes and per-layer/total param
+    counts (reference visualization.py print_summary). ``shape`` maps input
+    names to shapes; when given, shapes are inferred through the graph."""
+    out_shapes = {}
+    arg_shape_map = {}
+    if shape:
+        internals = symbol.get_internals()
+        try:
+            _, outs, _ = internals.infer_shape(**shape)
+            for name, s in zip(internals.list_outputs(), outs):
+                out_shapes[name] = s
+            arg_shapes, _, _ = symbol.infer_shape(**shape)
+            arg_shape_map = dict(zip(symbol.list_arguments(), arg_shapes))
+        except Exception as exc:
+            import warnings
+            warnings.warn("print_summary: shape inference failed (%s); "
+                          "printing without shapes/param counts" % exc)
+            arg_shape_map = {}
+            out_shapes = {}
 
     def print_row(fields, positions_):
         line = ""
@@ -19,18 +34,40 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
             line += " " * (positions_[i] - len(line))
         print(line)
 
+    def nparams(s):
+        n = 1
+        for d in s:
+            n *= d
+        return n
+
     positions_abs = [int(line_length * p) for p in positions]
     print("_" * line_length)
     print_row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"],
               positions_abs)
     print("=" * line_length)
-    for i, node in enumerate(nodes):
-        if node["op"] == "null" and i not in heads:
+    total = 0
+    counted = set()
+    for node in symbol._topo_nodes():
+        if node.op is None:
             continue
-        pred = [nodes[e[0]]["name"] for e in node.get("inputs", [])]
-        print_row(["%s (%s)" % (node["name"], node["op"]), "", "",
-                   ",".join(pred[:2])], positions_abs)
+        pred = [inp.name for (inp, _idx) in node.inputs]
+        # params owned by this layer: its variable inputs, counted once,
+        # excluding the user-provided data inputs
+        layer_params = 0
+        for (inp, _idx) in node.inputs:
+            if inp.op is None and inp.name not in (shape or {}) \
+                    and inp.name not in counted \
+                    and inp.name in arg_shape_map:
+                layer_params += nparams(arg_shape_map[inp.name])
+                counted.add(inp.name)
+        total += layer_params
+        oshape = out_shapes.get("%s_output" % node.name,
+                                out_shapes.get(node.name, ""))
+        print_row(["%s (%s)" % (node.name, node.op), str(oshape),
+                   str(layer_params), ",".join(pred[:2])], positions_abs)
     print("=" * line_length)
+    print("Total params: %d" % total)
+    print("_" * line_length)
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,

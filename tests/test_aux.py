@@ -1,0 +1,102 @@
+"""Auxiliary-subsystem tests: exception surfacing, profiler, monitor,
+visualization (model: reference tests/python/unittest/test_exc_handling.py,
+test_profiler.py; SURVEY §5)."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------- exceptions
+
+def test_op_error_raises_at_call():
+    """Eager dispatch surfaces invalid-argument errors immediately (the
+    WaitForVar rethrow analog collapses to call-site raise under eager XLA)."""
+    with pytest.raises(Exception):
+        nd.dot(nd.zeros((2, 3)), nd.zeros((4, 5)))  # shape mismatch
+
+
+def test_unknown_op_raises_mxnet_error():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ndarray import invoke
+    with pytest.raises(MXNetError):
+        invoke("NoSuchOperator", [], {})
+
+
+def test_executor_bad_shape_raises():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4)
+    with pytest.raises(Exception):
+        ex = out.simple_bind(mx.cpu(), data=(2, 3))
+        ex.forward(is_train=False, data=nd.zeros((2, 999)))
+        ex.outputs[0].wait_to_read()
+
+
+def test_exception_propagates_from_recorded_backward():
+    from mxnet_tpu import autograd
+    x = nd.array(np.ones((2, 2)))
+    x.attach_grad()
+    with pytest.raises(Exception):
+        with autograd.record():
+            y = nd.dot(x, nd.zeros((3, 3)))
+        y.backward()
+
+
+# ------------------------------------------------------------------ profiler
+
+def test_profiler_aggregate_and_objects(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.set_config(filename=fname, profile_all=True)
+    mx.profiler.set_state("run")
+    dom = mx.profiler.Domain("testdomain")
+    task = dom.new_task("mytask")
+    task.start()
+    (nd.ones((64, 64)) @ nd.ones((64, 64))).wait_to_read()
+    task.stop()
+    counter = dom.new_counter("mycounter", 3)
+    counter.increment(2)
+    marker = dom.new_marker("hello")
+    marker.mark()
+    mx.profiler.set_state("stop")
+    out = mx.profiler.dumps()
+    assert isinstance(out, str)
+    mx.profiler.dump()
+    assert os.path.exists(fname)
+    import json
+    events = json.load(open(fname))
+    names = {e.get("name") for e in events.get("traceEvents", [])}
+    assert any("mytask" in str(n) for n in names)
+
+
+# ------------------------------------------------------------------- monitor
+
+def test_monitor_taps_outputs():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fc_mon")
+    ex = out.simple_bind(mx.cpu(), data=(2, 3))
+    mon = mx.monitor.Monitor(interval=1, pattern=".*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=nd.ones((2, 3)))
+    stats = mon.toc()
+    assert stats, "monitor collected nothing"
+    names = [n for (_, n, _) in stats]
+    assert any("fc_mon" in n for n in names)
+
+
+# -------------------------------------------------------------- visualization
+
+def test_print_summary_counts_params(capsys):
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    out = mx.sym.Activation(out, act_type="relu")
+    out = mx.sym.FullyConnected(out, num_hidden=2, name="fc2")
+    mx.viz.print_summary(out, shape={"data": (1, 4)})
+    printed = capsys.readouterr().out
+    assert "fc1" in printed and "fc2" in printed
+    # fc1: 4*8+8 = 40; fc2: 8*2+2 = 18 -> total 58
+    assert "58" in printed

@@ -163,3 +163,76 @@ def test_python_loss_module_custom_grad():
               is_train=True)
     m.backward()
     assert (m.get_input_grads()[0].asnumpy() == 7).all()
+
+
+def test_module_multi_device_training_matches_single():
+    """Module bound on 4 devices with a local kvstore takes the same SGD
+    trajectory as the single-device module (DataParallelExecutorGroup +
+    CommDevice reduce semantics, tests/nightly/multi_lenet.py analog)."""
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(7)
+    x = rng.normal(0, 1, (64, 10)).astype(np.float32)
+    y = rng.randint(0, 3, (64,)).astype(np.float32)
+
+    def make_mod(ctxs):
+        data = mx.sym.var("data")
+        out = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        out = mx.sym.Activation(out, act_type="relu")
+        out = mx.sym.FullyConnected(out, num_hidden=3, name="fc2")
+        out = mx.sym.SoftmaxOutput(out, name="softmax")
+        mod = mx.mod.Module(out, context=ctxs)
+        it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=1),
+                        force_init=True)
+        return mod, it
+
+    mod1, it1 = make_mod(mx.cpu())
+    mod4, it4 = make_mod([mx.cpu(i) for i in range(4)])
+    # identical starting params BEFORE init_optimizer (the kvstore snapshots
+    # weights at init; set_params afterwards would desync, as the reference)
+    p1, _ = mod1.get_params()
+    mod4.set_params(p1, {}, force_init=True)
+    for m in (mod1, mod4):
+        m.init_optimizer(kvstore="local", optimizer="sgd",
+                         optimizer_params=(("learning_rate", 0.1),))
+
+    for _ in range(3):
+        it1.reset(); it4.reset()
+        for b1, b4 in zip(it1, it4):
+            mod1.forward_backward(b1); mod1.update()
+            mod4.forward_backward(b4); mod4.update()
+    f1, _ = mod1.get_params()
+    f4, _ = mod4.get_params()
+    for k in f1:
+        np.testing.assert_allclose(f1[k].asnumpy(), f4[k].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_module_multi_device_scores():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (32, 6)).astype(np.float32)
+    w = rng.normal(0, 1, (6, 4)).astype(np.float32)
+    y = x.dot(w).argmax(1).astype(np.float32)
+    data = mx.sym.var("data")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4), name="softmax")
+    mod = mx.mod.Module(out, context=[mx.cpu(0), mx.cpu(1)])
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params=(("learning_rate", 0.5),))
+    for _ in range(40):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9, "multi-device training failed to fit: acc=%s" % acc

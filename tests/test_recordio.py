@@ -223,3 +223,24 @@ def test_native_iter_rejects_unsupported_kwargs(tmp_path):
                                batch_size=1, rand_crop=True)
     from mxnet_tpu.io.native_image_iter import NativeImageRecordIter
     assert not isinstance(it, NativeImageRecordIter)
+
+
+def test_native_pipeline_raises_on_truncated_partial_batch(tmp_path):
+    """Corrupt frame + partial final batch must still raise (the epoch lost
+    its tail — 'fail loudly' covers the mid-batch ending too)."""
+    pytest.importorskip("PIL")
+    from mxnet_tpu._native import get_lib
+    if get_lib() is None or not hasattr(get_lib(), "mxtpu_pipe_open"):
+        pytest.skip("native pipeline unavailable")
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    path = str(tmp_path / "trunc.rec")
+    _pack_jpeg_rec(path, 6)
+    with open(path, "r+b") as f:
+        f.seek(-40, 2)
+        f.truncate()   # cut mid-frame
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=4, backend="native")
+    with pytest.raises(MXNetError):
+        for _ in it:
+            pass

@@ -366,3 +366,50 @@ def test_sparse_zero_grad_stays_sparse():
     p.zero_grad()
     g = p.grad()
     assert g.nnz == 0 and g._data_buf is None
+
+
+def test_gluon_trainer_sparse_embedding_end_to_end():
+    """SparseEmbedding trains through gluon Trainer: row-sparse grads reach
+    the optimizer's lazy kernels; embedding regression converges."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+    import mxnet_tpu as mx
+
+    vocab, dim = 50, 4
+    rng = np.random.RandomState(0)
+    target = rng.normal(0, 1, (vocab, dim)).astype(np.float32)
+    layer = SparseEmbedding(vocab, dim)
+    layer.initialize(mx.init.Normal(0.1))
+    trainer = mx.gluon.Trainer(layer.collect_params(), "sgd",
+                               {"learning_rate": 0.05})
+    losses = []
+    for step in range(120):
+        idx_np = rng.randint(0, vocab, (16,))
+        idx = nd.array(idx_np, dtype="int32")
+        tgt = nd.array(target[idx_np])
+        with autograd.record():
+            emb = layer(idx)
+            loss = ((emb - tgt) ** 2).sum()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+
+
+def test_sparse_reduce_across_devices():
+    """Multi-device row_sparse reduce gathers aux fields (no densify, no
+    mixed-placement crash)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    kv = mx.kvstore.create("local")
+    shape = (10_000, 4)
+    g0 = sparse.row_sparse_array(
+        (np.ones((1, 4), np.float32), np.array([5])), shape=shape)
+    g1 = sparse.row_sparse_array(
+        (np.ones((1, 4), np.float32), np.array([5])),
+        shape=shape).as_in_context(mx.cpu(1))
+    assert g1.context.device_id == 1 and g1._data_buf is None
+    out = kv._reduce([g0, g1])
+    assert out.stype == "row_sparse" and out._data_buf is None
+    assert_almost_equal(out.data.asnumpy(), np.full((1, 4), 2.0))

@@ -1,0 +1,69 @@
+"""Measure kvstore allreduce bandwidth (reference: tools/bandwidth/
+measure.py — the GB/s of gradient aggregation, BASELINE.json metric 2).
+
+Single process: measures the tpu_sync jitted add-tree over N simulated
+device buffers (one chip: HBM-bound adds).  Under a multi-device mesh
+(virtual CPU or a pod slice) the same reduce compiles to XLA collectives —
+run with XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+to exercise the collective path without hardware.
+
+Usage: python tools/bandwidth.py [--size-mb 64] [--copies 4] [--iters 20]
+Prints one JSON line {"metric", "value", "unit"}.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=64.0,
+                    help="per-buffer size in MiB (fp32)")
+    ap.add_argument("--copies", type=int, default=4,
+                    help="number of per-device gradients to reduce")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--kv", default="tpu_sync")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    n = int(args.size_mb * (1 << 20) / 4)
+    kv = mx.kvstore.create(args.kv)
+    rng = np.random.RandomState(0)
+    bufs = [nd.array(rng.uniform(-1, 1, n).astype(np.float32))
+            for _ in range(args.copies)]
+    kv.init("0", bufs[0])
+
+    out = nd.zeros((n,))
+    # warmup (compile)
+    kv.push("0", bufs)
+    kv.pull("0", out=out)
+    out.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        kv.push("0", bufs)
+        kv.pull("0", out=out)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    # bytes reduced per iteration: copies buffers in + one out
+    gbytes = args.copies * n * 4 * args.iters / dt / 1e9
+    print(json.dumps({
+        "metric": "kvstore_%s_allreduce" % args.kv,
+        "value": round(gbytes, 2),
+        "unit": "GB/s",
+        "size_mb": args.size_mb,
+        "copies": args.copies,
+    }))
+
+
+if __name__ == "__main__":
+    main()
