@@ -324,7 +324,14 @@ class NDArray:
     def __rtruediv__(self, o): return self._binop(o, "broadcast_div", "_div_scalar", True)
     def __mod__(self, o):  return self._binop(o, "broadcast_mod", "_mod_scalar")
     def __matmul__(self, o):
+        if not isinstance(o, NDArray):
+            o = array(_np.asarray(o), ctx=self._ctx)
         return invoke("dot", [self, o], {})
+
+    def __rmatmul__(self, o):
+        if not isinstance(o, NDArray):
+            o = array(_np.asarray(o), ctx=self._ctx)
+        return invoke("dot", [o, self], {})
     def __rmod__(self, o): return self._binop(o, "broadcast_mod", "_mod_scalar", True)
     def __pow__(self, o):  return self._binop(o, "broadcast_power", "_power_scalar")
     def __rpow__(self, o): return self._binop(o, "broadcast_power", "_power_scalar", True)

@@ -17,8 +17,11 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
             _, outs, _ = internals.infer_shape(**shape)
             for name, s in zip(internals.list_outputs(), outs):
                 out_shapes[name] = s
-            arg_shapes, _, _ = symbol.infer_shape(**shape)
-            arg_shape_map = dict(zip(symbol.list_arguments(), arg_shapes))
+            # variable nodes appear among the internals outputs, so one
+            # inference pass also yields every argument's shape
+            arg_shape_map = {n: out_shapes[n]
+                             for n in symbol.list_arguments()
+                             if n in out_shapes}
         except Exception as exc:
             import warnings
             warnings.warn("print_summary: shape inference failed (%s); "

@@ -1,0 +1,100 @@
+"""Initializer and RNG tests (model: reference test_init.py, test_random.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# --------------------------------------------------------------- initializers
+
+def _init_one(init, shape, name="test_weight"):
+    from mxnet_tpu.initializer import InitDesc
+    arr = nd.zeros(shape)
+    init(InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert (_init_one(mx.init.Zero(), (3, 4)) == 0).all()
+    assert (_init_one(mx.init.One(), (3, 4)) == 1).all()
+    assert (_init_one(mx.init.Constant(2.5), (3, 4)) == 2.5).all()
+
+
+def test_uniform_normal_ranges():
+    u = _init_one(mx.init.Uniform(0.3), (200, 50))
+    assert np.abs(u).max() <= 0.3 and np.abs(u).std() > 0
+    n = _init_one(mx.init.Normal(0.1), (200, 50))
+    assert abs(n.std() - 0.1) < 0.02
+
+
+def test_xavier_magnitude():
+    w = _init_one(mx.init.Xavier(factor_type="avg", magnitude=3), (64, 32))
+    bound = np.sqrt(3.0 * 2 / (64 + 32))
+    assert np.abs(w).max() <= bound + 1e-6
+
+
+def test_orthogonal_is_orthogonal():
+    # default scale is sqrt(2): W W^T = scale^2 I
+    w = _init_one(mx.init.Orthogonal(scale=1.0), (16, 16))
+    np.testing.assert_allclose(w @ w.T, np.eye(16), atol=1e-4)
+
+
+def test_bilinear_upsampling_kernel():
+    w = _init_one(mx.init.Bilinear(), (1, 1, 4, 4), name="upsampling_weight")
+    # bilinear kernel is symmetric and positive
+    k = w[0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)
+    assert (k > 0).all()
+
+
+def test_mixed_initializer_patterns():
+    from mxnet_tpu.initializer import InitDesc
+    init = mx.init.Mixed([".*bias", ".*"],
+                         [mx.init.Zero(), mx.init.One()])
+    b = nd.zeros((4,)); init(InitDesc("fc_bias"), b)
+    w = nd.zeros((4,)); init(InitDesc("fc_weight"), w)
+    assert (b.asnumpy() == 0).all() and (w.asnumpy() == 1).all()
+
+
+# ------------------------------------------------------------------------ rng
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, (10,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(0, 1, (10,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.random.uniform(0, 1, (10,)).asnumpy()
+    assert not np.array_equal(b, c)   # stream advances
+
+
+def test_random_distributions_statistics():
+    mx.random.seed(0)
+    n = 20000
+    u = nd.random.uniform(-2, 2, (n,)).asnumpy()
+    assert abs(u.mean()) < 0.05 and u.min() >= -2 and u.max() <= 2
+    g = nd.random.normal(1.0, 2.0, (n,)).asnumpy()
+    assert abs(g.mean() - 1.0) < 0.06 and abs(g.std() - 2.0) < 0.06
+    p = nd.random.poisson(3.0, (n,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.1
+    e = nd.random.exponential(2.0, (n,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.1
+    gm = nd.random.gamma(2.0, 2.0, (n,)).asnumpy()
+    assert abs(gm.mean() - 4.0) < 0.15
+
+
+def test_multinomial_and_shuffle():
+    mx.random.seed(1)
+    probs = nd.array(np.array([[0.0, 0.0, 1.0]], dtype=np.float32))
+    s = nd.random.multinomial(probs, shape=8).asnumpy()
+    assert (s == 2).all()
+    arr = nd.arange(20)
+    sh = nd.random.shuffle(arr).asnumpy()
+    assert sorted(sh.tolist()) == list(range(20))
+    assert not np.array_equal(sh, np.arange(20))
+
+
+def test_randint_bounds():
+    r = nd.random.randint(5, 10, (1000,)).asnumpy()
+    assert r.min() >= 5 and r.max() < 10

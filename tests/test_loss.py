@@ -1,0 +1,94 @@
+"""gluon.loss tests against hand-computed values (model: reference
+tests/python/unittest/test_loss.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import loss as gloss
+
+
+def test_l2_l1_loss():
+    pred = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    label = nd.array(np.array([[1.5, 2.0], [2.0, 4.0]], np.float32))
+    l2 = gloss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l2, [0.5 * 0.25 / 2, 0.5 * 1.0 / 2], rtol=1e-5)
+    l1 = gloss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l1, [0.25, 0.5], rtol=1e-5)
+
+
+def test_softmax_ce_loss():
+    pred = nd.array(np.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]], np.float32))
+    label = nd.array(np.array([0, 1], np.float32))
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    assert (l < 1e-3).all()
+    wrong = gloss.SoftmaxCrossEntropyLoss()(pred, nd.array([1.0, 0.0])).asnumpy()
+    assert (wrong > 5).all()
+
+
+def test_sigmoid_bce_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 2, (4, 5)).astype(np.float32)
+    y = (rng.rand(4, 5) > 0.5).astype(np.float32)
+    out = gloss.SigmoidBinaryCrossEntropyLoss()(nd.array(x), nd.array(y)).asnumpy()
+    p = 1 / (1 + np.exp(-x))
+    ref = -(y * np.log(p + 1e-12) + (1 - y) * np.log(1 - p + 1e-12)).mean(axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_kl_div_loss():
+    p = np.array([[0.2, 0.3, 0.5]], np.float32)
+    q = np.array([[0.3, 0.3, 0.4]], np.float32)
+    out = gloss.KLDivLoss(from_logits=False)(
+        nd.array(np.log(q)), nd.array(p)).asnumpy()
+    ref = (p * (np.log(p) - np.log(q))).mean(axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_huber_hinge_logistic():
+    pred = nd.array(np.array([[0.5], [3.0]], np.float32))
+    label = nd.array(np.array([[0.0], [0.0]], np.float32))
+    h = gloss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    np.testing.assert_allclose(h, [0.5 * 0.25, 3.0 - 0.5], rtol=1e-5)
+    hinge = gloss.HingeLoss()(nd.array(np.array([[0.4], [2.0]], np.float32)),
+                              nd.array(np.array([[1.0], [1.0]], np.float32))).asnumpy()
+    np.testing.assert_allclose(hinge, [0.6, 0.0], rtol=1e-5)
+    logi = gloss.LogisticLoss()(nd.array(np.array([[0.0]], np.float32)),
+                                nd.array(np.array([[1.0]], np.float32))).asnumpy()
+    np.testing.assert_allclose(logi, [np.log(2)], rtol=1e-5)
+
+
+def test_triplet_loss_margin():
+    a = nd.array(np.zeros((2, 3), np.float32))
+    pos = nd.array(np.zeros((2, 3), np.float32))
+    neg = nd.array(np.ones((2, 3), np.float32) * 10)
+    l = gloss.TripletLoss(margin=1.0)(a, pos, neg).asnumpy()
+    np.testing.assert_allclose(l, [0.0, 0.0])  # easily satisfied
+    l2 = gloss.TripletLoss(margin=1.0)(a, neg, pos).asnumpy()
+    assert (l2 > 0).all()
+
+
+def test_loss_weight_and_sample_weight():
+    pred = nd.array(np.ones((2, 2), np.float32))
+    label = nd.array(np.zeros((2, 2), np.float32))
+    base = gloss.L2Loss()(pred, label).asnumpy()
+    weighted = gloss.L2Loss(weight=2.0)(pred, label).asnumpy()
+    np.testing.assert_allclose(weighted, base * 2, rtol=1e-6)
+    sw = nd.array(np.array([[1.0], [0.0]], np.float32))
+    masked = gloss.L2Loss()(pred, label, sw).asnumpy()
+    assert masked[1] == 0 and masked[0] == base[0]
+
+
+def test_ctc_loss_runs_and_grads():
+    from mxnet_tpu import autograd
+    T, B, C = 10, 2, 5
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.uniform(-1, 1, (T, B, C)).astype(np.float32))
+    label = nd.array(np.array([[1, 2], [2, 3]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        l = gloss.CTCLoss(layout="TNC")(data, label)
+        l.sum().backward()
+    assert np.isfinite(l.asnumpy()).all()
+    assert np.isfinite(data.grad.asnumpy()).all()
+    assert np.abs(data.grad.asnumpy()).sum() > 0
