@@ -28,7 +28,11 @@ IMG = int(os.environ.get("BENCH_IMG", "224"))
 # (docs/faq/perf.md:150-180: 1076.81 img/s fp32 / 2085.51 fp16 on V100)
 MODE = os.environ.get("BENCH_MODE", "train")
 if MODE not in ("train", "inference"):
-    sys.exit("unknown BENCH_MODE=%r (train|inference)" % MODE)
+    # still honor the one-JSON-line-on-stdout contract
+    print(json.dumps({"metric": "invalid_bench_mode", "value": None,
+                      "unit": None, "vs_baseline": None,
+                      "error": "unknown BENCH_MODE=%r (train|inference)" % MODE}))
+    sys.exit(1)
 BASELINE_IMGS_PER_SEC = 298.51 if MODE == "train" else 2085.51
 # the baseline ratio is only meaningful for the headline config
 IS_HEADLINE = (BATCH == 32 and IMG == 224)

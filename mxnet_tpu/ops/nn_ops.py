@@ -61,17 +61,25 @@ def _fully_connected(attrs, data, weight, bias=None):
 # Convolution / Deconvolution
 # ---------------------------------------------------------------------------
 
-def _conv_dims(ndim):
-    if ndim == 3:
-        return ("NCW", "OIW", "NCW")
-    if ndim == 4:
-        return ("NCHW", "OIHW", "NCHW")
-    return ("NCDHW", "OIDHW", "NCDHW")
+def _conv_dims(ndim, layout=None):
+    """Dimension-number strings for the requested data layout.
+
+    Channel-first is the reference default; channel-last (NWC/NHWC/NDHWC,
+    convolution.cc's layout parameter) is the TPU-preferred layout — with it
+    XLA needs no transposes at the graph edges.  MXNet's channel-last weight
+    layout is (O, spatial..., I)."""
+    spatial = {3: "W", 4: "HW", 5: "DHW"}[ndim]
+    if layout is None or layout.startswith("NC"):
+        s = "NC" + spatial
+        return (s, "OI" + spatial, s)
+    s = "N" + spatial + "C"
+    return (s, "O" + spatial + "I", s)
 
 
 @register("Convolution")
 def _convolution(attrs, data, weight, bias=None):
-    """N-D convolution, NCHW/OIHW API layout (src/operator/nn/convolution.cc)."""
+    """N-D convolution (src/operator/nn/convolution.cc), layout attr selects
+    channel-first (default) or channel-last data/weight layouts."""
     lax = _lax()
     nd = data.ndim - 2
     kernel = _pair(attrs["kernel"], nd)
@@ -79,7 +87,10 @@ def _convolution(attrs, data, weight, bias=None):
     pad = _pair(attrs.get("pad", (0,) * nd), nd)
     dilate = _pair(attrs.get("dilate", (1,) * nd), nd)
     num_group = int(attrs.get("num_group", 1))
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
+    layout = attrs.get("layout")
+    channel_last = layout is not None and not layout.startswith("NC")
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dims(data.ndim, layout))
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -90,7 +101,9 @@ def _convolution(attrs, data, weight, bias=None):
         feature_group_count=num_group,
         preferred_element_type=None)
     if not attrs.get("no_bias", False) and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)) if channel_last \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -105,6 +118,10 @@ def _deconvolution(attrs, data, weight, bias=None):
     pad = _pair(attrs.get("pad", (0,) * nd), nd)
     adj = _pair(attrs.get("adj", (0,) * nd), nd)
     num_group = int(attrs.get("num_group", 1))
+    layout = attrs.get("layout")
+    if layout is not None and not layout.startswith("NC"):
+        raise ValueError("Deconvolution supports channel-first layouts only; "
+                         "got layout=%r" % (layout,))
     # weight layout (in_c, out_c/g, *kernel) per MXNet deconvolution
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
     pads = [(k - 1 - p + a, k - 1 - p + a) for k, p, a in zip(kernel, pad, adj)]
@@ -138,14 +155,18 @@ def _deconvolution(attrs, data, weight, bias=None):
 
 @register("Pooling")
 def _pooling(attrs, data):
-    """max/avg/sum pooling via lax.reduce_window (src/operator/nn/pooling.cc)."""
+    """max/avg/sum pooling via lax.reduce_window (src/operator/nn/pooling.cc);
+    layout attr selects channel-first (default) or channel-last windows."""
     lax = _lax()
     jnp = _jnp()
     nd = data.ndim - 2
     pool_type = attrs.get("pool_type", "max")
+    layout = attrs.get("layout")
+    channel_last = layout is not None and not layout.startswith("NC")
     global_pool = bool(attrs.get("global_pool", False))
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(range(1, data.ndim - 1)) if channel_last \
+            else tuple(range(2, data.ndim))
         if pool_type == "max":
             out = jnp.max(data, axis=axes, keepdims=True)
         elif pool_type in ("avg", "sum"):
@@ -158,19 +179,22 @@ def _pooling(attrs, data):
     stride = _pair(attrs.get("stride", (1,) * nd), nd)
     pad = _pair(attrs.get("pad", (0,) * nd), nd)
     pooling_convention = attrs.get("pooling_convention", "valid")
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    window = ((1,) + kernel + (1,)) if channel_last else ((1, 1) + kernel)
+    strides = ((1,) + stride + (1,)) if channel_last else ((1, 1) + stride)
+    spatial0 = 1 if channel_last else 2
     if pooling_convention == "full":
         # ceil-mode: pad right edge so ceil((x+2p-k)/s)+1 windows fit
         extra = []
         for i in range(nd):
-            x = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            x = data.shape[spatial0 + i] + 2 * pad[i] - kernel[i]
             rem = x % stride[i]
             e = 0 if rem == 0 else stride[i] - rem
             extra.append(e)
-        pads = [(0, 0), (0, 0)] + [(pad[i], pad[i] + extra[i]) for i in range(nd)]
+        spads = [(pad[i], pad[i] + extra[i]) for i in range(nd)]
     else:
-        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+        spads = [(p, p) for p in pad]
+    pads = ([(0, 0)] + spads + [(0, 0)]) if channel_last \
+        else ([(0, 0), (0, 0)] + spads)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, pads)

@@ -454,10 +454,12 @@ def _topk(attrs, x):
     if ret_typ == "both":
         return vals, idxs
     if ret_typ == "mask":
-        mask = jnp.zeros(xs.shape, dtype=x.dtype)
-        mask = mask.at[..., :1].set(0)  # placeholder; mask built from idxs below
-        oh = jax.nn.one_hot(idxs.astype(jnp.int32) if False else 0, 1)
-        raise NotImplementedError("topk ret_typ=mask")
+        # 0/1 mask of the selected entries, original shape: one-hot the
+        # top-k indices along the last (moved) axis and sum over k
+        idxs_last = jnp.moveaxis(idxs, axis, -1).astype(jnp.int32)
+        oh = jax.nn.one_hot(idxs_last, xs.shape[-1], dtype=x.dtype)
+        mask = jnp.clip(oh.sum(axis=-2), 0, 1)
+        return jnp.moveaxis(mask, -1, axis)
     return idxs
 
 

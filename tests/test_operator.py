@@ -310,3 +310,43 @@ def test_signum_wd_inside_momentum():
     expect = w_np + lr * np.sign(mom)
     np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
     np.testing.assert_allclose(state.asnumpy(), mom, rtol=1e-5)
+
+
+def test_topk_mask():
+    x = nd.array(np.array([[1.0, 5.0, 3.0, 2.0],
+                           [9.0, 0.0, 4.0, 7.0]], np.float32))
+    m = nd.topk(x, k=2, ret_typ="mask").asnumpy()
+    np.testing.assert_array_equal(m, [[0, 1, 1, 0], [1, 0, 0, 1]])
+    # along axis 0
+    m0 = nd.topk(x, axis=0, k=1, ret_typ="mask").asnumpy()
+    np.testing.assert_array_equal(m0, [[0, 1, 0, 0], [1, 0, 1, 1]])
+
+
+def test_conv_pool_nhwc_layout_matches_nchw():
+    """layout='NHWC' conv/pool equal the channel-first results — the
+    TPU-preferred layout path (convolution.cc layout parameter)."""
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (2, 8, 8, 3)).astype(np.float32)     # NHWC
+    w = rng.normal(0, 1, (4, 3, 3, 3)).astype(np.float32)     # OHWI
+    b = rng.normal(0, 1, (4,)).astype(np.float32)
+    out_cl = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                            kernel=(3, 3), pad=(1, 1), num_filter=4,
+                            layout="NHWC").asnumpy()
+    x_cf = x.transpose(0, 3, 1, 2)
+    w_cf = w.transpose(0, 3, 1, 2)
+    out_cf = nd.Convolution(nd.array(x_cf), nd.array(w_cf), nd.array(b),
+                            kernel=(3, 3), pad=(1, 1), num_filter=4).asnumpy()
+    np.testing.assert_allclose(out_cl.transpose(0, 3, 1, 2), out_cf,
+                               rtol=1e-4, atol=1e-4)
+
+    p_cl = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                      pool_type="max", layout="NHWC").asnumpy()
+    p_cf = nd.Pooling(nd.array(x_cf), kernel=(2, 2), stride=(2, 2),
+                      pool_type="max").asnumpy()
+    np.testing.assert_allclose(p_cl.transpose(0, 3, 1, 2), p_cf, rtol=1e-5)
+
+    g_cl = nd.Pooling(nd.array(x), global_pool=True, kernel=(1, 1),
+                      pool_type="avg", layout="NHWC").asnumpy()
+    g_cf = nd.Pooling(nd.array(x_cf), global_pool=True, kernel=(1, 1),
+                      pool_type="avg").asnumpy()
+    np.testing.assert_allclose(g_cl.transpose(0, 3, 1, 2), g_cf, rtol=1e-5)
