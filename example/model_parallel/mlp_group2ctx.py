@@ -1,0 +1,73 @@
+"""Model parallelism via ctx_group placement (reference:
+example/model-parallel/matrix_factorization/, docs/faq/model_parallel_lstm.md).
+
+Layers are assigned to device groups with AttrScope(ctx_group=...) and
+simple_bind's group2ctx maps each group to a device — the TPU-native
+AssignContext analog places each subgraph's arrays on its device and XLA
+inserts the cross-device transfers (the _CrossDeviceCopy analog).
+
+Run (8 virtual devices):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python example/model_parallel/mlp_group2ctx.py
+"""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+logging.basicConfig(level=logging.INFO)
+
+
+def build_net():
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    # first half of the network on device group "front"
+    with mx.AttrScope(ctx_group="front"):
+        x = sym.FullyConnected(data, name="fc1", num_hidden=32)
+        x = sym.Activation(x, act_type="relu", name="relu1")
+        x = sym.FullyConnected(x, name="fc2", num_hidden=32)
+        x = sym.Activation(x, act_type="relu", name="relu2")
+    # classifier head on device group "back"
+    with mx.AttrScope(ctx_group="back"):
+        x = sym.FullyConnected(x, name="fc3", num_hidden=10)
+        out = sym.SoftmaxOutput(x, label, name="softmax")
+    return out
+
+
+def main():
+    group2ctx = {"front": mx.cpu(0), "back": mx.cpu(1)}
+    net = build_net()
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (256, 16)).astype(np.float32)
+    w = rng.normal(0, 1, (16, 10)).astype(np.float32)
+    y = x.dot(w).argmax(1).astype(np.float32)
+
+    mod = mx.mod.Module(net, context=mx.cpu(0), group2ctxs=[group2ctx])
+    it = mx.io.NDArrayIter(x, y, batch_size=64, label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+    metric = mx.metric.Accuracy()
+    for epoch in range(30):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        logging.info("Epoch %d %s", epoch, metric.get())
+    name, acc = metric.get()
+    print("final accuracy: %.3f" % acc)
+    assert acc > 0.85, "model-parallel MLP failed to fit"
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,31 @@ Architecture (see SURVEY.md for the full mapping):
 """
 __version__ = "0.1.0"
 
+
+def _honor_jax_platforms_env():
+    """Make JAX_PLATFORMS authoritative before any backend init.
+
+    This image's axon site hook initializes the TPU plugin even when
+    JAX_PLATFORMS=cpu is exported; only the jax config update stops it —
+    and when the TPU relay is down that init BLOCKS FOREVER, hanging any
+    script that merely imports jax (the round-1 driver failure).  Applying
+    the env var through the config here makes every mxnet_tpu entry point
+    (examples, tools, user scripts) safe to run CPU-only."""
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+        if jax.config.jax_platforms:
+            return  # an explicit earlier config (e.g. conftest) wins
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass  # backends already initialized
+
+
+_honor_jax_platforms_env()
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray
