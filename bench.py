@@ -9,7 +9,16 @@ The measured step is the full compiled training iteration — forward + backward
 train_imagenet.py's per-batch forward_backward+update), bf16 compute with fp32
 params (TPU-native dtype policy; the reference's fp16 path is the analog).
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+supporting keys ("mfu", "device", "layout", "step_flops").
+
+Harness design (round-3 rework): the axon TPU relay is reached through a
+tunnel that is sometimes down, and a down relay makes backend init HANG in
+native code rather than error.  So the watchdog (a) pre-flight-probes the
+backend in a cheap disposable subprocess under a short timeout, looping with
+backoff until the relay answers, and only then (b) commits to a full
+benchmark attempt under a moderate per-attempt timeout, retrying across the
+whole BENCH_BUDGET rather than forfeiting on the first hang.
 """
 import json
 import os
@@ -27,11 +36,19 @@ IMG = int(os.environ.get("BENCH_IMG", "224"))
 # BENCH_MODE=train (default, the driver metric) | inference
 # (docs/faq/perf.md:150-180: 1076.81 img/s fp32 / 2085.51 fp16 on V100)
 MODE = os.environ.get("BENCH_MODE", "train")
+# BENCH_LAYOUT=NCHW (reference layout) | NHWC (TPU-native channels-last);
+# settles SURVEY §7(f) with data when run both ways on-chip
+LAYOUT = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
 if MODE not in ("train", "inference"):
     # still honor the one-JSON-line-on-stdout contract
     print(json.dumps({"metric": "invalid_bench_mode", "value": None,
                       "unit": None, "vs_baseline": None,
                       "error": "unknown BENCH_MODE=%r (train|inference)" % MODE}))
+    sys.exit(1)
+if LAYOUT not in ("NCHW", "NHWC"):
+    print(json.dumps({"metric": "invalid_bench_layout", "value": None,
+                      "unit": None, "vs_baseline": None,
+                      "error": "unknown BENCH_LAYOUT=%r (NCHW|NHWC)" % LAYOUT}))
     sys.exit(1)
 BASELINE_IMGS_PER_SEC = 298.51 if MODE == "train" else 2085.51
 # the baseline ratio is only meaningful for the headline config
@@ -39,6 +56,26 @@ IS_HEADLINE = (BATCH == 32 and IMG == 224)
 _KIND = "train" if MODE == "train" else "infer"
 METRIC = ("resnet50_%s_imgs_per_sec_bs32" % _KIND if IS_HEADLINE
           else "resnet50_%s_imgs_per_sec_bs%d_img%d" % (_KIND, BATCH, IMG))
+
+# peak bf16 matmul throughput per chip, by device_kind substring
+# (public spec-sheet numbers; used only to report MFU alongside img/s)
+_PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def _peak_flops(device_kind):
+    kind = (device_kind or "").lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
 
 
 def _init_backend():
@@ -56,19 +93,34 @@ def _init_backend():
     return devs
 
 
+def _step_flops(compiled):
+    """FLOPs of one compiled step from XLA's own cost model (None if the
+    backend doesn't expose it)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    flops = cost.get("flops") if hasattr(cost, "get") else None
+    return float(flops) if flops else None
+
+
 def main():
     import jax
-    _init_backend()
+    devs = _init_backend()
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.gluon.block import functional_call, param_values
     from mxnet_tpu import nd
 
+    device_kind = getattr(devs[0], "device_kind", devs[0].platform)
     dtype = jnp.bfloat16
-    net = vision.resnet50_v1(classes=1000)
+    net = vision.resnet50_v1(classes=1000, layout=LAYOUT)
     net.initialize(mx.init.Xavier())
-    net(nd.zeros((1, 3, IMG, IMG)))  # materialize deferred shapes
+    shape = (1, 3, IMG, IMG) if LAYOUT == "NCHW" else (1, IMG, IMG, 3)
+    net(nd.zeros(shape))  # materialize deferred shapes
     params = param_values(net)
 
     aux_names = {n for n, p in net.collect_params().items()
@@ -87,7 +139,6 @@ def main():
     lr = 0.05
     momentum = 0.9
 
-    @jax.jit
     def train_step(train_params, momenta, aux_params, x, y):
         (loss, new_aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             train_params, aux_params, x, y)
@@ -102,103 +153,180 @@ def main():
     aux_params = {n: params[n] for n in params if n in aux_names}
 
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.uniform(-1, 1, (BATCH, 3, IMG, IMG)).astype(np.float32))
+    xshape = (BATCH, 3, IMG, IMG) if LAYOUT == "NCHW" else (BATCH, IMG, IMG, 3)
+    x = jnp.asarray(rng.uniform(-1, 1, xshape).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, BATCH).astype(np.int32))
+
+    def _emit(imgs_per_sec, flops_per_step):
+        mfu = None
+        peak = _peak_flops(device_kind)
+        if flops_per_step and peak:
+            mfu = round(flops_per_step * imgs_per_sec / BATCH / peak, 4)
+        print(json.dumps({
+            "metric": METRIC,
+            "value": round(imgs_per_sec, 2),
+            "unit": "images/sec",
+            "vs_baseline": (round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3)
+                            if IS_HEADLINE else None),
+            "mfu": mfu,
+            "step_flops": flops_per_step,
+            "device": device_kind,
+            "layout": LAYOUT,
+            "mode": MODE,
+        }))
 
     if MODE == "inference":
         # weights AND moving stats in bf16: fp32 stats would promote the
         # activations and break the all-bf16 conv chain
         all_params = {n: v.astype(dtype) for n, v in params.items()}
 
-        @jax.jit
         def infer_step(p, xb):
             outs, _ = functional_call(net, p, xb.astype(dtype), training=False)
             return outs[0]
 
-        infer_step(all_params, x).block_until_ready()
+        compiled = jax.jit(infer_step).lower(all_params, x).compile()
+        compiled(all_params, x).block_until_ready()
         iters = int(os.environ.get("BENCH_ITERS", "50"))
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = infer_step(all_params, x)
+            out = compiled(all_params, x)
         out.block_until_ready()
         dt = time.perf_counter() - t0
-        print(json.dumps({
-            "metric": METRIC,
-            "value": round(BATCH * iters / dt, 2),
-            "unit": "images/sec",
-            "vs_baseline": (round(BATCH * iters / dt / BASELINE_IMGS_PER_SEC, 3)
-                            if IS_HEADLINE else None),
-        }))
+        _emit(BATCH * iters / dt, _step_flops(compiled))
         return
 
-    # compile + warmup
-    train_params, momenta, aux_params, loss = train_step(
+    # AOT-compile the whole training iteration as one XLA module with the
+    # previous step's buffers donated (params/momenta/aux update in place)
+    compiled = jax.jit(train_step, donate_argnums=(0, 1, 2)).lower(
+        train_params, momenta, aux_params, x, y).compile()
+    flops = _step_flops(compiled)
+    # warmup (donation consumes the inputs, so thread the outputs forward)
+    train_params, momenta, aux_params, loss = compiled(
         train_params, momenta, aux_params, x, y)
     loss.block_until_ready()
     for _ in range(2):
-        train_params, momenta, aux_params, loss = train_step(
+        train_params, momenta, aux_params, loss = compiled(
             train_params, momenta, aux_params, x, y)
     loss.block_until_ready()
 
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     t0 = time.perf_counter()
     for _ in range(iters):
-        train_params, momenta, aux_params, loss = train_step(
+        train_params, momenta, aux_params, loss = compiled(
             train_params, momenta, aux_params, x, y)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
-
-    imgs_per_sec = BATCH * iters / dt
-    print(json.dumps({
-        "metric": METRIC,
-        "value": round(imgs_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": (round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3)
-                        if IS_HEADLINE else None),
-    }))
+    _emit(BATCH * iters / dt, flops)
 
 
-def _error_line(msg):
-    return json.dumps({
+def _error_line(msg, **extra):
+    rec = {
         "metric": METRIC,
         "value": None,
         "unit": "images/sec",
         "vs_baseline": None,
         "error": msg,
-    })
+    }
+    rec.update(extra)
+    return json.dumps(rec)
+
+
+_PROBE_SRC = """
+import os, sys
+import jax
+plat = os.environ.get("JAX_PLATFORMS")
+if plat:
+    jax.config.update("jax_platforms", plat)
+devs = jax.devices()
+print("PROBE_OK %s %d" % (devs[0].platform, len(devs)))
+"""
+
+
+def _probe_backend(timeout_s):
+    """Cheap disposable check that backend init returns at all.
+
+    A down axon relay hangs jax.devices() forever inside native code, so the
+    probe must be its own subprocess that the parent can kill.  Returns the
+    platform string, or None if the probe hung/failed."""
+    import subprocess
+    proc = subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in out.splitlines():
+        if line.startswith("PROBE_OK"):
+            return line.split()[1]
+    return None
 
 
 def _watchdog():
-    """Run the benchmark in a child process under a hard timeout.
+    """Run the benchmark in a child process under a budgeted retry loop.
 
-    Round-1 failure modes: axon backend init either errors (rc=1, no
-    parseable output) or hangs in native code with the GIL held — a
-    SIGALRM-based guard cannot interrupt the latter, so the guard must live
-    in a separate process.  The parent ALWAYS prints exactly one JSON line
-    on stdout, retrying the child on failure."""
+    Failure modes seen in rounds 1-2: axon backend init either errors
+    (rc=1, no parseable output) or hangs in native code with the GIL held —
+    a SIGALRM guard cannot interrupt the latter, so the guard lives in a
+    separate process.  Round 2 lost its number to a single 1500 s hang with
+    no retry; now a ~30 s probe gates each attempt, so a down relay costs a
+    probe + backoff (not a full attempt timeout), and retries continue until
+    BENCH_BUDGET is spent.  The parent ALWAYS prints exactly one JSON line
+    on stdout."""
     import subprocess
 
-    timeout_s = float(os.environ.get("BENCH_TIMEOUT", "1500"))
-    retries = int(os.environ.get("BENCH_RETRIES", "3"))
-    delay = float(os.environ.get("BENCH_RETRY_DELAY", "15"))
-    last_err = "unknown"
-    attempts = 0
-    for attempt in range(retries):
-        attempts = attempt + 1
+    budget_s = float(os.environ.get("BENCH_BUDGET", "1400"))
+    attempt_timeout = float(os.environ.get("BENCH_TIMEOUT", "380"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "45"))
+    delay = float(os.environ.get("BENCH_RETRY_DELAY", "10"))
+    # cap on attempts whose CHILD ran and failed (a child error is
+    # deterministic — retrying it forever would just churn); probe failures
+    # are transient (relay down) and stay budget-bound instead
+    max_attempts = int(os.environ.get("BENCH_RETRIES", "3"))
+    # a real attempt needs compile + warmup + timed iters; launching with
+    # less than this remaining is a guaranteed-doomed run
+    min_attempt_s = min(attempt_timeout, 150.0)
+    t_start = time.monotonic()
+
+    def remaining():
+        return budget_s - (time.monotonic() - t_start)
+
+    probes = failed_probes = attempts = 0
+    last_err = "no attempt made"
+    backoff = delay
+    while attempts < max_attempts:
+        if remaining() < probe_timeout + min_attempt_s:
+            break
+        probes += 1
+        platform = _probe_backend(min(probe_timeout, remaining()))
+        if platform is None:
+            failed_probes += 1
+            last_err = ("backend probe hung/failed (relay down?), "
+                        "%d/%d probes failed" % (failed_probes, probes))
+            print("probe %d failed; backing off %gs" % (probes, backoff),
+                  file=sys.stderr)
+            time.sleep(min(backoff, max(remaining(), 0)))
+            backoff = min(backoff * 2, 60)
+            continue
+        backoff = delay
+        print("probe ok (%s); starting attempt" % platform, file=sys.stderr)
+        if remaining() < min_attempt_s:
+            break
+        attempts += 1
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
             stdout=subprocess.PIPE, text=True)
         try:
-            out, _ = proc.communicate(timeout=timeout_s)
+            out, _ = proc.communicate(timeout=min(attempt_timeout, remaining()))
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.communicate()
-            # a hang is deterministic (relay down) — don't burn the retry
-            # budget on it, or an external driver timeout could kill us
-            # before the JSON error line prints
-            last_err = "benchmark timed out after %gs (backend hang?)" % timeout_s
-            print("attempt %d: %s" % (attempt + 1, last_err), file=sys.stderr)
-            break
+            last_err = ("attempt timed out after %gs (relay dropped "
+                        "mid-run?)" % attempt_timeout)
+            print("attempt %d: %s" % (attempts, last_err), file=sys.stderr)
+            continue
         for line in reversed(out.splitlines()):
             line = line.strip()
             if line.startswith("{"):
@@ -213,10 +341,15 @@ def _watchdog():
                 break
         else:
             last_err = "child exited rc=%s with no JSON output" % proc.returncode
-        print("attempt %d failed: %s" % (attempt + 1, last_err), file=sys.stderr)
-        if attempt + 1 < retries:
+        print("attempt %d failed: %s" % (attempts, last_err), file=sys.stderr)
+        if remaining() > delay:
             time.sleep(delay)
-    print(_error_line("%d attempt(s) failed; last: %s" % (attempts, last_err)))
+    elapsed = time.monotonic() - t_start
+    print(_error_line(
+        "%d attempt(s), %d probe(s) (%d failed) over %.0fs; last: %s"
+        % (attempts, probes, failed_probes, elapsed, last_err),
+        attempts=attempts, probes=probes, failed_probes=failed_probes,
+        elapsed_s=round(elapsed, 1)))
     return 1
 
 

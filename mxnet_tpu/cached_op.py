@@ -78,11 +78,42 @@ class CachedOp:
 
         return traced
 
+    def _make_lowerable(self, training):
+        """The traced forward with the remat policy applied (pre-jit).
+
+        ``remat`` is the MXNET_BACKWARD_DO_MIRROR analog (reference
+        docs/faq/env_var.md:140-145, docs/architecture/note_memory.md): the
+        reference re-executes cheap forward nodes during backward to shed
+        activation memory; here ``jax.checkpoint`` makes the vjp recompute
+        the forward instead of saving residuals, with an optional named
+        policy from jax.checkpoint_policies selecting what is still saved
+        (e.g. "dots_saveable" keeps matmul outputs, recomputes the rest)."""
+        import jax
+        from . import env
+        traced = self._make_traced(training)
+        remat = self._flags.get("remat")
+        if remat is None:
+            remat = env.get("MXNET_BACKWARD_DO_MIRROR")
+        if not remat:
+            return traced
+        policy_name = self._flags.get("remat_policy")
+        if policy_name is None:
+            policy_name = env.get("MXNET_REMAT_POLICY")
+        policy = None
+        if policy_name and policy_name != "full":
+            try:
+                policy = getattr(jax.checkpoint_policies, policy_name)
+            except AttributeError:
+                raise MXNetError(
+                    "unknown remat policy %r; see jax.checkpoint_policies"
+                    % (policy_name,))
+        return jax.checkpoint(traced, policy=policy)
+
     def _get_jitted(self, training):
         fn = self._jitted.get(training)
         if fn is None:
             import jax
-            fn = jax.jit(self._make_traced(training))
+            fn = jax.jit(self._make_lowerable(training))
             self._jitted[training] = fn
         return fn
 

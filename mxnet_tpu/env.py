@@ -57,6 +57,16 @@ _V = [
            "Override for DMLC_PS_ROOT_URI."),
     EnvVar("MX_KV_ROOT_PORT", int, None,
            "Override for DMLC_PS_ROOT_PORT."),
+    # --- memory / recompute -----------------------------------------------
+    EnvVar("MXNET_BACKWARD_DO_MIRROR", bool, False,
+           "Recompute activations in backward instead of saving them "
+           "(reference env_var.md:140-145 mirroring; lowers to jax.checkpoint "
+           "on every hybridized CachedOp; per-block override: "
+           "hybridize(remat=True))."),
+    EnvVar("MXNET_REMAT_POLICY", str, "full",
+           "jax.checkpoint_policies name selecting what remat still saves "
+           "('full' = save nothing, recompute everything; e.g. "
+           "'dots_saveable' keeps matmul outputs on-chip)."),
     # --- profiling / testing ----------------------------------------------
     EnvVar("MXNET_PROFILER_AUTOSTART", bool, False,
            "Start the jax.profiler trace at import (profiler.py)."),
@@ -68,8 +78,20 @@ _V = [
     EnvVar("BENCH_BATCH", int, 32, "bench.py batch size."),
     EnvVar("BENCH_IMG", int, 224, "bench.py image edge length."),
     EnvVar("BENCH_ITERS", int, 20, "bench.py timed iterations."),
-    EnvVar("BENCH_TIMEOUT", float, 1500.0,
-           "bench.py child-process watchdog timeout (seconds)."),
+    EnvVar("BENCH_MODE", str, "train",
+           "bench.py measurement: train (headline) or inference."),
+    EnvVar("BENCH_LAYOUT", str, "NCHW",
+           "bench.py conv data layout: NCHW (reference) or NHWC."),
+    EnvVar("BENCH_BUDGET", float, 1400.0,
+           "bench.py total wall-clock budget across probes and retries."),
+    EnvVar("BENCH_TIMEOUT", float, 380.0,
+           "bench.py per-attempt child timeout (seconds); retried while "
+           "budget remains."),
+    EnvVar("BENCH_PROBE_TIMEOUT", float, 45.0,
+           "bench.py pre-flight backend-probe timeout (a down relay hangs "
+           "init, so each attempt is gated on a disposable probe)."),
+    EnvVar("BENCH_RETRY_DELAY", float, 10.0,
+           "bench.py base delay between probe/attempt retries."),
 ]
 
 VARIABLES = {v.name: v for v in _V}
@@ -84,7 +106,6 @@ ABSORBED = {
     "MXNET_EXEC_BULK_EXEC_TRAIN": "Whole-graph jit always bulks.",
     "MXNET_GPU_MEM_POOL_RESERVE": "XLA BFC allocator owns device memory.",
     "MXNET_CUDNN_AUTOTUNE_DEFAULT": "XLA autotuning; no cuDNN.",
-    "MXNET_BACKWARD_DO_MIRROR": "Use jax.checkpoint / remat policies.",
     "MXNET_KVSTORE_BIGARRAY_BOUND": "One fused allreduce per step.",
     "OMP_NUM_THREADS": "Honored by XLA's CPU backend directly.",
 }

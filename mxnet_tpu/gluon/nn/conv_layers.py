@@ -41,14 +41,24 @@ class _Conv(HybridBlock):
                 "no_bias": not use_bias, "layout": layout}
             if adj is not None:
                 self._kwargs["adj"] = _pair(adj, nd_)
+            self._channel_last = not layout.startswith("NC")
             if self._op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) \
-                    + self._kernel
+                in_per_group = in_channels // groups if in_channels else 0
+                # channel-last keeps the op's (O, spatial..., I) weight layout
+                # so the compiled graph needs no weight transposes either
+                wshape = ((channels,) + self._kernel + (in_per_group,)
+                          if self._channel_last
+                          else (channels, in_per_group) + self._kernel)
             else:  # Deconvolution: (in, out/g, *k)
                 wshape = (in_channels, channels // groups) + self._kernel
+            init_perm = None
+            if self._op_name == "Convolution" and self._channel_last:
+                nd_ = len(self._kernel)
+                init_perm = (0,) + tuple(range(2, 2 + nd_)) + (1,)
             self.weight = self.params.get("weight", shape=wshape,
                                           init=weight_initializer,
-                                          allow_deferred_init=True)
+                                          allow_deferred_init=True,
+                                          init_perm=init_perm)
             if use_bias:
                 self.bias = self.params.get("bias", shape=(channels,),
                                             init=bias_initializer,
@@ -62,17 +72,21 @@ class _Conv(HybridBlock):
                 self.act = None
 
     def _shape_hook(self, x, *args):
-        in_channels = x.shape[1]
         if self._op_name == "Convolution":
-            self.weight.shape = (self._channels, in_channels // self._groups) \
-                + self._kernel
+            if self._channel_last:
+                in_channels = x.shape[-1]
+                self.weight.shape = (self._channels,) + self._kernel \
+                    + (in_channels // self._groups,)
+            else:
+                in_channels = x.shape[1]
+                self.weight.shape = (self._channels,
+                                     in_channels // self._groups) + self._kernel
         else:
-            self.weight.shape = (in_channels, self._channels // self._groups) \
+            self.weight.shape = (x.shape[1], self._channels // self._groups) \
                 + self._kernel
 
     def hybrid_forward(self, F, x, weight, bias=None):
-        attrs = {k: v for k, v in self._kwargs.items() if k != "layout"
-                 and k != "num_filter"}
+        attrs = {k: v for k, v in self._kwargs.items() if k != "num_filter"}
         op = getattr(F, self._op_name)
         if bias is None:
             act = op(x, weight, no_bias=True,
@@ -195,6 +209,7 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
+            "layout": layout,
             "pooling_convention": "full" if ceil_mode else "valid"}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad

@@ -1060,7 +1060,11 @@ def _conv_param_shapes(attrs, in_shapes):
     ng = int(attrs.get("num_group", 1))
     kernel = tuple(attrs["kernel"]) if not isinstance(attrs["kernel"], int) \
         else (attrs["kernel"],)
-    out = {"weight": (nf, data[1] // ng) + kernel}
+    layout = attrs.get("layout")
+    if layout is not None and not layout.startswith("NC"):
+        out = {"weight": (nf,) + kernel + (data[-1] // ng,)}
+    else:
+        out = {"weight": (nf, data[1] // ng) + kernel}
     if not attrs.get("no_bias"):
         out["bias"] = (nf,)
     return out

@@ -298,3 +298,31 @@ def test_model_zoo_hybridize_consistency():
     net.hybridize()
     compiled = net(x).asnumpy()
     np.testing.assert_allclose(eager, compiled, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_nhwc_layout_matches_nchw():
+    """model_zoo ResNet built channels-last (TPU-preferred, SURVEY §7(f))
+    computes the same function as the channels-first build when the conv
+    weights are re-tiled (O,I,H,W) -> (O,H,W,I)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    rng = np.random.RandomState(7)
+
+    net_cf = vision.resnet18_v1(classes=10)
+    net_cf.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+    x_cf = nd.array(rng.uniform(-1, 1, (2, 3, 32, 32)).astype(np.float32))
+    out_cf = net_cf(x_cf)
+
+    net_cl = vision.resnet18_v1(classes=10, layout="NHWC")
+    net_cl.initialize()
+    net_cl(nd.array(np.transpose(x_cf.asnumpy(), (0, 2, 3, 1))))  # shapes
+    cf_params = {n[len(net_cf.prefix):]: p
+                 for n, p in net_cf.collect_params().items()}
+    for name, p in net_cl.collect_params().items():
+        name = name[len(net_cl.prefix):]
+        src = cf_params[name].data().asnumpy()
+        if src.ndim == 4 and name.endswith("weight"):
+            src = np.transpose(src, (0, 2, 3, 1))
+        assert tuple(src.shape) == tuple(p.shape), (name, src.shape, p.shape)
+        p.set_data(nd.array(src))
+    out_cl = net_cl(nd.array(np.transpose(x_cf.asnumpy(), (0, 2, 3, 1))))
+    assert_almost_equal(out_cl.asnumpy(), out_cf.asnumpy(), rtol=1e-4, atol=1e-4)

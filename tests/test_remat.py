@@ -1,0 +1,136 @@
+"""Backward-mirroring / rematerialization (reference: MXNET_BACKWARD_DO_MIRROR,
+docs/faq/env_var.md:140-145 and docs/architecture/note_memory.md — re-execute
+cheap forward ops during backward to shed activation memory).
+
+TPU analog: ``hybridize(remat=True)`` (or the env knob) wraps the CachedOp's
+traced forward in ``jax.checkpoint`` so the compiled vjp recomputes
+activations instead of saving them.  Same math, less HBM."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.gluon import nn
+
+
+def _make_net(remat=None, seed=3):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(4))
+    np.random.seed(seed)
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    flags = {} if remat is None else {"remat": remat}
+    net.hybridize(**flags)
+    return net
+
+
+def _grads(net, x_np):
+    x = nd.array(x_np)
+    net(x)  # materialize deferred shapes
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    return {n[len(net.prefix):]: p.grad().asnumpy()
+            for n, p in net.collect_params().items()}
+
+
+def test_remat_grads_match():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (8, 16)).astype(np.float32)
+    g_plain = _grads(_make_net(remat=None), x)
+    g_remat = _grads(_make_net(remat=True), x)
+    assert set(g_plain) == set(g_remat)
+    for name in g_plain:
+        # same math, but remat changes XLA's fusion schedule, so the last
+        # float bit can differ — tight tolerance, not bitwise
+        np.testing.assert_allclose(g_plain[name], g_remat[name],
+                                   rtol=1e-6, atol=1e-6, err_msg=name)
+
+
+def test_remat_appears_in_jaxpr():
+    import jax
+    net = _make_net(remat=True)
+    x = nd.zeros((2, 16))
+    net(x)  # builds the CachedOp
+    co = net._cached_op
+    fn = co._make_lowerable(training=True)
+    params = {n: p.data()._data for n, p in net._cached_params.items()}
+    vals = tuple(params[n] for n in co._param_names) + (x._data,
+                                                        jax.random.PRNGKey(0))
+    jaxpr = jax.make_jaxpr(fn)(*vals)
+    assert "remat" in str(jaxpr), "jax.checkpoint not applied to the forward"
+    # and the plain build must NOT carry it
+    net2 = _make_net(remat=None)
+    net2(x)
+    fn2 = net2._cached_op._make_lowerable(training=True)
+    vals2 = tuple(net2._cached_params[n].data()._data
+                  for n in net2._cached_op._param_names) \
+        + (x._data, jax.random.PRNGKey(0))
+    assert "remat" not in str(jax.make_jaxpr(fn2)(*vals2))
+
+
+def test_remat_env_knob(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR=1 turns remat on without a per-block flag."""
+    import jax
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    net = _make_net(remat=None)
+    x = nd.zeros((2, 16))
+    net(x)
+    fn = net._cached_op._make_lowerable(training=True)
+    vals = tuple(net._cached_params[n].data()._data
+                 for n in net._cached_op._param_names) \
+        + (x._data, jax.random.PRNGKey(0))
+    assert "remat" in str(jax.make_jaxpr(fn)(*vals))
+
+
+def test_remat_policy_knob():
+    """Named jax.checkpoint_policies select what is still saved; bad names
+    error out with the available surface."""
+    from mxnet_tpu.base import MXNetError
+    net = _make_net(remat=True)
+    net.hybridize(remat=True, remat_policy="dots_saveable")
+    x = nd.zeros((2, 16))
+    out = net(x)
+    assert out.shape == (2, 4)
+    net.hybridize(remat=True, remat_policy="not_a_policy")
+    with pytest.raises(MXNetError):
+        net(x)
+
+
+def test_remat_convnet_bitwise():
+    """Conv+BN net (aux state threaded) under remat: grads and updated
+    running stats match the plain path to float precision."""
+    rng = np.random.RandomState(1)
+    x_np = rng.uniform(-1, 1, (2, 3, 16, 16)).astype(np.float32)
+
+    def build(remat):
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(8, 3, padding=1))
+            net.add(nn.BatchNorm())
+            net.add(nn.Activation("relu"))
+            net.add(nn.GlobalAvgPool2D())
+            net.add(nn.Dense(4))
+        np.random.seed(11)
+        net.initialize(mx.init.Xavier(), force_reinit=True)
+        net.hybridize(**({"remat": True} if remat else {}))
+        return net
+
+    results = {}
+    for remat in (False, True):
+        net = build(remat)
+        x = nd.array(x_np)
+        net(x)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        results[remat] = {
+            n[len(net.prefix):]: (p.grad().asnumpy() if p.grad_req != "null"
+                                  else p.data().asnumpy())
+            for n, p in net.collect_params().items()}
+    for name in results[False]:
+        np.testing.assert_allclose(results[False][name], results[True][name],
+                                   rtol=1e-6, atol=1e-6, err_msg=name)

@@ -1,0 +1,82 @@
+"""Find >=N-line verbatim blocks shared with the reference's Python tree.
+
+Usage: python tools/verbatim_sweep.py [--min-lines 8] [files...]
+
+Compares every mxnet_tpu/**/*.py (or the given files) against every
+python/mxnet/**/*.py in /root/reference using difflib matching blocks over
+whitespace-stripped non-empty lines, and prints blocks of >= min-lines
+consecutive identical lines.  Used to enforce the no-derived-passages rule:
+the build is a from-scratch framework, so API-parity plumbing must be
+rewritten in repo idiom, not condensed from the reference.
+"""
+import argparse
+import difflib
+import os
+import sys
+
+REF_ROOT = "/root/reference/python/mxnet"
+REPO_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mxnet_tpu")
+
+
+def stripped_lines(path):
+    out = []
+    with open(path, errors="replace") as f:
+        for i, line in enumerate(f, 1):
+            s = line.strip()
+            if s:
+                out.append((i, s))
+    return out
+
+
+def sweep(repo_files, ref_files, min_lines):
+    ref_cache = {p: stripped_lines(p) for p in ref_files}
+    total = 0
+    for rf in repo_files:
+        mine = stripped_lines(rf)
+        if not mine:
+            continue
+        a = [s for _, s in mine]
+        for ref_path, ref in ref_cache.items():
+            b = [s for _, s in ref]
+            sm = difflib.SequenceMatcher(a=a, b=b, autojunk=False)
+            for m in sm.get_matching_blocks():
+                if m.size >= min_lines:
+                    # skip blocks that are all boilerplate (imports, closers)
+                    body = a[m.a:m.a + m.size]
+                    if all(len(x) <= 8 for x in body):
+                        continue
+                    total += 1
+                    print("%s:%d-%d == %s:%d-%d (%d lines)" % (
+                        rf, mine[m.a][0], mine[m.a + m.size - 1][0],
+                        ref_path, ref[m.b][0], ref[m.b + m.size - 1][0],
+                        m.size))
+                    for x in body[:3]:
+                        print("    | " + x[:90])
+    return total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("files", nargs="*")
+    ap.add_argument("--min-lines", type=int, default=8)
+    args = ap.parse_args()
+
+    if args.files:
+        repo_files = args.files
+    else:
+        repo_files = []
+        for root, _, names in os.walk(REPO_ROOT):
+            repo_files += [os.path.join(root, n) for n in names
+                           if n.endswith(".py")]
+    ref_files = []
+    for root, _, names in os.walk(REF_ROOT):
+        ref_files += [os.path.join(root, n) for n in names
+                      if n.endswith(".py")]
+    n = sweep(sorted(repo_files), sorted(ref_files), args.min_lines)
+    print("-- %d verbatim block(s) >= %d lines" % (n, args.min_lines))
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
