@@ -27,17 +27,24 @@ class AttrScope:
         return attr if attr else {}
 
     def __enter__(self):
-        if not hasattr(AttrScope._current, "value"):
-            AttrScope._current.value = AttrScope()
-        self._old_scope = AttrScope._current.value
-        attr = AttrScope._current.value._attr.copy()
-        attr.update(self._attr)
-        self._attr = attr
+        # nested scopes stack: our attrs override the enclosing scope's,
+        # which we fold in so lookups see the whole chain
+        outer = AttrScope._get_current()
+        self._old_scope = outer
+        merged = dict(outer._attr)
+        merged.update(self._attr)
+        self._attr = merged
         AttrScope._current.value = self
         return self
 
     def __exit__(self, ptype, value, trace):
         AttrScope._current.value = self._old_scope
+
+    @staticmethod
+    def _get_current():
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        return AttrScope._current.value
 
 
 AttrScope._current.value = AttrScope()

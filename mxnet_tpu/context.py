@@ -27,12 +27,12 @@ class Context:
     _default_ctx = threading.local()
 
     def __init__(self, device_type, device_id=0):
+        # copy-construction from another Context is allowed (reference API)
         if isinstance(device_type, Context):
-            self.device_typeid = device_type.device_typeid
-            self.device_id = device_type.device_id
-        else:
-            self.device_typeid = Context.devstr2type[device_type]
-            self.device_id = device_id
+            device_id = device_type.device_id
+            device_type = device_type.device_type
+        self.device_typeid = Context.devstr2type[device_type]
+        self.device_id = device_id
         self._old_ctx = None
 
     @property

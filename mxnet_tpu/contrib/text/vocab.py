@@ -51,20 +51,25 @@ class Vocabulary(object):
     def __len__(self):
         return len(self._idx_to_token)
 
+    # read-only views over the two index structures
     @property
     def token_to_idx(self):
+        """dict token -> index (0 is the unknown token's slot)."""
         return self._token_to_idx
 
     @property
     def idx_to_token(self):
+        """list where position i holds the token at index i."""
         return self._idx_to_token
 
     @property
     def unknown_token(self):
+        """Representation used for out-of-vocabulary tokens."""
         return self._unknown_token
 
     @property
     def reserved_tokens(self):
+        """Tokens pinned at the front of the index, after unknown."""
         return self._reserved_tokens
 
     def to_indices(self, tokens):

@@ -64,22 +64,22 @@ class _BlockScope:
 
     def __init__(self, block):
         self._block = block
-        self._counter = {}
+        self._counter = {}     # per-hint child numbering inside this scope
         self._old_scope = None
         self._name_scope = None
 
     @staticmethod
     def create(prefix, params, hint):
+        """Resolve a new block's (prefix, ParameterDict) against the
+        enclosing scope: top-level blocks auto-number through NameManager,
+        nested ones through the parent scope's counter."""
         current = getattr(_BlockScope._current, "value", None)
         if current is None:
+            from ..name import current as current_names
             if prefix is None:
-                if not hasattr(NameManager._current, "value"):
-                    NameManager._current.value = NameManager()
-                prefix = NameManager._current.value.get(None, hint) + "_"
-            if params is None:
-                params = ParameterDict(prefix)
-            else:
-                params = ParameterDict(params.prefix, params)
+                prefix = current_names().get(None, hint) + "_"
+            params = ParameterDict(prefix) if params is None \
+                else ParameterDict(params.prefix, params)
             return prefix, params
         if prefix is None:
             count = current._counter.get(hint, 0)
@@ -94,10 +94,11 @@ class _BlockScope:
 
     def __enter__(self):
         if self._block._empty_prefix:
-            return self
+            return self  # prefix="" blocks are name-transparent
+        from ..name import Prefix
         self._old_scope = getattr(_BlockScope._current, "value", None)
         _BlockScope._current.value = self
-        from ..name import Prefix
+        # symbols built inside the scope get the block's prefix too
         self._name_scope = Prefix(self._block.prefix)
         self._name_scope.__enter__()
         return self
@@ -105,6 +106,7 @@ class _BlockScope:
     def __exit__(self, ptype, value, trace):
         if self._block._empty_prefix:
             return
+        # unwind in reverse order of __enter__
         self._name_scope.__exit__(ptype, value, trace)
         self._name_scope = None
         _BlockScope._current.value = self._old_scope

@@ -43,6 +43,8 @@ class SimpleDataset(Dataset):
 
 
 class _LazyTransformDataset(Dataset):
+    """Applies ``fn`` at access time (no upfront materialization)."""
+
     def __init__(self, data, fn):
         self._data = data
         self._fn = fn
@@ -52,9 +54,8 @@ class _LazyTransformDataset(Dataset):
 
     def __getitem__(self, idx):
         item = self._data[idx]
-        if isinstance(item, tuple):
-            return self._fn(*item)
-        return self._fn(item)
+        # multi-field samples (data, label, ...) splat into the transform
+        return self._fn(*item) if isinstance(item, tuple) else self._fn(item)
 
 
 class _TransformFirstClosure:

@@ -37,19 +37,23 @@ class RandomSampler(Sampler):
 
 
 class BatchSampler(Sampler):
+    """Group a sampler's indices into batch-size lists; ``last_batch``
+    picks keep/discard/rollover handling for the ragged tail."""
+
     def __init__(self, sampler, batch_size, last_batch="keep"):
         self._sampler = sampler
         self._batch_size = batch_size
         self._last_batch = last_batch
-        self._prev = []
+        self._prev = []   # rollover carry-in from the previous epoch
 
     def __iter__(self):
         batch, self._prev = self._prev, []
         for i in self._sampler:
             batch.append(i)
-            if len(batch) == self._batch_size:
-                yield batch
-                batch = []
+            if len(batch) < self._batch_size:
+                continue
+            yield batch
+            batch = []
         if batch:
             if self._last_batch == "keep":
                 yield batch

@@ -10,23 +10,31 @@ from .... import image as _image
 
 
 class Compose(Sequential):
+    """Chain transforms; consecutive HybridBlocks are fused into one
+    HybridSequential so they compile as a single jitted stage."""
+
     def __init__(self, transforms):
         super().__init__()
-        transforms.append(None)
+        # copy: the caller keeps its list; None sentinel flushes the
+        # trailing hybrid run
+        transforms = list(transforms) + [None]
         hybrid = []
+
+        def flush():
+            if len(hybrid) == 1:
+                self.add(hybrid[0])
+            elif hybrid:
+                fused = HybridSequential()
+                for j in hybrid:
+                    fused.add(j)
+                self.add(fused)
+            del hybrid[:]
+
         for i in transforms:
             if isinstance(i, HybridBlock):
                 hybrid.append(i)
                 continue
-            elif len(hybrid) == 1:
-                self.add(hybrid[0])
-                hybrid = []
-            elif len(hybrid) > 1:
-                hblock = HybridSequential()
-                for j in hybrid:
-                    hblock.add(j)
-                self.add(hblock)
-                hybrid = []
+            flush()
             if i is not None:
                 self.add(i)
 

@@ -299,15 +299,16 @@ class HueJitterAug(Augmenter):
 
 
 class ColorJitterAug(RandomOrderAug):
+    """Brightness/contrast/saturation jitter, applied in random order;
+    a zero strength drops that component entirely."""
+
     def __init__(self, brightness, contrast, saturation):
-        ts = []
-        if brightness > 0:
-            ts.append(BrightnessJitterAug(brightness))
-        if contrast > 0:
-            ts.append(ContrastJitterAug(contrast))
-        if saturation > 0:
-            ts.append(SaturationJitterAug(saturation))
-        super().__init__(ts)
+        parts = [cls(strength)
+                 for cls, strength in ((BrightnessJitterAug, brightness),
+                                       (ContrastJitterAug, contrast),
+                                       (SaturationJitterAug, saturation))
+                 if strength > 0]
+        super().__init__(parts)
 
 
 class LightingAug(Augmenter):
@@ -386,7 +387,7 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
+    auglist.append(CastAug())   # float32 from here on
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
     if hue:

@@ -195,15 +195,12 @@ class Xavier(Initializer):
         if len(shape) > 2:
             hw_scale = _np.prod(shape[2:])
         fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.0
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
-            raise ValueError("Incorrect factor type")
+        try:
+            factor = {"avg": (fan_in + fan_out) / 2.0,
+                      "in": fan_in,
+                      "out": fan_out}[self.factor_type]
+        except KeyError:
+            raise ValueError("Incorrect factor type %r" % (self.factor_type,))
         scale = _np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
             self._set(arr, _np.random.uniform(-scale, scale, shape))

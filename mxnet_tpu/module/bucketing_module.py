@@ -189,6 +189,8 @@ class BucketingModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
+        # only the active bucket creates real optimizer state; every other
+        # bucket shares it (they are the same weights at different lengths)
         self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
                                          force_init=force_init)
         for mod in self._buckets.values():

@@ -20,21 +20,21 @@ from ..base import MXNetError
 
 
 def _split_input_slice(batch_size, work_load_list):
-    """Reference: mxnet.executor_manager._split_input_slice."""
-    total_work_load = sum(work_load_list)
-    batch_num_list = [round(work_load * batch_size / total_work_load)
-                      for work_load in work_load_list]
-    batch_num_sum = sum(batch_num_list)
-    if batch_num_sum < batch_size:
-        batch_num_list[-1] += batch_size - batch_num_sum
-    slices = []
-    end = 0
-    for batch_num in batch_num_list:
-        begin = int(min(end, batch_size))
-        end = int(min(begin + batch_num, batch_size))
-        if begin >= end:
+    """Per-device batch slices proportional to each device's workload weight
+    (reference: mxnet.executor_manager._split_input_slice).
+
+    Rounds each share, gives any remainder to the last device, and errors if
+    the rounding starves a device of samples entirely."""
+    total = float(sum(work_load_list))
+    shares = [round(batch_size * w / total) for w in work_load_list]
+    shares[-1] += batch_size - sum(shares)
+    slices, start = [], 0
+    for share in shares:
+        stop = min(start + int(share), batch_size)
+        if stop <= start:
             raise ValueError("Too many slices. Some splits are empty.")
-        slices.append(slice(begin, end))
+        slices.append(slice(start, stop))
+        start = stop
     return slices
 
 

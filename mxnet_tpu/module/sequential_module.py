@@ -59,14 +59,13 @@ class SequentialModule(BaseModule):
         return self._modules[-1].output_shapes
 
     def get_params(self):
+        """Union of every layer-module's (arg, aux) parameter dicts."""
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
+        merged = ({}, {})
         for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+            for acc, part in zip(merged, module.get_params()):
+                acc.update(part)
+        return merged
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
@@ -107,17 +106,16 @@ class SequentialModule(BaseModule):
         self.binded = True
         self._label_shapes = label_shapes
 
+        # each layer binds against the previous layer's output schema;
+        # labels only reach the layers that declared META_TAKE_LABELS
         my_data_shapes = data_shapes
         anybody_ever_needs_label = False
         for i_layer, module in enumerate(self._modules):
             meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-
+            takes_labels = bool(meta.get(SequentialModule.META_TAKE_LABELS))
+            my_label_shapes = label_shapes if takes_labels else None
+            anybody_ever_needs_label |= takes_labels
+            # downstream layers always need input grads for backprop
             my_inputs_need_grad = bool(for_training and
                                        (inputs_need_grad or i_layer > 0))
 

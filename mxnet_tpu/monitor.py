@@ -10,18 +10,15 @@ from .ndarray import NDArray
 
 class Monitor:
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return x.abs().mean()
-            stat_func = asum_stat
-        self.stat_func = stat_func
+        # default statistic: mean absolute value of the tapped tensor
+        self.stat_func = stat_func or (lambda x: x.abs().mean())
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        self.activated = False
+        self.step = 0
+        self.queue = []   # (step, tensor name, statistic) triples
+        self.exes = []
 
         def stat_helper(name, array):
             if not self.activated or not self.re_prog.match(str(name)):

@@ -12,18 +12,16 @@ class NameManager:
         self._old_manager = None
 
     def get(self, name, hint):
+        """``name`` if explicit, else the next auto-name for ``hint``
+        (hint0, hint1, ...)."""
         if name:
             return name
-        if hint not in self._counter:
-            self._counter[hint] = 0
-        name = "%s%d" % (hint, self._counter[hint])
-        self._counter[hint] += 1
-        return name
+        seq = self._counter.get(hint, 0)
+        self._counter[hint] = seq + 1
+        return "%s%d" % (hint, seq)
 
     def __enter__(self):
-        if not hasattr(NameManager._current, "value"):
-            NameManager._current.value = NameManager()
-        self._old_manager = NameManager._current.value
+        self._old_manager = current()
         NameManager._current.value = self
         return self
 

@@ -19,7 +19,8 @@ import json
 import threading
 from collections import defaultdict
 
-__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "merge_dumps",
+           "pause", "resume",
            "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
 
 _config = {"profile_all": False, "profile_symbolic": True, "profile_imperative": True,
@@ -92,6 +93,43 @@ def dumps(reset=False):
                          % (name, cnt, total, total / max(cnt, 1)))
         if reset:
             _agg.clear()
+    return "\n".join(lines)
+
+
+def merge_dumps(filenames, out=None):
+    """Aggregate per-op stats across several workers' trace dumps
+    (the distributed analog of ``dumps()``; reference server-side profiling,
+    include/mxnet/kvstore.h:49 SetServerProfilerCommand +
+    tests/nightly/test_server_profiling.py).
+
+    ``filenames``: per-rank chrome-trace JSON files written by ``dump()``.
+    ``out``: optional path for the combined trace (events from all ranks in
+    one timeline; pids distinguish the workers).  Returns the merged table.
+    """
+    events = []
+    for fn in filenames:
+        with open(fn) as f:
+            events.extend(json.load(f).get("traceEvents", []))
+    if out is not None:
+        with open(out, "w") as f:
+            json.dump({"traceEvents": events}, f)
+    # pair B/E spans per (worker pid, thread, name) to recover durations
+    open_spans = defaultdict(list)
+    agg = defaultdict(lambda: [0, 0.0])
+    for ev in sorted(events, key=lambda e: e.get("ts", 0)):
+        key = (ev.get("pid"), ev.get("tid"), ev["name"])
+        if ev.get("ph") == "B":
+            open_spans[key].append(ev["ts"])
+        elif ev.get("ph") == "E" and open_spans[key]:
+            begin = open_spans[key].pop()
+            entry = agg[ev["name"]]
+            entry[0] += 1
+            entry[1] += (ev["ts"] - begin) / 1e3
+    lines = ["%-40s %10s %14s %14s" % ("Name", "Calls", "Total(ms)",
+                                       "Avg(ms)")]
+    for name, (cnt, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append("%-40s %10d %14.3f %14.3f"
+                     % (name, cnt, total, total / max(cnt, 1)))
     return "\n".join(lines)
 
 

@@ -30,9 +30,20 @@ def check_diff(nd_arr, expected):
 
 
 def main():
+    from mxnet_tpu import profiler
+
     kv = mx.kv.create("dist_sync")
     rank, nworker = kv.rank, kv.num_workers
     assert nworker > 1, "run through tools/launch.py -n <N>"
+
+    # per-worker profiling (reference server-side profiling analog,
+    # include/mxnet/kvstore.h:49): each rank traces its kvstore commands
+    # and leaves its own dump; the launcher-side test merges the tables
+    profile_dir = os.environ.get("DIST_PROFILE_DIR")
+    if profile_dir:
+        profiler.set_config(filename=os.path.join(
+            profile_dir, "dist_profile_rank%d.json" % rank))
+    kv_domain = profiler.Domain("kvstore")
 
     kv.init("3", mx.nd.ones(SHAPE))
     kv.init("99", mx.nd.ones(BIG_SHAPE))
@@ -40,9 +51,11 @@ def main():
 
     # repeated sync push/pull: result must equal the exact global sum
     for it in range(3):
-        kv.push("3", mx.nd.ones(SHAPE) * (rank + 1))
+        with kv_domain.new_task("push_dense"):
+            kv.push("3", mx.nd.ones(SHAPE) * (rank + 1))
         out = mx.nd.zeros(SHAPE)
-        kv.pull("3", out=out)
+        with kv_domain.new_task("pull_dense"):
+            kv.pull("3", out=out)
         check_diff(out, float(sum(range(1, nworker + 1))))
 
         kv.push("99", mx.nd.ones(BIG_SHAPE) * 2 * (rank + 1))
@@ -56,28 +69,78 @@ def main():
     kv.pull("3", out=out)
     check_diff(out, float(sum(range(1, nworker + 1))))
 
+    # --- non-fp32 dtypes over the cross-host reduce (reference
+    # dist_sync_kvstore.py tests fp16 alongside fp32) ---------------------
+    # (fp64 is excluded by design: jax runs x64-disabled, SURVEY §7)
+    for dtype, tol in (("float16", 1e-3), ("int32", 0)):
+        key = "dt_" + dtype
+        kv.init(key, mx.nd.zeros(SHAPE, dtype=dtype))
+        kv.push(key, mx.nd.ones(SHAPE, dtype=dtype) * (rank + 1))
+        out = mx.nd.zeros(SHAPE, dtype=dtype)
+        kv.pull(key, out=out)
+        expected = np.full(SHAPE, sum(range(1, nworker + 1)))
+        np.testing.assert_allclose(out.asnumpy().astype(np.float64),
+                                   expected, rtol=tol, atol=tol)
+        assert str(out.dtype).endswith(dtype), (out.dtype, dtype)
+
+    # --- row_sparse push + row_sparse_pull across workers (reference
+    # dist_sync_kvstore.py check_row_sparse_keys) ------------------------
+    # each rank touches a different row pair; the reduced table must hold
+    # every rank's contribution (ours reduces the dense view across hosts —
+    # wire densification is the documented divergence, README scope)
+    from mxnet_tpu.ndarray import sparse
+    R, C = 4 * nworker, 3
+    kv.init("rs", mx.nd.zeros((R, C)))
+    my_rows = np.array([rank, nworker + rank])
+    my_vals = np.full((2, C), float(rank + 1), dtype=np.float32)
+    kv.push("rs", sparse.row_sparse_array((my_vals, my_rows), shape=(R, C)))
+    expected = np.zeros((R, C), dtype=np.float32)
+    for r in range(nworker):
+        expected[[r, nworker + r]] += r + 1
+    out = mx.nd.zeros((R, C))
+    kv.pull("rs", out=out)
+    check_diff(out, expected)
+    # sliced pull of just this rank's rows (the large-embedding path)
+    rows = mx.nd.array(my_rows.astype(np.int32), dtype="int32")
+    sub = mx.nd.zeros((2, C))
+    kv.row_sparse_pull("rs", out=sub, row_ids=rows)
+    check_diff(sub, expected[my_rows])
+
     # --- 2-bit gradient compression with error feedback (reference
     # dist_sync_kvstore.py check_compr_residual) -------------------------
     threshold = 0.5
     kv.set_gradient_compression({"type": "2bit", "threshold": threshold})
     kv.init("c1", mx.nd.zeros(SHAPE))
-    # every worker pushes the same grad; per-worker quantization is
-    # identical, so the reduced result is nworker * quantized(grad)
-    grad_np = np.array([[0.7, -0.9, 0.2, -0.1],
-                        [0.4, 1.3, -2.0, 0.05],
-                        [0.0, 0.6, -0.55, 0.49]], dtype=np.float32)[:SHAPE[0], :SHAPE[1]]
-    residual = np.zeros_like(grad_np)
-    for _ in range(3):
-        acc = residual + grad_np
-        quant = np.where(acc >= threshold, threshold,
+    base_grad = np.array([[0.7, -0.9, 0.2, -0.1],
+                          [0.4, 1.3, -2.0, 0.05],
+                          [0.0, 0.6, -0.55, 0.49]],
+                         dtype=np.float32)[:SHAPE[0], :SHAPE[1]]
+    # rank-DEPENDENT gradients: every worker quantizes its own stream with
+    # its own error-feedback residual; the store must equal the sum of the
+    # per-rank quantized values, each residual evolving independently
+    def quantize_stream(grad, steps):
+        res = np.zeros_like(grad)
+        outs = []
+        for _ in range(steps):
+            acc = res + grad
+            q = np.where(acc >= threshold, threshold,
                          np.where(acc <= -threshold, -threshold, 0.0))
-        residual = acc - quant
-        kv.push("c1", mx.nd.array(grad_np))
+            res = acc - q
+            outs.append(q)
+        return outs
+
+    per_rank = [quantize_stream(base_grad * (r + 1), 3)
+                for r in range(nworker)]
+    my_grad = base_grad * (rank + 1)
+    for it in range(3):
+        kv.push("c1", mx.nd.array(my_grad))
         out = mx.nd.zeros(SHAPE)
         kv.pull("c1", out=out)
-        np.testing.assert_allclose(out.asnumpy(), nworker * quant,
-                                   rtol=0, atol=1e-6)
+        expected = sum(per_rank[r][it] for r in range(nworker))
+        np.testing.assert_allclose(out.asnumpy(), expected, rtol=0, atol=1e-6)
 
+    if profile_dir:
+        profiler.dump()
     print("dist_sync_kvstore rank %d/%d: OK" % (rank, nworker), flush=True)
 
 

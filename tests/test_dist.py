@@ -22,23 +22,58 @@ def _free_port():
     return port
 
 
-def _launch(n, script, timeout=240):
+def _launch(n, script, timeout=240, extra_env=None, script_args=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # each worker is its own process with its own (single) cpu device;
     # the conftest's 8-device XLA flag must not leak in
     env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
     cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
            "-n", str(n), "--launcher", "local",
            "--env-server-port", str(_free_port()),
-           sys.executable, os.path.join(REPO, script)]
+           sys.executable, os.path.join(REPO, script)] + list(script_args)
     return subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
                           capture_output=True, text=True)
 
 
-def test_dist_sync_kvstore_4_workers():
-    res = _launch(4, "tests/dist/dist_sync_kvstore.py")
+def test_dist_sync_kvstore_4_workers(tmp_path):
+    """4 real worker processes: dense (3 dtypes), row_sparse, 2-bit
+    compressed push/pull with per-rank numeric asserts (the asserts live in
+    tests/dist/dist_sync_kvstore.py and run inside every worker), plus a
+    per-rank profile dump merged into one op table (reference
+    tests/nightly/test_server_profiling.py analog)."""
+    res = _launch(4, "tests/dist/dist_sync_kvstore.py",
+                  extra_env={"DIST_PROFILE_DIR": str(tmp_path)})
     assert res.returncode == 0, \
         "launcher failed\nstdout:\n%s\nstderr:\n%s" % (res.stdout, res.stderr)
     for rank in range(4):
         assert "dist_sync_kvstore rank %d/4: OK" % rank in res.stdout
+    # every rank left its own trace; the merged table sees all 4 workers
+    from mxnet_tpu import profiler
+    traces = sorted(tmp_path.glob("dist_profile_rank*.json"))
+    assert len(traces) == 4, [t.name for t in traces]
+    table = profiler.merge_dumps([str(t) for t in traces],
+                                 out=str(tmp_path / "merged_trace.json"))
+    assert "push_dense" in table and "pull_dense" in table
+    # 3 iterations x 4 ranks
+    push_row = next(l for l in table.splitlines() if "push_dense" in l)
+    assert push_row.split()[1] == "12", table
+    assert (tmp_path / "merged_trace.json").exists()
+
+
+def test_dist_bandwidth_tool_2_workers():
+    """tools/bandwidth.py --kv dist_sync measures the cross-process
+    allreduce (the reference tools/bandwidth distributed measurement) and
+    prints one JSON line from rank 0."""
+    import json
+    res = _launch(2, "tools/bandwidth.py",
+                  script_args=["--kv", "dist_sync", "--size-mb", "1",
+                               "--iters", "4"])
+    assert res.returncode == 0, \
+        "launcher failed\nstdout:\n%s\nstderr:\n%s" % (res.stdout, res.stderr)
+    line = next(l for l in res.stdout.splitlines() if l.startswith("{"))
+    rec = json.loads(line)
+    assert rec["metric"] == "kvstore_dist_sync_allreduce"
+    assert rec["workers"] == 2
+    assert rec["value"] > 0

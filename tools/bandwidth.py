@@ -7,6 +7,13 @@ device buffers (one chip: HBM-bound adds).  Under a multi-device mesh
 run with XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 to exercise the collective path without hardware.
 
+Multi-process (the reference's distributed kvstore measurement — the
+DCN-analog number): launch N workers, each timing the full cross-host
+push/pull allreduce; rank 0 prints the JSON line:
+
+    python tools/launch.py -n 4 --launcher local \\
+        python tools/bandwidth.py --kv dist_sync --size-mb 16
+
 Usage: python tools/bandwidth.py [--size-mb 64] [--copies 4] [--iters 20]
 Prints one JSON line {"metric", "value", "unit"}.
 """
@@ -31,15 +38,29 @@ def main():
     ap.add_argument("--kv", default="tpu_sync")
     args = ap.parse_args()
 
+    # honor an explicit platform request before any backend touch (the env
+    # var alone does not stop this image's site hook from initializing the
+    # TPU plugin, and a down relay would hang the worker)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
     import mxnet_tpu as mx
     from mxnet_tpu import nd
 
+    dist = args.kv.startswith("dist")
     n = int(args.size_mb * (1 << 20) / 4)
     kv = mx.kvstore.create(args.kv)
+    # in dist mode each worker contributes ONE buffer; the interesting
+    # reduce is the cross-process one, not the local add-tree
+    copies = 1 if dist else args.copies
     rng = np.random.RandomState(0)
     bufs = [nd.array(rng.uniform(-1, 1, n).astype(np.float32))
-            for _ in range(args.copies)]
+            for _ in range(copies)]
     kv.init("0", bufs[0])
+    if dist:
+        kv.barrier()
 
     out = nd.zeros((n,))
     # warmup (compile)
@@ -47,6 +68,8 @@ def main():
     kv.pull("0", out=out)
     out.wait_to_read()
 
+    if dist:
+        kv.barrier()
     t0 = time.perf_counter()
     for _ in range(args.iters):
         kv.push("0", bufs)
@@ -54,15 +77,18 @@ def main():
     out.wait_to_read()
     dt = time.perf_counter() - t0
 
-    # bytes reduced per iteration: copies buffers in + one out
-    gbytes = args.copies * n * 4 * args.iters / dt / 1e9
-    print(json.dumps({
-        "metric": "kvstore_%s_allreduce" % args.kv,
-        "value": round(gbytes, 2),
-        "unit": "GB/s",
-        "size_mb": args.size_mb,
-        "copies": args.copies,
-    }))
+    # bytes reduced per iteration: every participating buffer in + one out
+    workers = getattr(kv, "num_workers", 1)
+    gbytes = max(copies, workers) * n * 4 * args.iters / dt / 1e9
+    if getattr(kv, "rank", 0) == 0:
+        print(json.dumps({
+            "metric": "kvstore_%s_allreduce" % args.kv,
+            "value": round(gbytes, 2),
+            "unit": "GB/s",
+            "size_mb": args.size_mb,
+            "copies": copies,
+            "workers": workers,
+        }))
 
 
 if __name__ == "__main__":

@@ -78,8 +78,18 @@ def main():
     parser.add_argument("--launcher", choices=["local", "ssh"], default="local")
     parser.add_argument("-H", "--hostfile", default=None)
     parser.add_argument("--env-server-port", default="9876")
-    parser.add_argument("command", nargs="+")
+    # REMAINDER: everything after the launcher's own options belongs to the
+    # worker command verbatim, including its dashed flags
+    # REMAINDER: everything after the launcher's own options belongs to the
+    # worker command verbatim, including its dashed flags — so launcher
+    # options must come BEFORE the command
+    parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
+    if not args.command:
+        parser.error("no worker command given")
+    if args.command[0].startswith("-"):
+        parser.error("launcher options must precede the worker command "
+                     "(got %r first)" % args.command[0])
     cmd = " ".join(args.command)
     env = dict(os.environ)
     env["MX_KV_ROOT_PORT"] = args.env_server_port
