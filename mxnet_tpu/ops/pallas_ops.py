@@ -45,7 +45,13 @@ def _attention_reference(q, k, v, causal, scale):
 def _flash_attention_pallas(q, k, v, causal, scale, block_q=128, block_k=128,
                             interpret=False):
     """Tiled attention: grid over (batch*heads, q blocks); inner fori_loop
-    streams K/V blocks through VMEM with the online-softmax accumulator."""
+    streams K/V blocks through VMEM with the online-softmax accumulator.
+
+    Ragged sequence lengths are handled by padding q/k/v up to the tile
+    size and masking the padded key columns to -inf inside the kernel (the
+    padded query rows compute garbage that is sliced off afterwards) — so
+    T % 128 != 0 workloads keep the fused path instead of falling back to
+    the dense XLA reference."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -54,7 +60,16 @@ def _flash_attention_pallas(q, k, v, causal, scale, block_q=128, block_k=128,
     Tk = k.shape[2]
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
-    n_k_blocks = (Tk + block_k - 1) // block_k
+    pad_q = -T % block_q
+    pad_k = -Tk % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    Tq_t, Tk_t = T + pad_q, Tk + pad_k
+    n_k_blocks = Tk_t // block_k
+    k_tail = bool(pad_k)  # static: tail masking compiled in only if needed
 
     def kernel(q_ref, k_ref, v_ref, o_ref):
         qi = pl.program_id(1)
@@ -63,47 +78,68 @@ def _flash_attention_pallas(q, k, v, causal, scale, block_q=128, block_k=128,
         l = jnp.zeros((block_q,), jnp.float32)
         acc = jnp.zeros((block_q, D), jnp.float32)
 
-        def body(ki, carry):
-            m_, l_, acc_ = carry
-            k_blk = k_ref[pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
-            v_blk = v_ref[pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
-            s = q_blk @ k_blk.T                               # MXU
-            if causal:
-                q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, -1e30)
-            m_cur = jnp.max(s, axis=1)
-            m_new = jnp.maximum(m_, m_cur)
-            p = jnp.exp(s - m_new[:, None])
-            alpha = jnp.exp(m_ - m_new)
-            l_new = alpha * l_ + jnp.sum(p, axis=1)
-            acc_new = acc_ * alpha[:, None] + p @ v_blk       # MXU
-            return m_new, l_new, acc_new
+        def make_body(with_tail):
+            def body(ki, carry):
+                m_, l_, acc_ = carry
+                k_blk = k_ref[pl.dslice(ki * block_k, block_k), :].astype(
+                    jnp.float32)
+                v_blk = v_ref[pl.dslice(ki * block_k, block_k), :].astype(
+                    jnp.float32)
+                s = q_blk @ k_blk.T                           # MXU
+                if causal or with_tail:
+                    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1)
+                    keep = jnp.ones_like(k_pos, dtype=bool)
+                    if causal:
+                        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                            jnp.int32, (block_q, block_k), 0)
+                        keep &= q_pos >= k_pos
+                    if with_tail:
+                        keep &= k_pos < Tk  # padded keys contribute nothing
+                    s = jnp.where(keep, s, -1e30)
+                m_cur = jnp.max(s, axis=1)
+                m_new = jnp.maximum(m_, m_cur)
+                p = jnp.exp(s - m_new[:, None])
+                alpha = jnp.exp(m_ - m_new)
+                l_new = alpha * l_ + jnp.sum(p, axis=1)
+                acc_new = acc_ * alpha[:, None] + p @ v_blk   # MXU
+                return m_new, l_new, acc_new
+            return body
 
-        upper = n_k_blocks if not causal else \
-            jax.lax.min(n_k_blocks, (qi + 1) * block_q // block_k + 1)
-        m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+        carry = (m, l, acc)
+        if causal:
+            # per-row masks are computed anyway; fold the tail predicate in
+            upper = jax.lax.min(n_k_blocks,
+                                (qi + 1) * block_q // block_k + 1)
+            carry = jax.lax.fori_loop(0, upper, make_body(k_tail), carry)
+        elif k_tail:
+            # peel the final block: interior blocks skip the mask entirely
+            carry = jax.lax.fori_loop(0, n_k_blocks - 1, make_body(False),
+                                      carry)
+            carry = make_body(True)(n_k_blocks - 1, carry)
+        else:
+            carry = jax.lax.fori_loop(0, n_k_blocks, make_body(False), carry)
+        m, l, acc = carry
         o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
 
-    qf = q.reshape(B * H, T, D)
-    kf = k.reshape(B * H, Tk, D)
-    vf = v.reshape(B * H, Tk, D)
+    qf = q.reshape(B * H, Tq_t, D)
+    kf = k.reshape(B * H, Tk_t, D)
+    vf = v.reshape(B * H, Tk_t, D)
 
     out = pl.pallas_call(
         kernel,
-        grid=(B * H, T // block_q),
+        grid=(B * H, Tq_t // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk_t, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk_t, D), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_t, D), q.dtype),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, T, D)
+    out = out.reshape(B, H, Tq_t, D)
+    return out[:, :, :T] if pad_q else out
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
@@ -116,18 +152,19 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
 
     if scale is None:
         scale = 1.0 / _np.sqrt(q.shape[-1])
+    if causal and q.shape[2] != k.shape[2]:
+        # alignment of query/key positions is ambiguous (top-aligned vs the
+        # KV-cache bottom-aligned convention); refuse rather than guess
+        raise ValueError(
+            "causal flash_attention requires matching q/k sequence lengths, "
+            "got %d vs %d" % (q.shape[2], k.shape[2]))
     use_pallas = _use_pallas() if interpret is None else True
-
-    def _blocks_align(q_, k_):
-        # the kernel's grid floors T/block_q and the inner loop's final
-        # dslice clamps in-bounds: a ragged tail would silently drop query
-        # rows / double-count trailing keys.  Both seq lengths must tile.
-        T, Tk = q_.shape[2], k_.shape[2]
-        return T % min(128, T) == 0 and Tk % min(128, Tk) == 0
 
     @jax.custom_vjp
     def f(q_, k_, v_):
-        if (use_pallas or interpret) and _blocks_align(q_, k_):
+        # ragged lengths stay on the fused path: the kernel pads to tile
+        # multiples and masks the tail keys itself
+        if use_pallas or interpret:
             try:
                 return _flash_attention_pallas(q_, k_, v_, causal, scale,
                                                interpret=bool(interpret))

@@ -49,3 +49,32 @@ def test_flash_attention_op_registered():
     x = nd.array(rng.normal(0, 1, (1, 2, 128, 16)).astype(np.float32))
     out = invoke("_contrib_flash_attention", [x, x, x], {"causal": True})
     assert out.shape == (1, 2, 128, 16)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("T,Tk", [(200, 200), (130, 130), (100, 100),
+                                  (160, 224)])
+def test_flash_attention_ragged_lengths(causal, T, Tk):
+    """T % 128 != 0 stays on the fused kernel: the tail q/k blocks are
+    padded to the tile size and masked, not routed to the dense fallback."""
+    if causal and T != Tk:
+        pytest.skip("causal assumes aligned q/k positions")
+    rng = np.random.RandomState(3)
+    B, H, D = 1, 2, 32
+    q = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, H, Tk, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, H, Tk, D)).astype(np.float32))
+    out_p = _flash_attention_pallas(q, k, v, causal, 1.0 / np.sqrt(D),
+                                    interpret=True)
+    out_r = _attention_reference(q, k, v, causal, 1.0 / np.sqrt(D))
+    assert out_p.shape == (B, H, T, D)
+    assert float(jnp.max(jnp.abs(out_p - out_r))) < 2e-5
+
+
+def test_flash_attention_causal_ragged_qk_rejected():
+    """causal with T != Tk has ambiguous position alignment; the entry
+    refuses loudly instead of silently top-aligning."""
+    q = jnp.zeros((1, 1, 130, 16), jnp.float32)
+    k = jnp.zeros((1, 1, 200, 16), jnp.float32)
+    with pytest.raises(ValueError, match="matching q/k"):
+        flash_attention(q, k, k, causal=True, interpret=True)
