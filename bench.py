@@ -36,19 +36,21 @@ IMG = int(os.environ.get("BENCH_IMG", "224"))
 # BENCH_MODE=train (default, the driver metric) | inference
 # (docs/faq/perf.md:150-180: 1076.81 img/s fp32 / 2085.51 fp16 on V100)
 MODE = os.environ.get("BENCH_MODE", "train")
-# BENCH_LAYOUT=NCHW (reference layout) | NHWC (TPU-native channels-last);
-# settles SURVEY §7(f) with data when run both ways on-chip
-LAYOUT = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
+# BENCH_LAYOUT=auto (default: measure NCHW first, then NHWC, report the
+# faster — settles SURVEY §7(f) with data in every driver capture) |
+# NCHW (reference layout) | NHWC (channels-last only)
+LAYOUT = os.environ.get("BENCH_LAYOUT", "auto").upper()
 if MODE not in ("train", "inference"):
     # still honor the one-JSON-line-on-stdout contract
     print(json.dumps({"metric": "invalid_bench_mode", "value": None,
                       "unit": None, "vs_baseline": None,
                       "error": "unknown BENCH_MODE=%r (train|inference)" % MODE}))
     sys.exit(1)
-if LAYOUT not in ("NCHW", "NHWC"):
+if LAYOUT not in ("AUTO", "NCHW", "NHWC"):
     print(json.dumps({"metric": "invalid_bench_layout", "value": None,
                       "unit": None, "vs_baseline": None,
-                      "error": "unknown BENCH_LAYOUT=%r (NCHW|NHWC)" % LAYOUT}))
+                      "error": "unknown BENCH_LAYOUT=%r (auto|NCHW|NHWC)"
+                               % LAYOUT}))
     sys.exit(1)
 BASELINE_IMGS_PER_SEC = 298.51 if MODE == "train" else 2085.51
 # the baseline ratio is only meaningful for the headline config
@@ -106,20 +108,23 @@ def _step_flops(compiled):
     return float(flops) if flops else None
 
 
-def main():
+def _measure(layout):
+    """Build + AOT-compile + time ResNet-50 in the given layout.
+
+    Returns {"imgs_per_sec", "flops"}; the whole measured step is one XLA
+    module (forward+backward+SGD-momentum, donated buffers) in train mode,
+    or the bf16 forward in inference mode."""
     import jax
-    devs = _init_backend()
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.gluon.block import functional_call, param_values
     from mxnet_tpu import nd
 
-    device_kind = getattr(devs[0], "device_kind", devs[0].platform)
     dtype = jnp.bfloat16
-    net = vision.resnet50_v1(classes=1000, layout=LAYOUT)
+    net = vision.resnet50_v1(classes=1000, layout=layout)
     net.initialize(mx.init.Xavier())
-    shape = (1, 3, IMG, IMG) if LAYOUT == "NCHW" else (1, IMG, IMG, 3)
+    shape = (1, 3, IMG, IMG) if layout == "NCHW" else (1, IMG, IMG, 3)
     net(nd.zeros(shape))  # materialize deferred shapes
     params = param_values(net)
 
@@ -153,27 +158,10 @@ def main():
     aux_params = {n: params[n] for n in params if n in aux_names}
 
     rng = np.random.RandomState(0)
-    xshape = (BATCH, 3, IMG, IMG) if LAYOUT == "NCHW" else (BATCH, IMG, IMG, 3)
+    xshape = (BATCH, 3, IMG, IMG) if layout == "NCHW" \
+        else (BATCH, IMG, IMG, 3)
     x = jnp.asarray(rng.uniform(-1, 1, xshape).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, BATCH).astype(np.int32))
-
-    def _emit(imgs_per_sec, flops_per_step):
-        mfu = None
-        peak = _peak_flops(device_kind)
-        if flops_per_step and peak:
-            mfu = round(flops_per_step * imgs_per_sec / BATCH / peak, 4)
-        print(json.dumps({
-            "metric": METRIC,
-            "value": round(imgs_per_sec, 2),
-            "unit": "images/sec",
-            "vs_baseline": (round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3)
-                            if IS_HEADLINE else None),
-            "mfu": mfu,
-            "step_flops": flops_per_step,
-            "device": device_kind,
-            "layout": LAYOUT,
-            "mode": MODE,
-        }))
 
     if MODE == "inference":
         # weights AND moving stats in bf16: fp32 stats would promote the
@@ -192,8 +180,8 @@ def main():
             out = compiled(all_params, x)
         out.block_until_ready()
         dt = time.perf_counter() - t0
-        _emit(BATCH * iters / dt, _step_flops(compiled))
-        return
+        return {"imgs_per_sec": BATCH * iters / dt,
+                "flops": _step_flops(compiled)}
 
     # AOT-compile the whole training iteration as one XLA module with the
     # previous step's buffers donated (params/momenta/aux update in place)
@@ -216,7 +204,52 @@ def main():
             train_params, momenta, aux_params, x, y)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
-    _emit(BATCH * iters / dt, flops)
+    return {"imgs_per_sec": BATCH * iters / dt, "flops": flops}
+
+
+def main():
+    devs = _init_backend()
+    device_kind = getattr(devs[0], "device_kind", devs[0].platform)
+
+    if LAYOUT == "AUTO":
+        # either layout alone may fail (compile/OOM) without costing the
+        # run; only both failing is an error
+        results = {}
+        errors = []
+        for layout in ("NCHW", "NHWC"):
+            try:
+                results[layout] = _measure(layout)
+            except Exception as exc:
+                print("%s measurement failed: %s" % (layout, exc),
+                      file=sys.stderr)
+                errors.append("%s: %s" % (layout, exc))
+        if not results:
+            raise RuntimeError("both layouts failed: %s" % "; ".join(errors))
+        winner = max(results, key=lambda l: results[l]["imgs_per_sec"])
+    else:
+        winner = LAYOUT
+        results = {winner: _measure(winner)}
+
+    best = results[winner]
+    imgs_per_sec = best["imgs_per_sec"]
+    mfu = None
+    peak = _peak_flops(device_kind)
+    if best["flops"] and peak:
+        mfu = round(best["flops"] * imgs_per_sec / BATCH / peak, 4)
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": (round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3)
+                        if IS_HEADLINE else None),
+        "mfu": mfu,
+        "step_flops": best["flops"],
+        "device": device_kind,
+        "layout": winner,
+        "layouts": {l: round(r["imgs_per_sec"], 2)
+                    for l, r in results.items()},
+        "mode": MODE,
+    }))
 
 
 def _error_line(msg, **extra):

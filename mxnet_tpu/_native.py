@@ -21,7 +21,7 @@ _SRC_DIR = os.path.join(_REPO_ROOT, "src")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libmxtpu.so")
 
-_SOURCES = ["recordio.cc", "pipeline.cc"]
+_SOURCES = ["recordio.cc", "pipeline.cc", "im2rec.cc"]
 
 
 def _build():
@@ -40,7 +40,9 @@ def _build():
     # session — e.g. after libjpeg gets installed.
     lib_current = (os.path.exists(_LIB_PATH)
                    and os.path.getmtime(_LIB_PATH) >= newest_src)
-    for attempt_srcs in (srcs, [s for s in srcs if "pipeline" not in s]):
+    # jpeg-dependent sources (pipeline, im2rec) drop out of the fallback
+    for attempt_srcs in (srcs, [s for s in srcs
+                                if "pipeline" not in s and "im2rec" not in s]):
         full = attempt_srcs is srcs
         if not full and lib_current:
             # full build still failing (libjpeg absent) and the fallback
@@ -111,5 +113,10 @@ def get_lib():
         lib.mxtpu_pipe_read_errors.restype = ctypes.c_int64
         lib.mxtpu_pipe_read_errors.argtypes = [ctypes.c_void_p]
         lib.mxtpu_pipe_close.argtypes = [ctypes.c_void_p]
+        # native im2rec packer (src/im2rec.cc; same jpeg dependency)
+        if hasattr(lib, "mxtpu_im2rec"):
+            lib.mxtpu_im2rec.restype = ctypes.c_int64
+            lib.mxtpu_im2rec.argtypes = [ctypes.c_char_p] * 4 \
+                + [ctypes.c_int] * 3
         _lib = lib
         return _lib

@@ -80,8 +80,9 @@ _V = [
     EnvVar("BENCH_ITERS", int, 20, "bench.py timed iterations."),
     EnvVar("BENCH_MODE", str, "train",
            "bench.py measurement: train (headline) or inference."),
-    EnvVar("BENCH_LAYOUT", str, "NCHW",
-           "bench.py conv data layout: NCHW (reference) or NHWC."),
+    EnvVar("BENCH_LAYOUT", str, "auto",
+           "bench.py conv data layout: auto (measure NCHW and NHWC, report "
+           "the faster), NCHW, or NHWC."),
     EnvVar("BENCH_BUDGET", float, 1400.0,
            "bench.py total wall-clock budget across probes and retries."),
     EnvVar("BENCH_TIMEOUT", float, 380.0,

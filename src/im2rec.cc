@@ -1,0 +1,329 @@
+// Native im2rec: pack an image listing into RecordIO (+index) with
+// multi-threaded JPEG re-encode — the tools/im2rec.cc counterpart of the
+// reference (which uses OpenCV + dmlc recordio; here libjpeg + the repo's
+// recordio writer, src/recordio.cc).
+//
+// Pipeline: one lister reads the .lst file -> N worker threads load each
+// image file, optionally decode/shorter-edge-resize/re-encode it -> a
+// writer drains results IN LIST ORDER and appends record + index entries.
+// The record payload is IRHeader{flag=0, label, id=lst index, id2=0}
+// followed by the (possibly re-encoded) image bytes — bit-compatible with
+// python mxnet_tpu/recordio.py pack()/unpack_img().
+
+#include <cstddef>   // jpeglib.h needs size_t/FILE declared first
+#include <cstdio>
+#include <csetjmp>
+
+#include <jpeglib.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* mxtpu_recio_writer_open(const char* path);
+int64_t mxtpu_recio_writer_write(void* handle, const uint8_t* data,
+                                 int64_t len);
+int64_t mxtpu_recio_writer_tell(void* handle);
+void mxtpu_recio_writer_close(void* handle);
+}
+
+namespace {
+
+struct IRHeader {          // python recordio._IR_FORMAT "IfQQ" (native)
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+static_assert(sizeof(IRHeader) == 24, "IRHeader layout must match IfQQ");
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
+                int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(static_cast<size_t>(*w) * (*h) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+                   static_cast<size_t>(cinfo.output_scanline) * (*w) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool EncodeJpeg(const std::vector<uint8_t>& rgb, int w, int h, int quality,
+                std::vector<uint8_t>* out) {
+  jpeg_compress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  unsigned char* mem = nullptr;
+  unsigned long mem_len = 0;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    if (mem) free(mem);
+    return false;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &mem, &mem_len);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    const uint8_t* row =
+        rgb.data() + static_cast<size_t>(cinfo.next_scanline) * w * 3;
+    JSAMPROW rows[1] = {const_cast<uint8_t*>(row)};
+    jpeg_write_scanlines(&cinfo, rows, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  out->assign(mem, mem + mem_len);
+  free(mem);
+  return true;
+}
+
+void ResizeBilinear(const std::vector<uint8_t>& src, int sw, int sh,
+                    std::vector<uint8_t>* dst, int dw, int dh) {
+  dst->resize(static_cast<size_t>(dw) * dh * 3);
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = fy < 0 ? 0 : static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = fx < 0 ? 0 : static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int k = 0; k < 3; ++k) {
+        float v00 = src[(static_cast<size_t>(y0) * sw + x0) * 3 + k];
+        float v01 = src[(static_cast<size_t>(y0) * sw + x1) * 3 + k];
+        float v10 = src[(static_cast<size_t>(y1) * sw + x0) * 3 + k];
+        float v11 = src[(static_cast<size_t>(y1) * sw + x1) * 3 + k];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        (*dst)[(static_cast<size_t>(y) * dw + x) * 3 + k] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct Task {
+  uint64_t idx;        // user-visible record id (first .lst column)
+  std::vector<float> labels;   // 1 = scalar header label; >1 = flag=n vector
+  std::string path;
+};
+
+struct Result {
+  uint64_t idx;
+  std::vector<uint8_t> record;  // IRHeader + image payload
+  bool ok;
+};
+
+struct Shared {
+  std::vector<Task> tasks;
+  std::atomic<size_t> next_task{0};
+  int resize;          // shorter-edge target; 0 = keep original bytes
+  int quality;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<size_t, Result> done;   // seq -> result, drained in order
+  size_t window;                   // max results parked ahead of the writer
+  size_t write_seq{0};
+};
+
+bool LoadFile(const std::string& path, std::vector<uint8_t>* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  f.seekg(0, std::ios::end);
+  std::streamoff n = f.tellg();
+  if (n < 0) return false;
+  f.seekg(0);
+  out->resize(static_cast<size_t>(n));
+  f.read(reinterpret_cast<char*>(out->data()), n);
+  return static_cast<bool>(f);
+}
+
+void Worker(Shared* sh) {
+  for (;;) {
+    size_t t = sh->next_task.fetch_add(1);
+    if (t >= sh->tasks.size()) return;
+    const Task& task = sh->tasks[t];
+    Result res;
+    res.idx = task.idx;
+    std::vector<uint8_t> payload;
+    res.ok = LoadFile(task.path, &payload);
+    if (res.ok && sh->resize > 0) {
+      std::vector<uint8_t> rgb;
+      int w = 0, h = 0;
+      if (DecodeJpeg(payload.data(), payload.size(), &rgb, &w, &h)) {
+        // shorter-edge scaling, aspect preserved (reference im2rec.cc)
+        int dw = w, dh = h;
+        if (w < h) {
+          dw = sh->resize;
+          dh = static_cast<int>(static_cast<int64_t>(h) * sh->resize / w);
+        } else {
+          dh = sh->resize;
+          dw = static_cast<int>(static_cast<int64_t>(w) * sh->resize / h);
+        }
+        if (dw != w || dh != h) {
+          std::vector<uint8_t> scaled;
+          ResizeBilinear(rgb, w, h, &scaled, dw, dh);
+          std::vector<uint8_t> jpg;
+          if (EncodeJpeg(scaled, dw, dh, sh->quality, &jpg)) payload = jpg;
+        }
+      }
+      // non-jpeg payloads (png etc.) pass through unscaled, like raw mode
+    }
+    if (res.ok) {
+      // multi-label records match python recordio.pack: flag = label
+      // count, header label 0, float32 vector prepended to the payload
+      const bool multi = task.labels.size() > 1;
+      IRHeader hdr{multi ? static_cast<uint32_t>(task.labels.size()) : 0,
+                   multi ? 0.0f : task.labels[0], task.idx, 0};
+      size_t label_bytes = multi ? task.labels.size() * sizeof(float) : 0;
+      res.record.resize(sizeof(hdr) + label_bytes + payload.size());
+      std::memcpy(res.record.data(), &hdr, sizeof(hdr));
+      if (multi)
+        std::memcpy(res.record.data() + sizeof(hdr), task.labels.data(),
+                    label_bytes);
+      std::memcpy(res.record.data() + sizeof(hdr) + label_bytes,
+                  payload.data(), payload.size());
+    }
+    std::unique_lock<std::mutex> lk(sh->mu);
+    // in-order delivery with bounded look-ahead so memory stays flat
+    sh->cv.wait(lk, [&] { return t < sh->write_seq + sh->window; });
+    sh->done.emplace(t, std::move(res));
+    sh->cv.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Packs the .lst listing into rec_path (+ idx_path unless null/empty).
+// Returns records written, or -1 on a hard error (unreadable lst/rec).
+// Unreadable image files are skipped and counted out of the return value.
+int64_t mxtpu_im2rec(const char* lst_path, const char* root,
+                     const char* rec_path, const char* idx_path,
+                     int resize, int quality, int num_threads) {
+  std::ifstream lst(lst_path);
+  if (!lst) return -1;
+  Shared sh;
+  sh.resize = resize;
+  sh.quality = quality <= 0 ? 95 : quality;
+  std::string line;
+  std::string prefix = root && root[0] ? std::string(root) + "/" : "";
+  while (std::getline(lst, line)) {
+    if (line.empty()) continue;
+    // idx \t label(s)... \t relative-path  (tab-separated, reference .lst)
+    std::vector<std::string> cols;
+    std::stringstream ss(line);
+    std::string col;
+    while (std::getline(ss, col, '\t')) cols.push_back(col);
+    if (cols.size() < 3) continue;
+    Task t;
+    t.idx = std::strtoull(cols[0].c_str(), nullptr, 10);
+    for (size_t i = 1; i + 1 < cols.size(); ++i)
+      t.labels.push_back(std::strtof(cols[i].c_str(), nullptr));
+    t.path = prefix + cols.back();
+    sh.tasks.push_back(std::move(t));
+  }
+
+  void* writer = mxtpu_recio_writer_open(rec_path);
+  if (!writer) return -1;
+  std::FILE* idx_f = nullptr;
+  if (idx_path && idx_path[0]) {
+    idx_f = std::fopen(idx_path, "w");
+    if (!idx_f) {
+      mxtpu_recio_writer_close(writer);
+      return -1;
+    }
+  }
+
+  int nt = num_threads <= 0 ? 1 : num_threads;
+  sh.window = static_cast<size_t>(nt) * 4;
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int i = 0; i < nt; ++i) threads.emplace_back(Worker, &sh);
+
+  int64_t written = 0;
+  bool io_error = false;
+  {
+    std::unique_lock<std::mutex> lk(sh.mu);
+    while (sh.write_seq < sh.tasks.size()) {
+      sh.cv.wait(lk, [&] { return sh.done.count(sh.write_seq) != 0; });
+      Result res = std::move(sh.done[sh.write_seq]);
+      sh.done.erase(sh.write_seq);
+      if (res.ok && !io_error) {
+        int64_t pos = mxtpu_recio_writer_tell(writer);
+        if (mxtpu_recio_writer_write(writer, res.record.data(),
+                                     static_cast<int64_t>(
+                                         res.record.size())) >= 0) {
+          if (idx_f) std::fprintf(idx_f, "%llu\t%lld\n",
+                                  static_cast<unsigned long long>(res.idx),
+                                  static_cast<long long>(pos));
+          ++written;
+        } else {
+          // a failed write (disk full) may leave a truncated record; the
+          // output is unusable — hard-fail instead of reporting success
+          io_error = true;
+        }
+      }
+      ++sh.write_seq;
+      sh.cv.notify_all();   // unblock workers waiting on the window
+    }
+  }
+  for (auto& th : threads) th.join();
+  if (idx_f) std::fclose(idx_f);
+  mxtpu_recio_writer_close(writer);
+  return io_error ? -1 : written;
+}
+
+}  // extern "C"

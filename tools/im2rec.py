@@ -67,10 +67,28 @@ def make_list(args):
 
 
 def write_record(args):
-    from mxnet_tpu import recordio
     lst = args.prefix + ".lst"
     frec = args.prefix + ".rec"
     fidx = args.prefix + ".idx"
+    resize = getattr(args, "resize", 0)
+    quality = getattr(args, "quality", 95)
+    num_threads = getattr(args, "num_thread", 1)
+
+    # native packer (src/im2rec.cc: threaded libjpeg re-encode, the
+    # tools/im2rec.cc analog); python path below is the fallback
+    if not getattr(args, "no_native", False):
+        from mxnet_tpu import _native
+        lib = _native.get_lib()
+        if lib is not None and hasattr(lib, "mxtpu_im2rec"):
+            n = lib.mxtpu_im2rec(lst.encode(), args.root.encode(),
+                                 frec.encode(), fidx.encode(),
+                                 int(resize), int(quality), int(num_threads))
+            if n >= 0:
+                print("packed %d records (native)" % n)
+                return
+            print("native im2rec failed; falling back to python")
+
+    from mxnet_tpu import recordio
     record = recordio.MXIndexedRecordIO(fidx, frec, "w")
     with open(lst) as fin:
         for line in fin:
@@ -80,10 +98,39 @@ def write_record(args):
             path = os.path.join(args.root, parts[-1])
             with open(path, "rb") as f:
                 img = f.read()
+            if resize:
+                img = _resize_jpeg_python(img, resize, quality)
             header = recordio.IRHeader(0, label[0] if len(label) == 1 else label,
                                        idx, 0)
             record.write_idx(idx, recordio.pack(header, img))
     record.close()
+
+
+def _resize_jpeg_python(img_bytes, shorter_edge, quality):
+    """Shorter-edge resize + re-encode via PIL.  Mirrors the native packer:
+    non-JPEG payloads and already-at-size images pass through untouched."""
+    if img_bytes[:2] != b"\xff\xd8":   # JPEG SOI marker
+        return img_bytes
+    try:
+        import io
+        from PIL import Image
+    except ImportError:
+        return img_bytes
+    try:
+        im = Image.open(io.BytesIO(img_bytes)).convert("RGB")
+    except Exception:
+        return img_bytes
+    w, h = im.size
+    if w < h:
+        dw, dh = shorter_edge, h * shorter_edge // w
+    else:
+        dw, dh = w * shorter_edge // h, shorter_edge
+    if (dw, dh) == (w, h):
+        return img_bytes
+    im = im.resize((dw, dh), Image.BILINEAR)
+    buf = io.BytesIO()
+    im.save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
 
 
 def main():
@@ -100,6 +147,14 @@ def main():
     parser.add_argument("--test-ratio", type=float, default=0)
     parser.add_argument("--recursive", action="store_true")
     parser.add_argument("--shuffle", type=bool, default=True)
+    parser.add_argument("--resize", type=int, default=0,
+                        help="shorter-edge target; 0 keeps original bytes")
+    parser.add_argument("--quality", type=int, default=95,
+                        help="JPEG re-encode quality when resizing")
+    parser.add_argument("--num-thread", type=int, default=1,
+                        help="native packer worker threads")
+    parser.add_argument("--no-native", action="store_true",
+                        help="force the pure-python packer")
     args = parser.parse_args()
     if args.list:
         make_list(args)
