@@ -207,29 +207,14 @@ def _measure(layout):
     return {"imgs_per_sec": BATCH * iters / dt, "flops": flops}
 
 
-def main():
-    devs = _init_backend()
-    device_kind = getattr(devs[0], "device_kind", devs[0].platform)
+def _emit(results, device_kind):
+    """Print the result line for whatever layouts have completed so far.
 
-    if LAYOUT == "AUTO":
-        # either layout alone may fail (compile/OOM) without costing the
-        # run; only both failing is an error
-        results = {}
-        errors = []
-        for layout in ("NCHW", "NHWC"):
-            try:
-                results[layout] = _measure(layout)
-            except Exception as exc:
-                print("%s measurement failed: %s" % (layout, exc),
-                      file=sys.stderr)
-                errors.append("%s: %s" % (layout, exc))
-        if not results:
-            raise RuntimeError("both layouts failed: %s" % "; ".join(errors))
-        winner = max(results, key=lambda l: results[l]["imgs_per_sec"])
-    else:
-        winner = LAYOUT
-        results = {winner: _measure(winner)}
-
+    Called after EVERY layout finishes — the watchdog keeps the LAST
+    parseable line, and on a timeout it salvages whatever the killed child
+    already printed, so a hang during the second measurement cannot discard
+    a finished first one (the round-2 lost-number failure mode)."""
+    winner = max(results, key=lambda l: results[l]["imgs_per_sec"])
     best = results[winner]
     imgs_per_sec = best["imgs_per_sec"]
     mfu = None
@@ -249,7 +234,27 @@ def main():
         "layouts": {l: round(r["imgs_per_sec"], 2)
                     for l, r in results.items()},
         "mode": MODE,
-    }))
+    }), flush=True)
+
+
+def main():
+    devs = _init_backend()
+    device_kind = getattr(devs[0], "device_kind", devs[0].platform)
+
+    layouts = ("NCHW", "NHWC") if LAYOUT == "AUTO" else (LAYOUT,)
+    results = {}
+    errors = []
+    for layout in layouts:
+        try:
+            results[layout] = _measure(layout)
+        except Exception as exc:
+            print("%s measurement failed: %s" % (layout, exc),
+                  file=sys.stderr)
+            errors.append("%s: %s" % (layout, exc))
+            continue
+        _emit(results, device_kind)
+    if not results:
+        raise RuntimeError("all layouts failed: %s" % "; ".join(errors))
 
 
 def _error_line(msg, **extra):
@@ -351,15 +356,19 @@ def _watchdog():
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
             stdout=subprocess.PIPE, text=True)
+        timed_out = False
         try:
             out, _ = proc.communicate(timeout=min(attempt_timeout, remaining()))
         except subprocess.TimeoutExpired:
             proc.kill()
-            proc.communicate()
+            # salvage whatever the child printed before hanging — in AUTO
+            # layout mode a completed first measurement is already a line
+            out, _ = proc.communicate()
+            out = out or ""
+            timed_out = True
             last_err = ("attempt timed out after %gs (relay dropped "
                         "mid-run?)" % attempt_timeout)
             print("attempt %d: %s" % (attempts, last_err), file=sys.stderr)
-            continue
         for line in reversed(out.splitlines()):
             line = line.strip()
             if line.startswith("{"):
@@ -373,7 +382,9 @@ def _watchdog():
                 last_err = parsed.get("error", "child reported no value")
                 break
         else:
-            last_err = "child exited rc=%s with no JSON output" % proc.returncode
+            if not timed_out:
+                last_err = ("child exited rc=%s with no JSON output"
+                            % proc.returncode)
         print("attempt %d failed: %s" % (attempts, last_err), file=sys.stderr)
         if remaining() > delay:
             time.sleep(delay)

@@ -10,6 +10,8 @@ from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange,
 from . import register as _register
 from . import random    # noqa: F401
 from . import linalg    # noqa: F401
+from . import sparse    # noqa: F401
+from .sparse import cast_storage
 
 # install one function per registered op into this module (analog of
 # _init_op_module, python/mxnet/base.py:578)

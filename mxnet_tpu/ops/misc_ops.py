@@ -1,0 +1,452 @@
+"""Remaining reference op surface: legacy loss wrappers, image utility ops,
+histogram, and the small contrib ops (quadratic/index_copy/bipartite
+matching/adaptive pooling/bilinear resize/deformable PSROI pooling).
+
+Closes the op-registration audit gaps vs the reference's NNVM_REGISTER_OP /
+MXNET_REGISTER_OP_PROPERTY list (src/operator/**) that are meaningful on
+TPU; CUDA/MKLDNN/TensorRT-internal registrations are N/A by design.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# legacy loss-layer ops
+# ---------------------------------------------------------------------------
+
+@register("MakeLoss")
+def _make_loss(attrs, data):
+    """Treat ``data`` as a loss (src/operator/make_loss.cc): forward is
+    identity; backward REPLACES the incoming gradient with grad_scale
+    (optionally normalized), which is how pre-gluon models defined custom
+    objectives."""
+    import jax
+    jnp = _jnp()
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+    norm = attrs.get("normalization", "null")
+    valid_thresh = float(attrs.get("valid_thresh", 0.0))
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        scale = jnp.asarray(grad_scale, x.dtype)
+        if norm == "batch":
+            scale = scale / x.shape[0]
+        elif norm == "valid":
+            n = jnp.sum((x > valid_thresh).astype(x.dtype))
+            scale = scale / jnp.maximum(n, 1.0)
+        return (jnp.full_like(x, scale),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("SVMOutput")
+def _svm_output(attrs, data, label):
+    """Hinge-loss output layer (src/operator/svm_output.cc): forward is
+    identity over the scores; backward ignores the head gradient and emits
+    the (squared) hinge gradient against the integer label."""
+    import jax
+    jnp = _jnp()
+    margin = float(attrs.get("margin", 1.0))
+    reg = float(attrs.get("regularization_coefficient", 1.0))
+    use_linear = bool(attrs.get("use_linear", False))
+
+    @jax.custom_vjp
+    def f(x, y):
+        return x
+
+    def fwd(x, y):
+        return x, (x, y)
+
+    def bwd(res, g):
+        x, y = res
+        B, C = x.shape
+        yi = y.astype(jnp.int32)
+        onehot = jax.nn.one_hot(yi, C, dtype=x.dtype)
+        score_y = jnp.sum(x * onehot, axis=1, keepdims=True)
+        viol = margin - score_y + x          # (B, C); j==y row gives margin
+        viol = jnp.where(onehot > 0, 0.0, viol)
+        if use_linear:
+            dx_other = (viol > 0).astype(x.dtype)
+        else:  # squared hinge: d/dx_j max(0, v)^2 = 2v
+            dx_other = jnp.where(viol > 0, 2.0 * viol, 0.0)
+        dx = reg * (dx_other - onehot * jnp.sum(dx_other, axis=1,
+                                                keepdims=True))
+        if jnp.issubdtype(y.dtype, jnp.integer) or y.dtype == jnp.bool_:
+            dy = _np.zeros(y.shape, jax.dtypes.float0)
+        else:
+            dy = jnp.zeros_like(y)
+        return dx, dy
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("Crop",
+          num_outputs=1)
+def _crop(attrs, *inputs):
+    """Spatial crop of an NCHW tensor (src/operator/crop.cc, deprecated in
+    the reference in favor of slice): target size from ``h_w`` or from a
+    second input's H/W; position from ``offset`` or center_crop."""
+    jnp = _jnp()
+    data = inputs[0]
+    _, _, H, W = data.shape
+    if len(inputs) > 1:
+        th, tw = int(inputs[1].shape[2]), int(inputs[1].shape[3])
+    else:
+        h_w = attrs.get("h_w", (0, 0))
+        th, tw = int(h_w[0]), int(h_w[1])
+    if bool(attrs.get("center_crop", False)):
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        offset = attrs.get("offset", (0, 0))
+        oy, ox = int(offset[0]), int(offset[1])
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+# ---------------------------------------------------------------------------
+# histogram
+# ---------------------------------------------------------------------------
+
+@register("_histogram", num_outputs=2)
+def _histogram(attrs, data, bins=None):
+    """np.histogram analog (src/operator/tensor/histogram.cc): either
+    ``bin_cnt`` uniform bins over ``range``, or explicit bin edges as the
+    second input.  Returns (counts, bin_edges)."""
+    jnp = _jnp()
+    flat = data.reshape(-1)
+    bin_cnt = attrs.get("bin_cnt")
+    if bin_cnt is not None:
+        n = int(bin_cnt)
+        lo, hi = attrs.get("range", (0.0, 1.0))
+        edges = jnp.linspace(float(lo), float(hi), n + 1)
+    else:
+        edges = bins
+        n = edges.shape[0] - 1
+    # index = which bin; right-inclusive last bin like numpy
+    idx = jnp.searchsorted(edges, flat, side="right") - 1
+    idx = jnp.where(flat == edges[-1], n - 1, idx)
+    valid = (idx >= 0) & (idx < n) & (flat >= edges[0]) & (flat <= edges[-1])
+    counts = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(valid, idx, 0)].add(valid.astype(jnp.int32))
+    return counts, edges
+
+
+# ---------------------------------------------------------------------------
+# image utility ops (gluon transforms' backing kernels)
+# ---------------------------------------------------------------------------
+
+@register("_image_to_tensor")
+def _image_to_tensor(attrs, data):
+    """HWC (or NHWC) uint8 [0,255] -> CHW (NCHW) float32 [0,1]
+    (src/operator/image/image_random.cc ToTensor)."""
+    jnp = _jnp()
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def _image_normalize(attrs, data):
+    """Per-channel (x - mean) / std on CHW or NCHW float input."""
+    jnp = _jnp()
+    mean = jnp.asarray(attrs.get("mean", (0.0,)), jnp.float32)
+    std = jnp.asarray(attrs.get("std", (1.0,)), jnp.float32)
+    shape = (-1, 1, 1) if data.ndim == 3 else (1, -1, 1, 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# small contrib ops
+# ---------------------------------------------------------------------------
+
+@register("_contrib_quadratic")
+def _quadratic(attrs, data):
+    """a*x^2 + b*x + c (src/operator/contrib/quadratic_op.cc — the
+    reference's tutorial op; kept for parity with code that uses it)."""
+    a = float(attrs.get("a", 0.0))
+    b = float(attrs.get("b", 0.0))
+    c = float(attrs.get("c", 0.0))
+    return a * data * data + b * data + c
+
+
+@register("_contrib_index_copy")
+def _index_copy(attrs, old, index, new):
+    """Copy rows of ``new`` into ``old`` at ``index``
+    (src/operator/contrib/index_copy.cc)."""
+    return old.at[index.astype("int32")].set(new)
+
+
+@register("_contrib_bipartite_matching", num_outputs=2)
+def _bipartite_matching(attrs, score):
+    """Greedy bipartite matching over the trailing (row, col) score matrix
+    (src/operator/contrib/bounding_box.cc BipartiteMatching; the SSD
+    anchor-to-ground-truth matcher).
+
+    Edges are visited in globally sorted score order (descending unless
+    is_ascend); a row and column pair up the first time both are free and
+    the score passes ``threshold``; ``topk`` caps matches.  Returns
+    (row_marker, col_marker): matched partner index or -1.
+
+    TPU-native: the sequential greedy scan is a lax.fori_loop over the
+    sorted edge list, vmapped over batch dims."""
+    import jax
+    from jax import lax
+    jnp = _jnp()
+    is_ascend = bool(attrs.get("is_ascend", False))
+    threshold = float(attrs["threshold"])
+    topk = int(attrs.get("topk", -1))
+
+    *batch, R, C = score.shape
+    flat = score.reshape((-1, R, C))
+
+    def one(mat):
+        s = mat.reshape(-1)
+        order = jnp.argsort(s if is_ascend else -s)
+        limit = topk if topk >= 0 else R * C
+
+        def body(i, carry):
+            row_m, col_m, n = carry
+            e = order[i]
+            r, c = e // C, e % C
+            val = s[e]
+            passes = (val >= threshold) if not is_ascend else (val <= threshold)
+            ok = passes & (row_m[r] < 0) & (col_m[c] < 0) & (n < limit)
+            row_m = row_m.at[r].set(jnp.where(ok, c, row_m[r]))
+            col_m = col_m.at[c].set(jnp.where(ok, r, col_m[c]))
+            return row_m, col_m, n + ok.astype(jnp.int32)
+
+        init = (jnp.full((R,), -1, jnp.int32), jnp.full((C,), -1, jnp.int32),
+                jnp.asarray(0, jnp.int32))
+        row_m, col_m, _ = lax.fori_loop(0, R * C, body, init)
+        return row_m.astype(score.dtype), col_m.astype(score.dtype)
+
+    row, col = jax.vmap(one)(flat)
+    return (row.reshape(tuple(batch) + (R,)),
+            col.reshape(tuple(batch) + (C,)))
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool2d(attrs, data):
+    """PyTorch-style adaptive average pooling to a fixed output size
+    (src/operator/contrib/adaptive_avg_pooling.cc): output cell (i, j)
+    averages rows floor(i*H/OH) .. ceil((i+1)*H/OH)."""
+    jnp = _jnp()
+    out_size = attrs.get("output_size")
+    N, Cc, H, W = data.shape
+    if out_size is None:
+        oh = ow = 1
+    elif isinstance(out_size, (tuple, list)):
+        oh, ow = int(out_size[0]), int(out_size[-1])
+    else:
+        oh = ow = int(out_size)
+    # masked row/col means — static output size, so the per-cell windows
+    # are compile-time constants folded into two small matmuls
+    def axis_weights(n_in, n_out):
+        w = _np.zeros((n_out, n_in), _np.float32)
+        for i in range(n_out):
+            a = (i * n_in) // n_out
+            b = -(-((i + 1) * n_in) // n_out)   # ceil
+            w[i, a:b] = 1.0 / (b - a)
+        return jnp.asarray(w)
+
+    wh = axis_weights(H, oh)        # (OH, H)
+    ww = axis_weights(W, ow)        # (OW, W)
+    t = jnp.einsum("nchw,oh->ncow", data, wh)
+    return jnp.einsum("ncow,pw->ncop", t, ww)
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize2d(attrs, data, like=None):
+    """Bilinear upsample/downsample of NCHW to (height, width)
+    (src/operator/contrib/bilinear_resize.cc; align_corners semantics —
+    scale = (in-1)/(out-1) — like the reference kernel)."""
+    jnp = _jnp()
+    N, C, H, W = data.shape
+    if like is not None:
+        oh, ow = int(like.shape[2]), int(like.shape[3])
+    else:
+        oh = int(attrs.get("height", 0)) or int(H * float(
+            attrs.get("scale_height", 1.0)))
+        ow = int(attrs.get("width", 0)) or int(W * float(
+            attrs.get("scale_width", 1.0)))
+
+    def axis_coords(n_in, n_out):
+        if n_out == 1:
+            return jnp.zeros((1,), jnp.float32)
+        scale = (n_in - 1.0) / (n_out - 1.0)
+        return jnp.arange(n_out, dtype=jnp.float32) * scale
+
+    fy = axis_coords(H, oh)
+    fx = axis_coords(W, ow)
+    y0 = jnp.clip(jnp.floor(fy).astype(jnp.int32), 0, H - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x0 = jnp.clip(jnp.floor(fx).astype(jnp.int32), 0, W - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = (fy - y0.astype(jnp.float32))[None, None, :, None]
+    wx = (fx - x0.astype(jnp.float32))[None, None, None, :]
+    rows0 = data[:, :, y0, :]
+    rows1 = data[:, :, y1, :]
+    top = rows0[:, :, :, x0] * (1 - wx) + rows0[:, :, :, x1] * wx
+    bot = rows1[:, :, :, x0] * (1 - wx) + rows1[:, :, :, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+@register("_contrib_DeformablePSROIPooling", num_outputs=2)
+def _deformable_psroi_pooling(attrs, data, rois, trans=None):
+    """Deformable position-sensitive ROI pooling (Dai et al. 2017;
+    src/operator/contrib/deformable_psroi_pooling.cu — the reference ships
+    GPU-only, CPU is NOT_IMPLEMENTED; this is the TPU implementation).
+
+    Each output bin samples sample_per_part^2 points, bilinearly
+    interpolated at positions shifted by the learned normalized offsets in
+    ``trans`` (scaled by trans_std and the ROI extent).  Returns
+    (output, top_count) like the reference (count of in-bounds samples).
+    """
+    import jax
+    jnp = _jnp()
+    scale = float(attrs.get("spatial_scale", 1.0))
+    out_dim = int(attrs["output_dim"])
+    pooled = int(attrs["pooled_size"])
+    gs = int(attrs.get("group_size", 0)) or pooled
+    part = int(attrs.get("part_size", 0)) or pooled
+    sp = int(attrs.get("sample_per_part", 1))
+    trans_std = float(attrs.get("trans_std", 0.0))
+    no_trans = bool(attrs.get("no_trans", False)) or trans is None
+
+    N, Cc, H, W = data.shape
+    R = rois.shape[0]
+
+    batch_ind = rois[:, 0].astype(jnp.int32)
+    # roi corners in feature coords, 0.5-centered like the CUDA kernel
+    x1 = jnp.round(rois[:, 1]) * scale - 0.5
+    y1 = jnp.round(rois[:, 2]) * scale - 0.5
+    x2 = (jnp.round(rois[:, 3]) + 1.0) * scale - 0.5
+    y2 = (jnp.round(rois[:, 4]) + 1.0) * scale - 0.5
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    bin_w = roi_w / pooled
+    bin_h = roi_h / pooled
+    sub_w = bin_w / sp
+    sub_h = bin_h / sp
+
+    ph = jnp.arange(pooled)
+    pw = jnp.arange(pooled)
+    part_h = (ph * part) // pooled                     # (P,)
+    part_w = (pw * part) // pooled
+
+    if no_trans:
+        tx = jnp.zeros((R, pooled, pooled))
+        ty = jnp.zeros((R, pooled, pooled))
+        ncls = 1
+    else:
+        ncls = trans.shape[1] // 2
+        # per (roi, part cell) normalized offsets; class dim folded below
+        tx_all = trans[:, 0::2, :, :] * trans_std      # (R, ncls, part, part)
+        ty_all = trans[:, 1::2, :, :] * trans_std
+
+    cpc = max(out_dim // ncls, 1)                      # channels per class
+
+    # sample grid per bin: (P, P, S, S)
+    iy = jnp.arange(sp, dtype=jnp.float32)
+    ix = jnp.arange(sp, dtype=jnp.float32)
+
+    def per_class(cls):
+        if no_trans:
+            txc, tyc = tx, ty
+        else:
+            txc = tx_all[:, cls][:, part_h][:, :, part_w]   # (R, P, P)
+            tyc = ty_all[:, cls][:, part_h][:, :, part_w]
+        # start of each bin + learned shift, then the sub-sample offsets
+        wstart = (pw[None, :] * bin_w[:, None] + x1[:, None])[:, None, :] \
+            + txc * roi_w[:, None, None]                    # (R, P, P)
+        hstart = (ph[None, :] * bin_h[:, None] + y1[:, None])[:, :, None] \
+            + tyc * roi_h[:, None, None]
+        sw = wstart[..., None, None] + (ix[None, :] + 0.5)[None, None, None] \
+            * sub_w[:, None, None, None, None]              # (R,P,P,1,S)
+        sh = hstart[..., None, None] + (iy[:, None] + 0.5)[None, None, None] \
+            * sub_h[:, None, None, None, None]              # (R,P,P,S,1)
+        sw = jnp.broadcast_to(sw, sw.shape[:3] + (sp, sp))
+        sh = jnp.broadcast_to(sh, sh.shape[:3] + (sp, sp))
+        inb = (sw > -1.0) & (sw < W) & (sh > -1.0) & (sh < H)
+        swc = jnp.clip(sw, 0.0, W - 1.0)
+        shc = jnp.clip(sh, 0.0, H - 1.0)
+        xx0 = jnp.floor(swc).astype(jnp.int32)
+        yy0 = jnp.floor(shc).astype(jnp.int32)
+        xx1 = jnp.minimum(xx0 + 1, W - 1)
+        yy1 = jnp.minimum(yy0 + 1, H - 1)
+        ax = swc - xx0
+        ay = shc - yy0
+
+        # channel for bin (c, ph, pw): (cls*cpc + c)*gs*gs + gh*gs + gw
+        gh = jnp.clip((ph * gs) // pooled, 0, gs - 1)
+        gw = jnp.clip((pw * gs) // pooled, 0, gs - 1)
+        cch = (jnp.arange(cpc)[:, None, None] + cls * cpc) * gs * gs \
+            + gh[None, :, None] * gs + gw[None, None, :]    # (cpc, P, P)
+
+        img = data[batch_ind]                               # (R, C, H, W)
+        flat_img = img.reshape(R, Cc, H * W)
+
+        def sample(yyi, xxi):
+            lin = (yyi * W + xxi).reshape(R, -1)            # (R, P*P*S*S)
+            got = jnp.take_along_axis(flat_img, lin[:, None, :], axis=2)
+            return got.reshape(R, Cc, pooled, pooled, sp, sp)
+
+        v00 = sample(yy0, xx0)
+        v01 = sample(yy0, xx1)
+        v10 = sample(yy1, xx0)
+        v11 = sample(yy1, xx1)
+        val = (v00 * (1 - ay[:, None]) * (1 - ax[:, None])
+               + v01 * (1 - ay[:, None]) * ax[:, None]
+               + v10 * ay[:, None] * (1 - ax[:, None])
+               + v11 * ay[:, None] * ax[:, None])           # (R,C,P,P,S,S)
+        val = jnp.where(inb[:, None], val, 0.0)
+        cnt = jnp.sum(inb, axis=(-1, -2)).astype(data.dtype)  # (R, P, P)
+        summed = jnp.sum(val, axis=(-1, -2))                # (R, C, P, P)
+        picked = summed[jnp.arange(R)[:, None, None, None],
+                        cch[None], ph[None, None, :, None],
+                        pw[None, None, None, :]]            # (R, cpc, P, P)
+        out = jnp.where(cnt[:, None] > 0, picked / jnp.maximum(
+            cnt[:, None], 1.0), 0.0)
+        return out, jnp.broadcast_to(cnt[:, None], out.shape)
+
+    outs, counts = zip(*(per_class(cls) for cls in range(ncls)))
+    out = jnp.concatenate(outs, axis=1)[:, :out_dim]
+    top_count = jnp.concatenate(counts, axis=1)[:, :out_dim] \
+        .astype(data.dtype)
+    return out, top_count
+
+
+alias("_contrib_MultiProposal", "_contrib_Proposal")
+# the reference registers these with a leading underscore
+alias("_ravel_multi_index", "ravel_multi_index")
+alias("_unravel_index", "unravel_index")
+
+# Audit closure — reference registrations deliberately NOT mirrored here:
+#   *_v1 / CuDNNBatchNorm / _sg_mkldnn_conv / _trt_op: legacy or
+#     CUDA/MKLDNN/TensorRT-internal, no TPU meaning.
+#   _NDArray/_Native/_CrossDeviceCopy/name/_zeros_without_dtype/
+#     _identity_with_attr_like_rhs/_rnn_param_concat/_broadcast_backward/
+#     _contrib_backward_*: internal NNVM graph nodes; jax.vjp and the
+#     tracer replace them.
+#   _cond/_foreach/_while_loop: mxnet_tpu.contrib.control_flow (lax.cond/
+#     scan/while_loop) is the op surface.
+#   cast_storage/_sparse_retain/_contrib_SparseEmbedding: nd.cast_storage,
+#     nd.sparse.retain and ndarray/sparse.sparse_embedding (NDArray-level
+#     by design — storage type is not a traced property).
+#   _slice_assign(_scalar): NDArray.__setitem__.

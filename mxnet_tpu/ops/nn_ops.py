@@ -196,10 +196,12 @@ def _pooling(attrs, data):
     pads = ([(0, 0)] + spads + [(0, 0)]) if channel_last \
         else ([(0, 0), (0, 0)] + spads)
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        init = _np.array(-_np.inf if jnp.issubdtype(data.dtype, jnp.floating)
+                         else jnp.iinfo(data.dtype).min, data.dtype)
         return lax.reduce_window(data, init, lax.max, window, strides, pads)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        s = lax.reduce_window(data, _np.array(0.0, data.dtype), lax.add,
+                              window, strides, pads)
         if pool_type == "sum":
             return s
         if bool(attrs.get("count_include_pad", True)):

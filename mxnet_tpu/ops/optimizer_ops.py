@@ -171,3 +171,31 @@ def _ftml_update(attrs, weight, grad, d, v, z):
     z_new = beta1 * z + (1 - beta1) * g - sigma * weight
     w = -z_new / d_new
     return w, d_new, v_new, z_new
+
+
+@register("_contrib_group_adagrad_update", num_outputs=2)
+def _group_adagrad_update(attrs, weight, grad, history):
+    """Group AdaGrad (src/operator/contrib/optimizer_op.cc): ONE history
+    scalar per row — history[i] += mean(grad[i]^2) — so embedding tables
+    pay O(rows) state instead of O(elements)."""
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    eps = float(attrs.get("epsilon", 1e-5))
+    g = _prep_grad(jnp, grad, rescale, clip)
+    red_axes = tuple(range(1, g.ndim))
+    new_h = history + jnp.mean(g * g, axis=red_axes).reshape(history.shape)
+    denom = jnp.sqrt(new_h + eps).reshape((-1,) + (1,) * (g.ndim - 1))
+    return weight - lr * g / denom, new_h
+
+
+@register("_sparse_adagrad_update", num_outputs=2)
+def _sparse_adagrad_update(attrs, weight, grad, history):
+    """Dense fallback of the row-sparse AdaGrad update (optimizer_op.cc
+    AdagradUpdateEx): elementwise history, used when the gradient has been
+    densified; the row-sparse path applies the same math per stored row."""
+    jnp = _jnp()
+    lr, wd, rescale, clip = _common(attrs)
+    eps = float(attrs.get("epsilon", 1e-7))
+    g = _prep_grad(jnp, grad, rescale, clip)
+    new_h = history + g * g
+    return weight - lr * g / (jnp.sqrt(new_h) + eps), new_h

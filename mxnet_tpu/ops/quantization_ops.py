@@ -158,3 +158,27 @@ def _quantized_conv(attrs, *inputs):
     out_min = jnp.min(out).reshape((1,))
     out_max = jnp.max(out).reshape((1,))
     return out, out_min, out_max
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def _quantized_flatten(attrs, data, min_data, max_data):
+    """Flatten int8 data, passing the quantization range through unchanged
+    (quantized_flatten.cc) — shape-only, no requantization."""
+    return data.reshape((data.shape[0], -1)), min_data, max_data
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def _quantized_pooling(attrs, data, min_data, max_data):
+    """Pool int8 data directly (quantized_pooling.cc): max pooling is
+    order-preserving so the int8 codes pool as-is; avg pooling averages the
+    codes (same scale).  Range passes through unchanged."""
+    from . import nn_ops
+    jnp = _jnp()
+    pool_type = attrs.get("pool_type", "max")
+    if pool_type == "max":
+        out = nn_ops._pooling(attrs, data)
+    else:
+        # average in int32 then round back to int8 (same scale)
+        acc = nn_ops._pooling(dict(attrs), data.astype(jnp.float32))
+        out = jnp.clip(jnp.round(acc), -128, 127).astype(data.dtype)
+    return out, min_data, max_data

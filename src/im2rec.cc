@@ -93,15 +93,21 @@ bool EncodeJpeg(const std::vector<uint8_t>& rgb, int w, int h, int quality,
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = JpegErrExit;
-  unsigned char* mem = nullptr;
-  unsigned long mem_len = 0;
+  // the output buffer pointer is modified by libjpeg between setjmp and a
+  // potential longjmp; route every access through a volatile pointer to a
+  // memory-resident holder so the error-path read is defined behavior
+  struct MemHolder {
+    unsigned char* p = nullptr;
+    unsigned long n = 0;
+  } holder;
+  MemHolder* volatile hp = &holder;
   if (setjmp(jerr.jb)) {
     jpeg_destroy_compress(&cinfo);
-    if (mem) free(mem);
+    if (hp->p) free(hp->p);
     return false;
   }
   jpeg_create_compress(&cinfo);
-  jpeg_mem_dest(&cinfo, &mem, &mem_len);
+  jpeg_mem_dest(&cinfo, &hp->p, &hp->n);
   cinfo.image_width = w;
   cinfo.image_height = h;
   cinfo.input_components = 3;
@@ -117,8 +123,8 @@ bool EncodeJpeg(const std::vector<uint8_t>& rgb, int w, int h, int quality,
   }
   jpeg_finish_compress(&cinfo);
   jpeg_destroy_compress(&cinfo);
-  out->assign(mem, mem + mem_len);
-  free(mem);
+  out->assign(hp->p, hp->p + hp->n);
+  free(hp->p);
   return true;
 }
 
@@ -261,6 +267,11 @@ int64_t mxtpu_im2rec(const char* lst_path, const char* root,
   std::string line;
   std::string prefix = root && root[0] ? std::string(root) + "/" : "";
   while (std::getline(lst, line)) {
+    // tolerate CRLF / trailing whitespace (a Windows-written .lst must not
+    // silently produce paths ending in '\r')
+    while (!line.empty() && (line.back() == '\r' || line.back() == '\n' ||
+                             line.back() == ' ' || line.back() == '\t'))
+      line.pop_back();
     if (line.empty()) continue;
     // idx \t label(s)... \t relative-path  (tab-separated, reference .lst)
     std::vector<std::string> cols;

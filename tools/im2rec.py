@@ -80,12 +80,21 @@ def write_record(args):
         from mxnet_tpu import _native
         lib = _native.get_lib()
         if lib is not None and hasattr(lib, "mxtpu_im2rec"):
+            with open(lst) as f:
+                expected = sum(1 for line in f
+                               if len(line.strip().split("\t")) >= 3)
             n = lib.mxtpu_im2rec(lst.encode(), args.root.encode(),
                                  frec.encode(), fidx.encode(),
                                  int(resize), int(quality), int(num_threads))
-            if n >= 0:
+            if n == expected:
                 print("packed %d records (native)" % n)
                 return
+            if n >= 0:
+                # partial pack = unreadable image files; fail loudly like
+                # the python path's open() would, instead of silently
+                # shipping a dataset with holes
+                raise IOError("native im2rec packed %d of %d records "
+                              "(unreadable image files?)" % (n, expected))
             print("native im2rec failed; falling back to python")
 
     from mxnet_tpu import recordio
