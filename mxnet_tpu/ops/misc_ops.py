@@ -132,7 +132,12 @@ def _histogram(attrs, data, bins=None):
     bin_cnt = attrs.get("bin_cnt")
     if bin_cnt is not None:
         n = int(bin_cnt)
-        lo, hi = attrs.get("range", (0.0, 1.0))
+        if attrs.get("range") is None:
+            # silently assuming a range would drop out-of-range data; the
+            # reference errors here too ("null range is not supported")
+            raise ValueError("_histogram with bin_cnt requires an explicit "
+                             "range=(min, max)")
+        lo, hi = attrs["range"]
         edges = jnp.linspace(float(lo), float(hi), n + 1)
     else:
         edges = bins
@@ -377,13 +382,15 @@ def _deformable_psroi_pooling(attrs, data, rois, trans=None):
             + txc * roi_w[:, None, None]                    # (R, P, P)
         hstart = (ph[None, :] * bin_h[:, None] + y1[:, None])[:, :, None] \
             + tyc * roi_h[:, None, None]
-        sw = wstart[..., None, None] + (ix[None, :] + 0.5)[None, None, None] \
+        # sample positions iw*sub (no half-offset) and (-0.5, dim-0.5)
+        # bounds, matching deformable_psroi_pooling.cu:144-150
+        sw = wstart[..., None, None] + ix[None, :][None, None, None] \
             * sub_w[:, None, None, None, None]              # (R,P,P,1,S)
-        sh = hstart[..., None, None] + (iy[:, None] + 0.5)[None, None, None] \
+        sh = hstart[..., None, None] + iy[:, None][None, None, None] \
             * sub_h[:, None, None, None, None]              # (R,P,P,S,1)
         sw = jnp.broadcast_to(sw, sw.shape[:3] + (sp, sp))
         sh = jnp.broadcast_to(sh, sh.shape[:3] + (sp, sp))
-        inb = (sw > -1.0) & (sw < W) & (sh > -1.0) & (sh < H)
+        inb = (sw >= -0.5) & (sw <= W - 0.5) & (sh >= -0.5) & (sh <= H - 0.5)
         swc = jnp.clip(sw, 0.0, W - 1.0)
         shc = jnp.clip(sh, 0.0, H - 1.0)
         xx0 = jnp.floor(swc).astype(jnp.int32)

@@ -198,4 +198,6 @@ def _sparse_adagrad_update(attrs, weight, grad, history):
     eps = float(attrs.get("epsilon", 1e-7))
     g = _prep_grad(jnp, grad, rescale, clip)
     new_h = history + g * g
-    return weight - lr * g / (jnp.sqrt(new_h) + eps), new_h
+    # epsilon inside the sqrt, like the reference kernel
+    # (optimizer_op-inl.h:1707 AdagradDnsRspDnsKernel)
+    return weight - lr * g / jnp.sqrt(new_h + eps), new_h
