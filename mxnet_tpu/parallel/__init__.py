@@ -25,3 +25,5 @@ from .ring_attention import ring_attention, sequence_parallel_attention
 from .pipeline import pipeline_apply, make_pipeline_step
 from .ulysses import ulysses_attention_local, ulysses_parallel_attention
 from .moe import moe_apply, make_expert_parallel_moe
+from .checkpoint import (save_sharded, restore_sharded,
+                         SlicedCheckpointManager)

@@ -1,0 +1,118 @@
+"""Sharded multi-chip checkpoint/resume (SURVEY §5 checkpoint subsystem).
+
+The reference checkpoints through host-gathered binary dumps
+(python/mxnet/model.py:383-413 save_checkpoint + ndarray.cc Save/Load) —
+fine for one GPU, but on a pod a replicated gather of every parameter
+through one host is the wrong shape.  TPU-native equivalent: orbax writes
+each shard from the host that owns it (OCDBT/zarr under the hood), and
+restore re-lays the arrays out onto ANY target mesh/sharding — so a
+checkpoint taken on a (dp=4, tp=2) mesh resumes on (dp=2, tp=4), a bigger
+slice, or one chip.
+
+Single-chip interchange with the reference's ``.params`` format stays in
+``mxnet_tpu.ndarray.serialization``; this module is the scale path.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_sharded", "restore_sharded", "SlicedCheckpointManager"]
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+def save_sharded(path, tree, force=True):
+    """Write a pytree of (possibly sharded) jax Arrays under ``path``.
+
+    Every entry is written with its sharding metadata; sharded arrays are
+    written shard-by-shard from their owning devices (no host gather)."""
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=force)
+
+
+def restore_sharded(path, template=None, shardings=None):
+    """Read a checkpoint back.
+
+    template: a pytree of arrays or jax.ShapeDtypeStruct giving the target
+    structure.  shardings: optional matching pytree of NamedSharding that
+    re-lays the restored arrays onto a (possibly different) mesh — the
+    elastic-resume path.  With neither, restores host-replicated arrays."""
+    import jax
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is None:
+            return ckptr.restore(path)
+        if shardings is not None:
+            template = jax.tree.map(
+                lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                                  sharding=s),
+                template, shardings)
+        else:
+            template = jax.tree.map(
+                lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), template)
+        return ckptr.restore(path, template)
+
+
+class SlicedCheckpointManager:
+    """Keep the latest N step checkpoints of params + optimizer state
+    (the Module.save_checkpoint / do_checkpoint analog for sharded
+    training loops)."""
+
+    def __init__(self, directory, max_to_keep=3):
+        ocp = _ocp()
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 enable_async_checkpointing=False))
+
+    def save(self, step, params, opt_state=None):
+        ocp = _ocp()
+        items = {"params": ocp.args.StandardSave(params)}
+        if opt_state is not None:
+            items["opt_state"] = ocp.args.StandardSave(opt_state)
+        self._mgr.save(step, args=ocp.args.Composite(**items))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def restore(self, step=None, params_template=None, opt_template=None,
+                shardings=None, opt_shardings=None):
+        """``shardings``/``opt_shardings`` re-lay params / optimizer state
+        onto a target mesh; each must match its own template's tree."""
+        import jax
+        ocp = _ocp()
+        step = self._mgr.latest_step() if step is None else step
+
+        def spec(tree, shard_tree):
+            if tree is None:
+                return None
+            if shard_tree is not None:
+                return jax.tree.map(
+                    lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                                      sharding=s),
+                    tree, shard_tree)
+            return jax.tree.map(
+                lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), tree)
+
+        items = {}
+        if params_template is not None:
+            items["params"] = ocp.args.StandardRestore(
+                spec(params_template, shardings))
+        if opt_template is not None:
+            items["opt_state"] = ocp.args.StandardRestore(
+                spec(opt_template, opt_shardings))
+        if items:
+            out = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        else:
+            out = self._mgr.restore(step)
+        return out
+
+    def close(self):
+        self._mgr.close()
