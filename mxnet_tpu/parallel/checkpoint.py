@@ -41,13 +41,25 @@ def restore_sharded(path, template=None, shardings=None):
     template: a pytree of arrays or jax.ShapeDtypeStruct giving the target
     structure.  shardings: optional matching pytree of NamedSharding that
     re-lays the restored arrays onto a (possibly different) mesh — the
-    elastic-resume path.  With neither, restores host-replicated arrays."""
+    elastic-resume path.  With neither, the structure is read from the
+    checkpoint's own metadata and every array lands on one local device
+    (host-replicated) — safe even when the saving topology no longer
+    exists."""
     import jax
     ocp = _ocp()
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         if template is None:
-            return ckptr.restore(path)
+            # structure comes from the checkpoint's own metadata; land every
+            # array on one local device so the saved topology need not exist
+            from etils import epath
+            meta = ocp.StandardCheckpointHandler().metadata(epath.Path(path))
+            one_dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+            template = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                                               sharding=one_dev),
+                meta.tree, is_leaf=lambda m: hasattr(m, "shape"))
+            return ckptr.restore(path, template)
         if shardings is not None:
             template = jax.tree.map(
                 lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype,
@@ -88,7 +100,11 @@ class SlicedCheckpointManager:
         onto a target mesh; each must match its own template's tree."""
         import jax
         ocp = _ocp()
-        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    "no checkpoint found in %s" % self._mgr.directory)
 
         def spec(tree, shard_tree):
             if tree is None:

@@ -1,0 +1,34 @@
+"""The BASELINE.json sequence config (example/gluon transformer LM) stays
+runnable: trains the causal flash-attention decoder on synthetic patterns."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *args],
+        env=env, cwd=REPO, timeout=timeout, capture_output=True, text=True)
+
+
+def test_transformer_lm_example_trains():
+    res = _run("example/gluon/transformer_lm.py", "--steps", "40",
+               "--seq-len", "32", "--dim", "32")
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "next-token accuracy" in res.stdout
+
+
+def test_transformer_lm_sequence_parallel_mode():
+    res = _run("example/gluon/transformer_lm.py", "--steps", "10",
+               "--seq-len", "32", "--dim", "32",
+               "--sequence-parallel", "4",
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=4"})
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ring vs fused attention" in res.stdout
