@@ -51,8 +51,12 @@ class SyncBatchNorm(BatchNorm):
                  epsilon=1e-5, center=True, scale=True, use_global_stats=False,
                  beta_initializer="zeros", gamma_initializer="ones",
                  running_mean_initializer="zeros",
-                 running_variance_initializer="ones", **kwargs):
-        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                 running_variance_initializer="ones", axis=None, **kwargs):
+        if axis is None:
+            # 1, or -1 inside nn.channels_last() — like plain BatchNorm
+            from ...nn.conv_layers import default_batchnorm_axis
+            axis = default_batchnorm_axis()
+        super().__init__(axis=axis, momentum=momentum, epsilon=epsilon,
                          center=center, scale=scale,
                          use_global_stats=use_global_stats,
                          beta_initializer=beta_initializer,

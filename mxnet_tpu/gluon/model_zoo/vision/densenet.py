@@ -4,11 +4,15 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ...nn import (HybridSequential, Conv2D, MaxPool2D, AvgPool2D, BatchNorm,
                    Activation, Dense, GlobalAvgPool2D, Flatten, Dropout)
+from ...nn.conv_layers import default_batchnorm_axis
 
 
 class _DenseLayer(HybridBlock):
     def __init__(self, growth_rate, bn_size, dropout):
         super().__init__(prefix="")
+        # channel axis captured at construction (1, or -1 under
+        # nn.channels_last()) — dense connectivity concats features there
+        self._channel_axis = default_batchnorm_axis()
         self.body = HybridSequential(prefix="")
         self.body.add(BatchNorm())
         self.body.add(Activation("relu"))
@@ -21,7 +25,7 @@ class _DenseLayer(HybridBlock):
 
     def hybrid_forward(self, F, x):
         out = self.body(x)
-        return F.concat(x, out, dim=1)
+        return F.concat(x, out, dim=self._channel_axis)
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):

@@ -35,12 +35,15 @@ class _Concurrent(HybridBlock):
 
     def __init__(self, prefix=""):
         super().__init__(prefix=prefix)
+        from ...nn.conv_layers import default_batchnorm_axis
+        self._channel_axis = default_batchnorm_axis()
 
     def add(self, block):
         self.register_child(block)
 
     def hybrid_forward(self, F, x):
-        return F.concat(*[child(x) for child in self._children.values()], dim=1)
+        return F.concat(*[child(x) for child in self._children.values()],
+                        dim=self._channel_axis)
 
 
 def _make_A(pool_features, prefix):
@@ -91,6 +94,8 @@ def _make_D(prefix):
 class _BranchE(HybridBlock):
     def __init__(self, prefix=""):
         super().__init__(prefix=prefix)
+        from ...nn.conv_layers import default_batchnorm_axis
+        self._channel_axis = default_batchnorm_axis()
         self.base = None
         self.left = None
         self.right = None
@@ -98,7 +103,7 @@ class _BranchE(HybridBlock):
     def hybrid_forward(self, F, x):
         if self.base is not None:
             x = self.base(x)
-        return F.concat(self.left(x), self.right(x), dim=1)
+        return F.concat(self.left(x), self.right(x), dim=self._channel_axis)
 
 
 def _make_E(prefix):

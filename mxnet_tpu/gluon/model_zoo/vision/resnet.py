@@ -9,9 +9,11 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ...nn import (HybridSequential, Conv2D, BatchNorm, Activation, Dense,
                    GlobalAvgPool2D, MaxPool2D)
+from ...nn.conv_layers import _resolve_layout
 
 
-def _conv3x3(channels, stride, in_channels, layout="NCHW"):
+def _conv3x3(channels, stride, in_channels, layout=None):
+    layout = _resolve_layout(layout, 2)
     return Conv2D(channels, kernel_size=3, strides=stride, padding=1,
                   use_bias=False, in_channels=in_channels, layout=layout)
 
@@ -21,8 +23,9 @@ def _bn_axis(layout):
 
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
+        layout = _resolve_layout(layout, 2)
         ax = _bn_axis(layout)
         self.body = HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels, layout))
@@ -49,8 +52,9 @@ class BasicBlockV1(HybridBlock):
 
 class BottleneckV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
+        layout = _resolve_layout(layout, 2)
         ax = _bn_axis(layout)
         self.body = HybridSequential(prefix="")
         self.body.add(Conv2D(channels // 4, kernel_size=1, strides=stride,
@@ -81,8 +85,9 @@ class BottleneckV1(HybridBlock):
 
 class BasicBlockV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
+        layout = _resolve_layout(layout, 2)
         ax = _bn_axis(layout)
         self.bn1 = BatchNorm(axis=ax)
         self.conv1 = _conv3x3(channels, stride, in_channels, layout)
@@ -109,8 +114,9 @@ class BasicBlockV2(HybridBlock):
 
 class BottleneckV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
+        layout = _resolve_layout(layout, 2)
         ax = _bn_axis(layout)
         self.bn1 = BatchNorm(axis=ax)
         self.conv1 = Conv2D(channels // 4, kernel_size=1, strides=1,
@@ -144,9 +150,10 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        layout = _resolve_layout(layout, 2)
         ax = _bn_axis(layout)
         with self.name_scope():
             self.features = HybridSequential(prefix="")
@@ -168,7 +175,7 @@ class ResNetV1(HybridBlock):
             self.output = Dense(classes, in_units=channels[-1])
 
     def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0, layout="NCHW"):
+                    in_channels=0, layout="NCHW"):  # parent always passes
         layer = HybridSequential(prefix="stage%d_" % stage_index)
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,
@@ -186,9 +193,10 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        layout = _resolve_layout(layout, 2)
         ax = _bn_axis(layout)
         with self.name_scope():
             self.features = HybridSequential(prefix="")

@@ -15,13 +15,16 @@ def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
     class Fire(HybridBlock):
         def __init__(self):
             super().__init__(prefix="")
+            from ...nn.conv_layers import default_batchnorm_axis
+            self._channel_axis = default_batchnorm_axis()
             self.squeeze = out
             self.left = left
             self.right = right
 
         def hybrid_forward(self, F, x):
             x = self.squeeze(x)
-            return F.concat(self.left(x), self.right(x), dim=1)
+            return F.concat(self.left(x), self.right(x),
+                            dim=self._channel_axis)
 
     return Fire()
 

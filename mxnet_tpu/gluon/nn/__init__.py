@@ -7,4 +7,4 @@ from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
                           MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
                           GlobalMaxPool1D, GlobalMaxPool2D, GlobalMaxPool3D,
                           GlobalAvgPool1D, GlobalAvgPool2D, GlobalAvgPool3D,
-                          ReflectionPad2D)
+                          ReflectionPad2D, channels_last)

@@ -169,11 +169,15 @@ class BatchNorm(HybridBlock):
     folds them into running stats — a pure-value update that the CachedOp
     captures as aux outputs when hybridized."""
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0, **kwargs):
         super().__init__(**kwargs)
+        if axis is None:
+            # 1 (reference default), or -1 inside nn.channels_last()
+            from .conv_layers import default_batchnorm_axis
+            axis = default_batchnorm_axis()
         self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
                         "fix_gamma": not scale,
                         "use_global_stats": use_global_stats}

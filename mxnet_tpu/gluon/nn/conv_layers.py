@@ -1,9 +1,62 @@
 """Convolution and pooling layers (reference: python/mxnet/gluon/nn/conv_layers.py)."""
 from __future__ import annotations
 
+import contextvars
+from contextlib import contextmanager
+
 import numpy as _np
 
 from ..block import HybridBlock
+
+# construction-time default data layout: channel-first matches the
+# reference; the channels_last() scope flips every conv/pool/batchnorm
+# BUILT inside it to the TPU-preferred channel-last layout without
+# per-layer plumbing (explicit layout=/axis= arguments always win)
+_channels_last_scope = contextvars.ContextVar("mxnet_tpu_channels_last",
+                                              default=False)
+
+_CHANNEL_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+_CHANNEL_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+
+
+@contextmanager
+def channels_last(active=True):
+    """Scope under which conv/pool layers default to channel-last layouts
+    and BatchNorm to axis=-1 — build any model (the whole model_zoo
+    included) channel-last::
+
+        with nn.channels_last():
+            net = vision.mobilenet1_0()
+
+    Channel-last is the layout XLA prefers on TPU (no edge transposes
+    around the convs); weights store as (O, *kernel, I) and initializers
+    draw in canonical order, so results match the channel-first build.
+    Transposed convs keep channel-first (op limitation, documented)."""
+    token = _channels_last_scope.set(bool(active))
+    try:
+        yield
+    finally:
+        _channels_last_scope.reset(token)
+
+
+def _resolve_layout(layout, rank, channel_last_ok=True):
+    if layout is not None:
+        return layout
+    if _channels_last_scope.get():
+        if not channel_last_ok:
+            # silent channel-first inside the scope would convolve over the
+            # wrong axes downstream; make the limitation loud
+            raise ValueError(
+                "transposed convolutions do not support channel-last "
+                "layouts; pass an explicit layout= (e.g. 'NCHW') to build "
+                "one inside nn.channels_last()")
+        return _CHANNEL_LAST[rank]
+    return _CHANNEL_FIRST[rank]
+
+
+def default_batchnorm_axis():
+    """1 (reference default) or -1 inside a channels_last() scope."""
+    return -1 if _channels_last_scope.get() else 1
 
 
 def _pair(v, n):
@@ -27,6 +80,9 @@ class _Conv(HybridBlock):
             if isinstance(kernel_size, int):
                 kernel_size = (kernel_size,)
             self._kernel = tuple(kernel_size)
+            layout = _resolve_layout(
+                layout, len(self._kernel),
+                channel_last_ok=(op_name or "Convolution") == "Convolution")
             nd_ = len(self._kernel)
             self._strides = _pair(strides, nd_)
             self._padding = _pair(padding, nd_)
@@ -120,7 +176,7 @@ class _Conv(HybridBlock):
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
-                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 groups=1, layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -132,7 +188,7 @@ class Conv1D(_Conv):
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 dilation=(1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -145,7 +201,7 @@ class Conv2D(_Conv):
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -157,7 +213,7 @@ class Conv3D(_Conv):
 
 class Conv1DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 output_padding=0, dilation=1, groups=1, layout=None,
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -171,7 +227,7 @@ class Conv1DTranspose(_Conv):
 class Conv2DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout="NCHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -185,7 +241,7 @@ class Conv2DTranspose(_Conv):
 class Conv3DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), output_padding=(0, 0, 0), dilation=(1, 1, 1),
-                 groups=1, layout="NCDHW", activation=None, use_bias=True,
+                 groups=1, layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -200,6 +256,7 @@ class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
                  pool_type, layout, count_include_pad=None, **kwargs):
         super().__init__(**kwargs)
+        layout = _resolve_layout(layout, len(pool_size))
         if strides is None:
             strides = pool_size
         if isinstance(strides, int):
@@ -229,14 +286,14 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__((pool_size,) if isinstance(pool_size, int) else pool_size,
                          strides, padding, ceil_mode, False, "max", layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
@@ -246,7 +303,7 @@ class MaxPool2D(_Pooling):
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
+                 layout=None, ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
         super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
@@ -254,7 +311,7 @@ class MaxPool3D(_Pooling):
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__((pool_size,) if isinstance(pool_size, int) else pool_size,
                          strides, padding, ceil_mode, False, "avg", layout,
@@ -262,7 +319,7 @@ class AvgPool1D(_Pooling):
 
 
 class AvgPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
@@ -272,7 +329,7 @@ class AvgPool2D(_Pooling):
 
 class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
+                 layout=None, ceil_mode=False, count_include_pad=True, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
         super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
@@ -280,32 +337,32 @@ class AvgPool3D(_Pooling):
 
 
 class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, 0, True, True, "avg", layout, **kwargs)
 
 

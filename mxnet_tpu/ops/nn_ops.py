@@ -240,7 +240,7 @@ def _bn_apply(attrs, data, gamma, beta, mean, var):
     """Shared affine-normalize step of BatchNorm/SyncBatchNorm."""
     jnp = _jnp()
     eps = float(attrs.get("eps", 1e-3))
-    axis = int(attrs.get("axis", 1))
+    axis = int(attrs.get("axis", 1)) % data.ndim  # -1 = channel-last
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
     if bool(attrs.get("fix_gamma", True)):
@@ -257,7 +257,7 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     returned mean/var are the batch statistics; the caller folds them into the
     running averages (functional aux-state update — see gluon/nn BatchNorm)."""
     jnp = _jnp()
-    axis = int(attrs.get("axis", 1))
+    axis = int(attrs.get("axis", 1)) % data.ndim  # -1 = channel-last
     use_global = bool(attrs.get("use_global_stats", False)) or not attrs.get("_training", False)
     if use_global:
         mean, var = moving_mean, moving_var
@@ -919,10 +919,11 @@ def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     use_global = (bool(attrs.get("use_global_stats", False))
                   or not attrs.get("_training", False))
     axis_name = attrs.get("axis_name", "dp")
+    channel_axis = int(attrs.get("axis", 1)) % data.ndim
     if use_global:
         mean, var = moving_mean, moving_var
     else:
-        axes = (0,) + tuple(range(2, data.ndim))
+        axes = tuple(i for i in range(data.ndim) if i != channel_axis)
         mean = jnp.mean(data, axis=axes)
         sq = jnp.mean(jnp.square(data), axis=axes)
         try:  # inside shard_map/pmap with the axis bound: cross-device stats

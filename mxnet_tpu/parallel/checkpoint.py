@@ -42,19 +42,25 @@ def restore_sharded(path, template=None, shardings=None):
     structure.  shardings: optional matching pytree of NamedSharding that
     re-lays the restored arrays onto a (possibly different) mesh — the
     elastic-resume path.  With neither, the structure is read from the
-    checkpoint's own metadata and every array lands on one local device
-    (host-replicated) — safe even when the saving topology no longer
-    exists."""
+    checkpoint's own metadata and every array lands on the host CPU (one
+    accelerator only if no CPU backend is registered) — an inspection
+    path that works when the saving topology no longer exists, not sized
+    for pod-scale params (those should restore with target shardings)."""
     import jax
     ocp = _ocp()
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         if template is None:
-            # structure comes from the checkpoint's own metadata; land every
-            # array on one local device so the saved topology need not exist
+            # structure comes from the checkpoint's own metadata; prefer a
+            # host CPU device so accelerator HBM never has to hold the
+            # whole (possibly pod-sized) tree
             from etils import epath
             meta = ocp.StandardCheckpointHandler().metadata(epath.Path(path))
-            one_dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+            try:
+                dev = jax.devices("cpu")[0]
+            except RuntimeError:
+                dev = jax.devices()[0]
+            one_dev = jax.sharding.SingleDeviceSharding(dev)
             template = jax.tree.map(
                 lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
                                                sharding=one_dev),
