@@ -1,0 +1,79 @@
+"""End-to-end data-to-model integration: im2rec-packed JPEGs ->
+ImageRecordIter (native C++ decode pipeline when available) -> Module.fit
+-> above-chance accuracy.  Pins the full reference training journey
+(SURVEY §3.3 + §3.5 call stacks composed)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def packed_dataset(tmp_path_factory):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+    root = tmp_path_factory.mktemp("rio")
+    imgdir = root / "imgs"
+    imgdir.mkdir()
+    rng = np.random.RandomState(0)
+    # class 0 = dark images, class 1 = bright images (learnable from pixels)
+    lines = []
+    for i in range(64):
+        cls = i % 2
+        base = 40 if cls == 0 else 200
+        arr = np.clip(rng.normal(base, 20, (16, 16, 3)), 0, 255).astype(np.uint8)
+        Image.fromarray(arr).save(imgdir / ("s%02d.jpg" % i), quality=95)
+        lines.append("%d\t%d\timgs/s%02d.jpg" % (i, cls, i))
+    lst = root / "data.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         str(root / "data"), str(root)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    return str(root / "data.rec")
+
+
+def test_module_fit_from_recordio(packed_dataset):
+    it = mx.io.ImageRecordIter(path_imgrec=packed_dataset,
+                               data_shape=(3, 16, 16), batch_size=8,
+                               shuffle=True, label_name="softmax_label")
+    data = mx.sym.var("data") * (1.0 / 255.0)   # raw uint8-scale pixels
+    net = mx.sym.FullyConnected(mx.sym.flatten(data), num_hidden=16)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=4,
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.init.Xavier())
+    it.reset()
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9, "brightness classes should be separable: acc=%s" % acc
+
+
+def test_gluon_dataloader_from_recordio(packed_dataset):
+    """Same .rec through the gluon data path (ImageRecordDataset +
+    DataLoader + transform)."""
+    from mxnet_tpu import gluon
+    ds = gluon.data.vision.ImageRecordDataset(packed_dataset)
+    n_bright = 0
+    loader = gluon.data.DataLoader(
+        ds.transform_first(lambda im: im.astype("float32") / 255.0),
+        batch_size=16)
+    total = 0
+    for x, y in loader:
+        assert x.shape[1:] == (16, 16, 3)
+        bright = x.asnumpy().mean(axis=(1, 2, 3)) > 0.45
+        n_bright += int((bright == (y.asnumpy() == 1)).sum())
+        total += x.shape[0]
+    assert total == 64
+    assert n_bright > 58  # labels ride with the right images
