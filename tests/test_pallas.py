@@ -78,3 +78,31 @@ def test_flash_attention_causal_ragged_qk_rejected():
     k = jnp.zeros((1, 1, 200, 16), jnp.float32)
     with pytest.raises(ValueError, match="matching q/k"):
         flash_attention(q, k, k, causal=True, interpret=True)
+
+
+def test_rtc_pallas_module_user_kernel():
+    """mx.rtc.PallasModule is the runtime-kernel extension point (the
+    CudaModule analog): a user-written pallas kernel launches on NDArrays."""
+    from jax.experimental import pallas as pl
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    def scaled_add_kernel(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+
+    def scaled_add(x, y):
+        return pl.pallas_call(
+            scaled_add_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True,  # CPU CI; compiles natively on TPU
+        )(x, y)
+
+    mod = mx.rtc.PallasModule({"scaled_add": scaled_add})
+    kern = mod.get_kernel("scaled_add")
+    a = nd.array(np.arange(8.0, dtype=np.float32))
+    b = nd.ones((8,))
+    out = kern.launch([a, b])
+    np.testing.assert_allclose(out.asnumpy(), np.arange(8.0) * 2 + 1)
+
+    with pytest.raises(NotImplementedError):
+        mx.rtc.CudaModule("__global__ void k() {}")

@@ -77,3 +77,48 @@ def test_gluon_dataloader_from_recordio(packed_dataset):
         total += x.shape[0]
     assert total == 64
     assert n_bright > 58  # labels ride with the right images
+
+
+def test_channels_last_training_from_native_nhwc_pipeline(packed_dataset):
+    """The full TPU-preferred path composed: native C++ decode pipeline
+    hands uint8 NHWC batches -> channels_last() model consumes them with
+    no transpose anywhere -> gluon training separates the classes."""
+    from mxnet_tpu import gluon, nd, autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import _native
+    lib = _native.get_lib()
+    if lib is None or not hasattr(lib, "mxtpu_pipe_open"):
+        pytest.skip("native pipeline unavailable")
+
+    it = mx.io.ImageRecordIter(path_imgrec=packed_dataset,
+                               data_shape=(3, 16, 16), batch_size=8,
+                               backend="native", layout="NHWC")
+    with nn.channels_last():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(8, 3, padding=1, activation="relu"))
+            net.add(nn.GlobalAvgPool2D())
+            net.add(nn.Flatten())
+            net.add(nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(6):
+        it.reset()
+        for batch in it:
+            x = batch.data[0].astype("float32") / 255.0
+            assert x.shape[1:] == (16, 16, 3), x.shape   # NHWC end to end
+            y = batch.label[0]
+            with autograd.record():
+                loss = ce(net(x), y).mean()
+            loss.backward()
+            trainer.step(x.shape[0])
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        x = batch.data[0].astype("float32") / 255.0
+        pred = net(x).asnumpy().argmax(1)
+        correct += int((pred == batch.label[0].asnumpy()).sum())
+        total += x.shape[0]
+    assert correct / total > 0.9, correct / total
