@@ -57,6 +57,9 @@ _V = [
            "Override for DMLC_PS_ROOT_URI."),
     EnvVar("MX_KV_ROOT_PORT", int, None,
            "Override for DMLC_PS_ROOT_PORT."),
+    EnvVar("MX_KV_INIT_TIMEOUT", float, 120.0,
+           "Seconds each worker waits in the dist-kvstore rendezvous before "
+           "failing with a diagnosis (barrier health at init)."),
     # --- memory / recompute -----------------------------------------------
     EnvVar("MXNET_BACKWARD_DO_MIRROR", bool, False,
            "Recompute activations in backward instead of saving them "

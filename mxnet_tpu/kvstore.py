@@ -255,10 +255,24 @@ class KVStoreDist(KVStoreTPUSync):
                 "dist kvstore with %d workers but no coordinator address: "
                 "set MX_KV_ROOT_URI (or DMLC_PS_ROOT_URI), e.g. via "
                 "tools/launch.py" % self._num_workers)
-        jax.distributed.initialize(
-            coordinator_address="%s:%s" % (coord, port),
-            num_processes=self._num_workers,
-            process_id=self._rank)
+        timeout = float(_env.get("MX_KV_INIT_TIMEOUT"))
+        try:
+            jax.distributed.initialize(
+                coordinator_address="%s:%s" % (coord, port),
+                num_processes=self._num_workers,
+                process_id=self._rank,
+                initialization_timeout=int(timeout))
+        except Exception as exc:
+            # barrier-health-at-init (SURVEY §5): a worker that never
+            # arrives should fail THIS process with an actionable message,
+            # not hang the job
+            raise MXNetError(
+                "dist kvstore rendezvous failed: rank %d of %d could not "
+                "join coordinator %s:%s within %gs (%s). Check that all "
+                "workers launched (tools/launch.py -n %d) and the "
+                "coordinator address is reachable."
+                % (self._rank, self._num_workers, coord, port, timeout,
+                   exc, self._num_workers)) from exc
         self._initialized_dist = True
 
     @property

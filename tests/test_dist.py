@@ -77,3 +77,32 @@ def test_dist_bandwidth_tool_2_workers():
     assert rec["metric"] == "kvstore_dist_sync_allreduce"
     assert rec["workers"] == 2
     assert rec["value"] > 0
+
+
+def test_dist_rendezvous_timeout_diagnosis():
+    """A worker whose peers never arrive fails FAST instead of hanging
+    (SURVEY §5 barrier health at init).  jax's coordination client
+    terminates the process from C++ on deadline (LOG(FATAL) in client.h),
+    so the contract observable from outside is: non-zero exit within the
+    configured timeout, stderr naming the deadline; the MXNetError wrapper
+    in kvstore._init_distributed covers the python-visible failure modes
+    (bad address, misconfiguration)."""
+    import time
+    env = dict(os.environ)
+    # rank 1 = a CLIENT whose coordinator never comes up (rank 0's own
+    # failure is a hard abort inside the C++ coordination service)
+    env.update({"JAX_PLATFORMS": "cpu", "MX_KV_NUM_WORKERS": "2",
+                "MX_KV_RANK": "1", "MX_KV_ROOT_URI": "127.0.0.1",
+                "MX_KV_ROOT_PORT": str(_free_port()),
+                "MX_KV_INIT_TIMEOUT": "5"})
+    env.pop("XLA_FLAGS", None)
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import mxnet_tpu as mx; mx.kv.create('dist_sync')")
+    t0 = time.monotonic()
+    res = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         timeout=120, capture_output=True, text=True)
+    elapsed = time.monotonic() - t0
+    assert res.returncode != 0
+    assert elapsed < 60, "rendezvous hung instead of timing out: %gs" % elapsed
+    assert ("DEADLINE_EXCEEDED" in res.stderr
+            or "rendezvous failed" in res.stderr), res.stderr[-500:]
