@@ -30,13 +30,22 @@ def _use_pallas():
 # flash attention
 # ---------------------------------------------------------------------------
 
+def _causal_offset(causal, Tq, Tk):
+    """Key-position offset of the causal diagonal: query i attends keys
+    j <= i + offset.  'top' aligns query 0 with key 0 (offset 0); 'bottom'
+    is the KV-cache decode convention (the last query sees every key,
+    offset Tk - Tq).  The two coincide when Tq == Tk."""
+    return Tk - Tq if causal == "bottom" else 0
+
+
 def _attention_reference(q, k, v, causal, scale):
     import jax
     import jax.numpy as jnp
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
-        T = q.shape[2]
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        Tq, Tk = q.shape[2], k.shape[2]
+        off = _causal_offset(causal, Tq, Tk)
+        mask = (jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None] + off)
         s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
@@ -70,6 +79,7 @@ def _flash_attention_pallas(q, k, v, causal, scale, block_q=128, block_k=128,
     Tq_t, Tk_t = T + pad_q, Tk + pad_k
     n_k_blocks = Tk_t // block_k
     k_tail = bool(pad_k)  # static: tail masking compiled in only if needed
+    c_off = _causal_offset(causal, T, Tk)  # offsets use UNPADDED lengths
 
     def kernel(q_ref, k_ref, v_ref, o_ref):
         qi = pl.program_id(1)
@@ -93,7 +103,7 @@ def _flash_attention_pallas(q, k, v, causal, scale, block_q=128, block_k=128,
                     if causal:
                         q_pos = qi * block_q + jax.lax.broadcasted_iota(
                             jnp.int32, (block_q, block_k), 0)
-                        keep &= q_pos >= k_pos
+                        keep &= q_pos + c_off >= k_pos
                     if with_tail:
                         keep &= k_pos < Tk  # padded keys contribute nothing
                     s = jnp.where(keep, s, -1e30)
@@ -109,8 +119,8 @@ def _flash_attention_pallas(q, k, v, causal, scale, block_q=128, block_k=128,
         carry = (m, l, acc)
         if causal:
             # per-row masks are computed anyway; fold the tail predicate in
-            upper = jax.lax.min(n_k_blocks,
-                                (qi + 1) * block_q // block_k + 1)
+            upper = jax.lax.clamp(0, ((qi + 1) * block_q + c_off) // block_k
+                                  + 1, n_k_blocks)
             carry = jax.lax.fori_loop(0, upper, make_body(k_tail), carry)
         elif k_tail:
             # peel the final block: interior blocks skip the mask entirely
@@ -146,18 +156,34 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
     """Fused attention entry: Pallas kernel on TPU, XLA reference elsewhere.
 
     q/k/v: (B, H, T, D).  Differentiable: custom_vjp with the reference
-    backward (recompute-based, XLA-fused)."""
+    backward (recompute-based, XLA-fused).
+
+    ``causal`` may be False, True, 'top', or 'bottom'.  With mismatched q/k
+    lengths the diagonal's alignment is ambiguous, so bare ``True`` refuses
+    and the caller must say which convention they mean: 'top' aligns query 0
+    with key 0; 'bottom' is the KV-cache decode convention (the last query
+    sees every key) — e.g. ``causal='bottom'`` for T=1, Tk=n decode."""
     import jax
     import jax.numpy as jnp
 
     if scale is None:
         scale = 1.0 / _np.sqrt(q.shape[-1])
-    if causal and q.shape[2] != k.shape[2]:
-        # alignment of query/key positions is ambiguous (top-aligned vs the
-        # KV-cache bottom-aligned convention); refuse rather than guess
+    # identity checks: 1/1.0 would sneak past an `in` test via 1 == True
+    if not (causal is False or causal is True
+            or causal in ("top", "bottom")):
+        raise ValueError("causal must be False/True/'top'/'bottom', got %r"
+                         % (causal,))
+    if causal is True and q.shape[2] != k.shape[2]:
         raise ValueError(
-            "causal flash_attention requires matching q/k sequence lengths, "
-            "got %d vs %d" % (q.shape[2], k.shape[2]))
+            "causal=True is ambiguous for q/k lengths %d vs %d: pass "
+            "causal='top' (align query 0 with key 0) or causal='bottom' "
+            "(KV-cache decode: last query sees every key)"
+            % (q.shape[2], k.shape[2]))
+    if causal == "bottom" and q.shape[2] > k.shape[2]:
+        # queries before the first key would attend nothing (0/0 rows)
+        raise ValueError(
+            "causal='bottom' needs q length <= k length, got %d vs %d"
+            % (q.shape[2], k.shape[2]))
     use_pallas = _use_pallas() if interpret is None else True
 
     @jax.custom_vjp

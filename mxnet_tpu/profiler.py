@@ -117,12 +117,17 @@ def merge_dumps(filenames, out=None):
     open_spans = defaultdict(list)
     agg = defaultdict(lambda: [0, 0.0])
     for ev in sorted(events, key=lambda e: e.get("ts", 0)):
-        key = (ev.get("pid"), ev.get("tid"), ev["name"])
+        name = ev.get("name")
+        if name is None or ev.get("ph") not in ("B", "E"):
+            # external tools emit name-less metadata ('M') events; skip
+            # anything that isn't a named duration span
+            continue
+        key = (ev.get("pid"), ev.get("tid"), name)
         if ev.get("ph") == "B":
             open_spans[key].append(ev["ts"])
-        elif ev.get("ph") == "E" and open_spans[key]:
+        elif open_spans[key]:
             begin = open_spans[key].pop()
-            entry = agg[ev["name"]]
+            entry = agg[name]
             entry[0] += 1
             entry[1] += (ev["ts"] - begin) / 1e3
     lines = ["%-40s %10s %14s %14s" % ("Name", "Calls", "Total(ms)",

@@ -72,6 +72,25 @@ def test_profiler_aggregate_and_objects(tmp_path):
     assert any("mytask" in str(n) for n in names)
 
 
+def test_merge_dumps_skips_nameless_metadata_events(tmp_path):
+    """Chrome traces from external tools carry name-less 'M' metadata
+    events; merge_dumps must skip them rather than KeyError."""
+    import json
+    trace = {"traceEvents": [
+        {"ph": "M", "pid": 1, "args": {"labels": "external"}},  # no name
+        {"ph": "B", "pid": 1, "tid": 0, "name": "op", "ts": 10},
+        {"ph": "E", "pid": 1, "tid": 0, "name": "op", "ts": 1010},
+        {"ph": "X", "pid": 1, "tid": 0, "name": "complete", "ts": 5,
+         "dur": 3},  # complete events are not B/E spans; skipped
+    ]}
+    fn = str(tmp_path / "rank0.json")
+    with open(fn, "w") as f:
+        json.dump(trace, f)
+    table = mx.profiler.merge_dumps([fn])
+    assert "op" in table
+    assert "1.000" in table  # 1000 us span -> 1.000 ms
+
+
 # ------------------------------------------------------------------- monitor
 
 def test_monitor_taps_outputs():

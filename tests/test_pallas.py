@@ -58,7 +58,7 @@ def test_flash_attention_ragged_lengths(causal, T, Tk):
     """T % 128 != 0 stays on the fused kernel: the tail q/k blocks are
     padded to the tile size and masked, not routed to the dense fallback."""
     if causal and T != Tk:
-        pytest.skip("causal assumes aligned q/k positions")
+        causal = "bottom"  # bare True is ambiguous for mismatched lengths
     rng = np.random.RandomState(3)
     B, H, D = 1, 2, 32
     q = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
@@ -72,12 +72,52 @@ def test_flash_attention_ragged_lengths(causal, T, Tk):
 
 
 def test_flash_attention_causal_ragged_qk_rejected():
-    """causal with T != Tk has ambiguous position alignment; the entry
-    refuses loudly instead of silently top-aligning."""
+    """Bare causal=True with T != Tk has ambiguous position alignment; the
+    entry refuses loudly and names the two explicit conventions."""
     q = jnp.zeros((1, 1, 130, 16), jnp.float32)
     k = jnp.zeros((1, 1, 200, 16), jnp.float32)
-    with pytest.raises(ValueError, match="matching q/k"):
+    with pytest.raises(ValueError, match="ambiguous"):
         flash_attention(q, k, k, causal=True, interpret=True)
+
+
+@pytest.mark.parametrize("align", ["top", "bottom"])
+def test_flash_attention_causal_alignment(align):
+    """Explicit 'top'/'bottom' alignment resolves the ragged-causal case:
+    'bottom' is the KV-cache decode convention (last query sees every key),
+    'top' aligns query 0 with key 0."""
+    rng = np.random.RandomState(4)
+    B, H, T, Tk, D = 1, 2, 96, 224, 32
+    q = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, H, Tk, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, H, Tk, D)).astype(np.float32))
+    out_p = _flash_attention_pallas(q, k, v, align, 1.0 / np.sqrt(D),
+                                    interpret=True)
+    out_r = _attention_reference(q, k, v, align, 1.0 / np.sqrt(D))
+    assert float(jnp.max(jnp.abs(out_p - out_r))) < 2e-5
+    # reference semantics spot-check against an explicit dense mask
+    off = Tk - T if align == "bottom" else 0
+    mask = (np.arange(Tk)[None, :] <= np.arange(T)[:, None] + off)
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q),
+                  np.asarray(k)) / np.sqrt(D)
+    s = np.where(mask[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    dense = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out_r), dense, atol=2e-5)
+
+
+def test_flash_attention_kv_cache_decode():
+    """T=1 decode against a long KV cache: causal='bottom' attends every
+    key (== non-causal for a single query) and works through the entry."""
+    rng = np.random.RandomState(5)
+    B, H, Tk, D = 1, 2, 200, 32
+    q = jnp.asarray(rng.normal(0, 1, (B, H, 1, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, H, Tk, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, H, Tk, D)).astype(np.float32))
+    out = flash_attention(q, k, v, causal="bottom", interpret=True)
+    full = flash_attention(q, k, v, causal=False, interpret=True)
+    assert out.shape == (B, H, 1, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full), atol=2e-5)
 
 
 def test_rtc_pallas_module_user_kernel():

@@ -308,3 +308,42 @@ def test_lstmp_cell():
     assert outs[-1].shape == (B, 3)          # projected
     assert states[0].shape == (B, 3)         # h: projection size
     assert states[1].shape == (B, 8)         # c: hidden size
+
+
+def test_container_override_pushes_down_original_dict_only():
+    """A container built with an explicit params dict pushes its ORIGINAL
+    dict into each child — one child's own params must not leak into a
+    sibling through the container's running merge (reference rnn_cell.py
+    SequentialRNNCell.add semantics)."""
+    from mxnet_tpu.rnn.rnn_cell import (BaseRNNCell, SequentialRNNCell,
+                                        RNNParams)
+    from mxnet_tpu import symbol as sym
+
+    class _EagerCell(BaseRNNCell):
+        # builds its weight via _params directly (keeping _own_params True),
+        # modeling a custom cell that creates params in __init__
+        def __init__(self, prefix):
+            super().__init__(prefix=prefix)
+            self._w = self._params.get("w")
+
+        @property
+        def state_info(self):
+            return []
+
+        def __call__(self, inputs, states):
+            return inputs, states
+
+    shared = RNNParams("stack_")
+    shared._params["stack_shared"] = sym.Variable("stack_shared")
+    left, right = _EagerCell("l_"), _EagerCell("r_")
+    stack = SequentialRNNCell(params=shared)
+    stack.add(left)
+    stack.add(right)
+    # the container's original dict reaches every child...
+    assert "stack_shared" in left._params._params
+    assert "stack_shared" in right._params._params
+    # ...but a sibling's own params must not ride along
+    assert "l_w" not in right._params._params
+    assert "r_w" not in left._params._params
+    # while the container itself aggregates everything
+    assert {"l_w", "r_w", "stack_shared"} <= set(stack._params._params)

@@ -81,8 +81,11 @@ def write_record(args):
         lib = _native.get_lib()
         if lib is not None and hasattr(lib, "mxtpu_im2rec"):
             with open(lst) as f:
+                # count with the same trailing-only strip the native parser
+                # (src/im2rec.cc) uses, so a line with leading whitespace is
+                # judged identically on both sides
                 expected = sum(1 for line in f
-                               if len(line.strip().split("\t")) >= 3)
+                               if len(line.rstrip().split("\t")) >= 3)
             n = lib.mxtpu_im2rec(lst.encode(), args.root.encode(),
                                  frec.encode(), fidx.encode(),
                                  int(resize), int(quality), int(num_threads))
@@ -101,7 +104,11 @@ def write_record(args):
     record = recordio.MXIndexedRecordIO(fidx, frec, "w")
     with open(lst) as fin:
         for line in fin:
-            parts = line.strip().split("\t")
+            # same trailing-only strip + >=3-column filter as the native
+            # parser, so both paths accept an identical record set
+            parts = line.rstrip().split("\t")
+            if len(parts) < 3:
+                continue
             idx = int(parts[0])
             label = [float(x) for x in parts[1:-1]]
             path = os.path.join(args.root, parts[-1])

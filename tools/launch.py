@@ -79,8 +79,6 @@ def main():
     parser.add_argument("-H", "--hostfile", default=None)
     parser.add_argument("--env-server-port", default="9876")
     # REMAINDER: everything after the launcher's own options belongs to the
-    # worker command verbatim, including its dashed flags
-    # REMAINDER: everything after the launcher's own options belongs to the
     # worker command verbatim, including its dashed flags — so launcher
     # options must come BEFORE the command
     parser.add_argument("command", nargs=argparse.REMAINDER)

@@ -1,0 +1,197 @@
+"""Opportunistic TPU benchmark capture (round-4, VERDICT.md item 1).
+
+The axon TPU relay has been down during every end-of-round driver capture
+window (BENCH_r01..r03 all null), yet it WAS up mid-round-2 (the in-session
+8,587 img/s measurement).  Waiting for the end-of-round window is therefore
+the losing strategy: this watcher runs for the whole session, probes the
+relay cheaply every POLL_S seconds, and the moment a probe succeeds it
+immediately runs the full capture battery:
+
+  1. bench.py           (train, BENCH_LAYOUT=auto -> NCHW + NHWC, MFU)
+  2. bench.py inference (BENCH_MODE=inference, bf16)
+  3. tools/bandwidth.py (on-chip tpu_sync allreduce GB/s)
+
+Every resulting JSON line is appended to BENCH_LIVE.json with a timestamp
+and the probe evidence; every probe (success or failure) is logged to
+PROBE_LOG_r04.txt.  The watcher exits 0 once the whole battery has
+succeeded at least once (so the session can commit the artifact), or exits
+3 at DEADLINE_S with the probe log as evidence that every relay window was
+tried.
+
+Usage:  python tools/relay_watcher.py [--poll 240] [--deadline 39600]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIVE_PATH = os.path.join(REPO, "BENCH_LIVE.json")
+LOG_PATH = os.path.join(REPO, "PROBE_LOG_r04.txt")
+
+_PROBE_SRC = """
+import os, sys
+import jax
+plat = os.environ.get("JAX_PLATFORMS")
+if plat:
+    jax.config.update("jax_platforms", plat)
+devs = jax.devices()
+print("PROBE_OK %s %d %s" % (devs[0].platform, len(devs),
+                             getattr(devs[0], "device_kind", "?")))
+"""
+
+
+def _now():
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + "Z"
+
+
+def _log(msg):
+    line = "%s %s" % (_now(), msg)
+    print(line, flush=True)
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s=45):
+    """Return 'platform kind' string if backend init returns, else None.
+
+    A down relay hangs jax.devices() in native code, so the probe is a
+    disposable subprocess the parent can kill."""
+    proc = subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True, cwd=REPO)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in out.splitlines():
+        if line.startswith("PROBE_OK"):
+            return line[len("PROBE_OK "):].strip()
+    return None
+
+
+def _run_capture(name, cmd, env_extra, timeout_s):
+    """Run one battery item; return its last parseable JSON line (with a
+    non-null value) or None."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    _log("capture %s: %s" % (name, " ".join(cmd)))
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            cwd=REPO, env=env)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        out = out or ""
+        _log("capture %s TIMED OUT after %gs (salvaging output)"
+             % (name, timeout_s))
+    for line in reversed((out or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("value") is not None:
+                rec["captured_at"] = _now()
+                rec["capture"] = name
+                _log("capture %s OK: %s=%s %s" % (
+                    name, rec.get("metric"), rec.get("value"),
+                    rec.get("unit")))
+                return rec
+            _log("capture %s failed: %s" % (name, rec.get("error")))
+            return None
+    _log("capture %s produced no JSON (rc=%s)" % (name, proc.returncode))
+    return None
+
+
+def _append_live(records):
+    existing = []
+    if os.path.exists(LIVE_PATH):
+        try:
+            with open(LIVE_PATH) as f:
+                existing = json.load(f).get("captures", [])
+        except Exception:
+            pass
+    existing.extend(records)
+    with open(LIVE_PATH, "w") as f:
+        json.dump({"captures": existing,
+                   "probe_log": os.path.basename(LOG_PATH),
+                   "updated_at": _now()}, f, indent=1)
+    _log("BENCH_LIVE.json updated (%d total captures)" % len(existing))
+
+
+BATTERY = [
+    # (name, cmd, env, timeout) — bench.py's own watchdog handles retry
+    # within each item; the budget here is per-item wall clock
+    ("train_auto", [sys.executable, "bench.py"],
+     {"BENCH_LAYOUT": "auto", "BENCH_BUDGET": "1100",
+      "BENCH_TIMEOUT": "500"}, 1200),
+    ("inference", [sys.executable, "bench.py"],
+     {"BENCH_MODE": "inference", "BENCH_BUDGET": "700",
+      "BENCH_TIMEOUT": "340"}, 800),
+    ("bandwidth_onchip", [sys.executable, "tools/bandwidth.py",
+                          "--size-mb", "64", "--copies", "4"],
+     {}, 400),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--poll", type=float, default=240.0,
+                    help="seconds between relay probes")
+    ap.add_argument("--deadline", type=float, default=39600.0,
+                    help="give up after this many seconds")
+    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    args = ap.parse_args()
+
+    t0 = time.monotonic()
+    done = set()  # battery items that have succeeded at least once
+    _log("watcher start: poll=%gs deadline=%gs battery=%s"
+         % (args.poll, args.deadline, [b[0] for b in BATTERY]))
+    n_probe = n_fail = 0
+    while time.monotonic() - t0 < args.deadline:
+        n_probe += 1
+        got = probe(args.probe_timeout)
+        if got is None:
+            n_fail += 1
+            _log("probe %d FAILED (relay down), %d/%d failed so far"
+                 % (n_probe, n_fail, n_probe))
+        else:
+            _log("probe %d OK: %s — relay is UP, running battery" %
+                 (n_probe, got))
+            new = []
+            for name, cmd, env, timeout_s in BATTERY:
+                if name in done:
+                    continue
+                rec = _run_capture(name, cmd, env, timeout_s)
+                if rec is not None:
+                    rec["device_probe"] = got
+                    new.append(rec)
+                    done.add(name)
+                else:
+                    # relay may have dropped mid-battery; re-probe before
+                    # burning time on the remaining items
+                    if probe(args.probe_timeout) is None:
+                        _log("relay dropped mid-battery; back to polling")
+                        break
+            if new:
+                _append_live(new)
+            if len(done) == len(BATTERY):
+                _log("full battery captured (%d items); watcher done"
+                     % len(done))
+                return 0
+        time.sleep(args.poll)
+    _log("deadline reached: %d probes, %d failed, captured=%s"
+         % (n_probe, n_fail, sorted(done)))
+    return 3 if len(done) < len(BATTERY) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
