@@ -1,0 +1,165 @@
+"""Load reference-trained checkpoints into model-zoo blocks.
+
+Reference counterpart: python/mxnet/gluon/model_zoo/model_store.py:77-120
+(``get_model_file`` downloads a ``.params`` file which ``vision/__init__.py:91``
+feeds to ``net.load_params``).  This build is zero-egress, so instead of a
+download root the zoo accepts ``pretrained=<path>`` pointing at a staged
+``.params`` file — any file the reference ecosystem produced:
+
+- gluon ``save_parameters`` dumps (dotted structural names) load directly;
+- gluon 1.x ``save_params`` / model-store dumps (block-prefix names like
+  ``resnetv10_batchnorm0_gamma``) and Module checkpoints (``arg:``/``aux:``
+  prefixes) go through a structural name-mapping: parameters are paired by
+  kind (weight/bias/gamma/.../running_var) in construction order with shape
+  checking, which is exact because the zoo blocks mirror the reference
+  architectures child-for-child.
+
+Channel-last models work too: ``Parameter._load_init`` permutes canonical
+NCHW conv weights into the stored (O, spatial..., I) layout on the way in.
+"""
+from ... import ndarray as nd
+
+# reference parameter-name suffixes -> this repo's (BatchNorm moving_* is
+# the reference's pre-gluon spelling); longest suffix wins
+_KIND_ALIASES = [
+    ("moving_mean", "running_mean"),
+    ("moving_var", "running_var"),
+    ("running_mean", "running_mean"),
+    ("running_var", "running_var"),
+    ("weight", "weight"),
+    ("gamma", "gamma"),
+    ("bias", "bias"),
+    ("beta", "beta"),
+]
+
+
+def _kind(name):
+    for suffix, canon in _KIND_ALIASES:
+        if name.endswith(suffix):
+            return canon
+    return None
+
+
+def map_reference_params(loaded, params):
+    """Map reference-layout checkpoint keys onto a block's dotted names.
+
+    ``loaded``: dict name -> NDArray from ``nd.load`` (any reference naming
+    scheme).  ``params``: the block's ``_collect_params_with_prefix`` dict
+    (insertion-ordered = construction order).  Returns {target_name: array}.
+
+    Strategy: strip Module ``arg:``/``aux:`` prefixes; if the keys already
+    match the dotted names, pass through.  Otherwise pair parameters of the
+    same kind in order — both naming schemes enumerate parameters in
+    construction order, and grouping by kind makes the pairing robust to the
+    arg/aux split reordering of Module checkpoints.  Shape mismatches (after
+    allowing a channel-last permutation) fail loudly with both names.
+    """
+    stripped = {}
+    for name, arr in loaded.items():
+        if name.startswith("arg:") or name.startswith("aux:"):
+            name = name[4:]
+        stripped[name] = arr
+    if set(stripped) >= set(params):
+        return {name: stripped[name] for name in params}
+
+    by_kind_src = {}
+    for name, arr in stripped.items():
+        kind = _kind(name)
+        if kind is None:
+            raise ValueError(
+                "cannot map checkpoint key %r: unrecognized parameter kind "
+                "(expected a weight/bias/gamma/beta/running-stat suffix)"
+                % name)
+        by_kind_src.setdefault(kind, []).append((name, arr))
+    by_kind_dst = {}
+    for name in params:
+        kind = _kind(name)
+        if kind is None:
+            raise ValueError("cannot map onto parameter %r: unrecognized "
+                             "kind suffix" % name)
+        by_kind_dst.setdefault(kind, []).append(name)
+
+    mapped = {}
+    for kind, dst_names in by_kind_dst.items():
+        src = by_kind_src.get(kind, [])
+        if len(src) != len(dst_names):
+            raise ValueError(
+                "checkpoint/model mismatch for kind %r: file has %d, model "
+                "needs %d (is this checkpoint for a different architecture?)"
+                % (kind, len(src), len(dst_names)))
+        for dst, (src_name, arr) in zip(dst_names, src):
+            p = params[dst]
+            if p.shape and not any(s == 0 for s in p.shape):
+                pshape, ashape = tuple(p.shape), tuple(arr.shape)
+                perm = getattr(p, "init_perm", None)
+                if pshape != ashape and not (
+                        perm is not None and
+                        tuple(ashape[j] for j in perm) == pshape):
+                    raise ValueError(
+                        "shape mismatch mapping %r -> %r: %s vs %s (in-order "
+                        "kind pairing failed; architectures differ?)"
+                        % (src_name, dst, ashape, pshape))
+            mapped[dst] = arr
+    extra = set(by_kind_src) - set(by_kind_dst)
+    if extra:
+        raise ValueError("checkpoint has parameter kinds %s the model lacks"
+                         % sorted(extra))
+    return mapped
+
+
+def load_pretrained(net, pretrained, ctx=None):
+    """The ``pretrained=`` hook shared by every model-zoo family.
+
+    ``pretrained`` must be a path to a staged ``.params`` file;
+    ``pretrained=True`` (the reference's download-from-model-store mode)
+    raises — this build has no egress (reference model_store.py:77 would
+    fetch from the model zoo bucket).
+    """
+    if pretrained is True:
+        raise NotImplementedError(
+            "pretrained=True needs the reference model-store download, and "
+            "this build is zero-egress: stage the .params file and pass "
+            "pretrained='/path/to/file.params' instead")
+    loaded = nd.load(str(pretrained))
+    params = net._collect_params_with_prefix()
+    mapped = map_reference_params(loaded, params)
+    canonical = _file_is_canonical(pretrained, params, mapped)
+    for name, arr in mapped.items():
+        params[name]._load_init(arr, ctx, prefer_canonical=canonical)
+
+
+def _file_is_canonical(pretrained, params, mapped):
+    """Decide ONCE per file whether its conv weights are canonical (NCHW,
+    any reference checkpoint) or already in this model's stored layout (a
+    channels_last model saved with ``save_parameters`` and reloaded through
+    ``pretrained=``).  A per-tensor guess would silently scramble kernels
+    whose spatial dims equal their in-channels (both interpretations fit);
+    unambiguous kernels elsewhere in the file settle the vote."""
+    canonical_only = stored_only = None
+    for name, arr in mapped.items():
+        p = params[name]
+        perm = getattr(p, "init_perm", None)
+        if perm is None or not p.shape:
+            continue
+        pshape, ashape = tuple(p.shape), tuple(arr.shape)
+
+        def _fits(shape):
+            # 0 entries in the param shape are still-deferred dims
+            return (len(shape) == len(pshape) and
+                    all(s in (0, d) for s, d in zip(pshape, shape)))
+        direct = _fits(ashape)
+        permuted = _fits(tuple(ashape[j] for j in perm))
+        if permuted and not direct:
+            canonical_only = name
+        elif direct and not permuted:
+            stored_only = name
+    if canonical_only and stored_only:
+        raise ValueError(
+            "checkpoint %s mixes layouts: %r only fits as canonical NCHW "
+            "but %r only fits as stored channel-last"
+            % (pretrained, canonical_only, stored_only))
+    if stored_only:
+        return False
+    # default canonical: reference checkpoints are NCHW, and for pure-NCHW
+    # models the flag is a no-op (no param has an init_perm)
+    return True

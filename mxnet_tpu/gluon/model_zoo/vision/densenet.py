@@ -86,7 +86,10 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
+        # pretrained=<path> loads a staged reference .params file;
+        # pretrained=True (model-store download) raises: zero-egress build
+        from ..model_store import load_pretrained
+        load_pretrained(net, pretrained, ctx)
     return net
 
 

@@ -164,5 +164,8 @@ class Inception3(HybridBlock):
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
+        # pretrained=<path> loads a staged reference .params file;
+        # pretrained=True (model-store download) raises: zero-egress build
+        from ..model_store import load_pretrained
+        load_pretrained(net, pretrained, ctx)
     return net

@@ -254,9 +254,10 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled "
-                                  "(zero-egress build); load_parameters() from "
-                                  "a staged file instead")
+        # pretrained=<path> loads a staged reference .params file;
+        # pretrained=True (model-store download) raises: zero-egress build
+        from ..model_store import load_pretrained
+        load_pretrained(net, pretrained, ctx)
     return net
 
 

@@ -88,7 +88,10 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
+        # pretrained=<path> loads a staged reference .params file;
+        # pretrained=True (model-store download) raises: zero-egress build
+        from ..model_store import load_pretrained
+        load_pretrained(net, pretrained, ctx)
     return net
 
 

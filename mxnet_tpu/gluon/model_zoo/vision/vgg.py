@@ -55,7 +55,10 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
+        # pretrained=<path> loads a staged reference .params file;
+        # pretrained=True (model-store download) raises: zero-egress build
+        from ..model_store import load_pretrained
+        load_pretrained(net, pretrained, ctx)
     return net
 
 

@@ -20,7 +20,8 @@ from . import ndarray
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
            "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
-           "Caffe", "CustomMetric", "np", "create", "register"]
+           "Caffe", "CustomMetric", "VOCMApMetric", "VOC07MApMetric",
+           "np", "create", "register"]
 
 _METRIC_REGISTRY = {}
 
@@ -518,6 +519,157 @@ class Caffe(Loss):
     def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
+
+
+def _box_iou_one_to_many(box, boxes):
+    """IoU of one [xmin,ymin,xmax,ymax] box against an (n, 4) array."""
+    ix = numpy.maximum(0.0, numpy.minimum(boxes[:, 2], box[2])
+                     - numpy.maximum(boxes[:, 0], box[0]))
+    iy = numpy.maximum(0.0, numpy.minimum(boxes[:, 3], box[3])
+                     - numpy.maximum(boxes[:, 1], box[1]))
+    inter = ix * iy
+    union = ((box[2] - box[0]) * (box[3] - box[1])
+             + (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+             - inter)
+    out = numpy.where(union > 1e-12, inter / numpy.maximum(union, 1e-12), 0.0)
+    return out
+
+
+@_alias("voc_map", "mAP")
+class VOCMApMetric(EvalMetric):
+    """Mean average precision for detection (reference
+    example/ssd/evaluate/eval_metric.py:24-130 MApMetric semantics).
+
+    ``update(labels, preds)`` consumes one batch:
+      - ``labels[0]``: (B, M, 5|6) ground truths per image,
+        [cls, xmin, ymin, xmax, ymax, (difficult)]; cls < 0 rows are padding.
+      - ``preds[pred_idx]``: (B, N, 6) detections per image,
+        [cls, score, xmin, ymin, xmax, ymax]; cls < 0 rows were NMS-discarded.
+        (the ``_contrib_MultiBoxDetection`` output format.)
+
+    Per class, detections are matched score-descending to ground truths at
+    ``ovp_thresh`` IoU: best-overlap unmatched gt -> TP, a second match to
+    the same gt or a sub-threshold overlap -> FP; matches to ``difficult``
+    gts count neither way unless ``use_difficult``.  AP integrates the
+    interpolated precision envelope over recall; with ``class_names`` the
+    metric reports per-class AP rows plus the mean.
+    """
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False, class_names=None,
+                 pred_idx=0, name="mAP"):
+        self.ovp_thresh = float(ovp_thresh)
+        self.use_difficult = bool(use_difficult)
+        self.class_names = list(class_names) if class_names else None
+        self.pred_idx = int(pred_idx)
+        super().__init__(name, ovp_thresh=ovp_thresh,
+                         use_difficult=use_difficult,
+                         class_names=class_names, pred_idx=pred_idx)
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        # per-class: list of (score, is_tp) match records + total gt count
+        self._records = {}
+        self._gt_counts = {}
+
+    def _class_records(self, cid):
+        if cid not in self._records:
+            self._records[cid] = []
+            self._gt_counts[cid] = 0
+        return self._records[cid]
+
+    def update(self, labels, preds):
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        label_b = numpy.asarray(labels[0].asnumpy()
+                              if hasattr(labels[0], "asnumpy") else labels[0])
+        pred_b = numpy.asarray(
+            preds[self.pred_idx].asnumpy()
+            if hasattr(preds[self.pred_idx], "asnumpy")
+            else preds[self.pred_idx])
+        for label, pred in zip(label_b, pred_b):
+            self._update_image(label[label[:, 0] >= 0],
+                               pred[pred[:, 0] >= 0])
+
+    def _update_image(self, gts, dets):
+        """Match one image's detections against its ground truths."""
+        classes = set(numpy.unique(gts[:, 0]).astype(int))
+        classes.update(numpy.unique(dets[:, 0]).astype(int))
+        for cid in sorted(classes):
+            recs = self._class_records(cid)
+            g = gts[gts[:, 0].astype(int) == cid]
+            difficult = (g[:, 5] > 0 if g.shape[1] >= 6
+                         else numpy.zeros(len(g), bool))
+            if self.use_difficult:
+                difficult = numpy.zeros(len(g), bool)
+            self._gt_counts[cid] += int((~difficult).sum())
+            d = dets[dets[:, 0].astype(int) == cid]
+            d = d[d[:, 1].argsort()[::-1]]
+            taken = numpy.zeros(len(g), bool)
+            for det in d:
+                if len(g) == 0:
+                    recs.append((float(det[1]), False))
+                    continue
+                ious = _box_iou_one_to_many(det[2:6], g[:, 1:5])
+                best = int(ious.argmax())
+                if ious[best] > self.ovp_thresh:
+                    if difficult[best]:
+                        continue  # neither tp nor fp
+                    if taken[best]:
+                        recs.append((float(det[1]), False))  # duplicate
+                    else:
+                        taken[best] = True
+                        recs.append((float(det[1]), True))
+                else:
+                    recs.append((float(det[1]), False))
+
+    def _average_precision(self, recall, precision):
+        """Area under the interpolated precision-recall envelope."""
+        r = numpy.concatenate(([0.0], recall, [1.0]))
+        p = numpy.concatenate(([0.0], precision, [0.0]))
+        p = numpy.maximum.accumulate(p[::-1])[::-1]
+        steps = numpy.nonzero(r[1:] != r[:-1])[0]
+        return float(numpy.sum((r[steps + 1] - r[steps]) * p[steps + 1]))
+
+    def _class_ap(self, cid):
+        recs = self._records[cid]
+        count = self._gt_counts[cid]
+        if not recs:
+            # gts exist but nothing was detected: AP 0; no gts and no
+            # detections can't happen (the class wouldn't be recorded)
+            return 0.0
+        order = sorted(recs, key=lambda r: -r[0])
+        flags = numpy.array([r[1] for r in order], dtype=float)
+        tp = numpy.cumsum(flags)
+        fp = numpy.cumsum(1.0 - flags)
+        recall = tp / count if count > 0 else tp * 0.0
+        precision = tp / numpy.maximum(tp + fp, 1e-12)
+        return self._average_precision(recall, precision)
+
+    def get(self):
+        aps = {cid: self._class_ap(cid) for cid in sorted(self._records)}
+        mean = float(numpy.mean(list(aps.values()))) if aps else float("nan")
+        if self.class_names is None:
+            return (self.name, mean)
+        names = list(self.class_names) + [self.name]
+        values = [aps.get(i, float("nan"))
+                  for i in range(len(self.class_names))] + [mean]
+        return (names, values)
+
+
+@_alias("voc07_map")
+class VOC07MApMetric(VOCMApMetric):
+    """PASCAL VOC-07 11-point interpolated AP (reference
+    eval_metric.py:268-295)."""
+
+    def _average_precision(self, recall, precision):
+        ap = 0.0
+        for t in numpy.arange(0.0, 1.1, 0.1):
+            mask = recall >= t
+            ap += (float(precision[mask].max()) if mask.any() else 0.0) / 11.0
+        return ap
 
 
 @register

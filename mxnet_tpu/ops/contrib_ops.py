@@ -96,16 +96,27 @@ def _box_iou(attrs, lhs, rhs):
 @register("_contrib_MultiBoxTarget", num_outputs=3)
 def _multibox_target(attrs, anchors, labels, cls_preds):
     """Assign ground truth to anchors (multibox_target.cc): returns
-    (loc_target, loc_mask, cls_target).  labels: (N, M, 5) [cls, 4 box]."""
+    (loc_target, loc_mask, cls_target).  labels: (N, M, 5) [cls, 4 box].
+
+    ``negative_mining_ratio`` > 0 enables hard-negative mining
+    (multibox_target.cc:181-230): unmatched anchors overlapping below
+    ``negative_mining_thresh`` compete by background softmax probability;
+    the ``num_positive * ratio`` hardest (lowest bg prob, floor
+    ``minimum_negative_samples``) become background targets and the rest
+    get ``ignore_label`` so the classification loss skips them."""
     import jax
     jnp = _jnp()
     iou_thresh = float(attrs.get("overlap_threshold", 0.5))
     variances = tuple(attrs.get("variances", (0.1, 0.1, 0.2, 0.2)))
+    mining_ratio = float(attrs.get("negative_mining_ratio", -1.0))
+    mining_thresh = float(attrs.get("negative_mining_thresh", 0.5))
+    min_negatives = int(attrs.get("minimum_negative_samples", 0))
+    ignore_label = float(attrs.get("ignore_label", -1.0))
     A = anchors.shape[1]
     N = labels.shape[0]
     anc = anchors[0]  # (A, 4)
 
-    def per_sample(lab):
+    def per_sample(lab, pred):
         valid = lab[:, 0] >= 0
         gt_boxes = lab[:, 1:5]
         iou = _box_iou_xyxy(jnp, anc[:, None, :], gt_boxes[None, :, :])  # (A, M)
@@ -135,10 +146,25 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
         loc = jnp.where(matched[:, None], loc, 0.0)
         mask = jnp.where(matched[:, None], 1.0, 0.0)
         mask = jnp.broadcast_to(mask, (A, 4))
-        cls_t = jnp.where(matched, lab[best_gt, 0] + 1, 0.0)
+        background = jnp.zeros((A,))
+        if mining_ratio > 0:
+            # pred: (C+1, A) logits; hardness = low background probability
+            bg_prob = jax.nn.softmax(pred, axis=0)[0]
+            eligible = (~matched) & (best_iou < mining_thresh)
+            hardness = jnp.where(eligible, bg_prob, jnp.inf)
+            order = jnp.argsort(hardness)          # hardest negatives first
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.minimum(
+                jnp.maximum((num_pos * mining_ratio).astype(jnp.int32),
+                            min_negatives),
+                jnp.sum(eligible))
+            keep_neg = eligible & (rank < num_neg)
+            background = jnp.where(keep_neg, 0.0, ignore_label)
+        cls_t = jnp.where(matched, lab[best_gt, 0] + 1, background)
         return loc.reshape(-1), mask.reshape(-1), cls_t
 
-    loc_t, loc_m, cls_t = jax.vmap(per_sample)(labels)
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(labels, cls_preds)
     return loc_t, loc_m, cls_t
 
 

@@ -125,3 +125,31 @@ def test_fft_roundtrip():
     x = nd.array(np.random.uniform(-1, 1, (2, 8)).astype(np.float32))
     f = contrib.ndarray.fft(x)
     assert f.shape == (2, 16)
+
+
+def test_multibox_target_negative_mining():
+    """negative_mining_ratio=R keeps only the R*num_pos hardest negatives
+    (lowest background prob) as background targets; the rest become
+    ignore_label (reference multibox_target.cc:181-230)."""
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.4, 0.4],      # on the gt
+                                  [0.5, 0.5, 0.9, 0.9],      # off
+                                  [0.1, 0.5, 0.5, 0.9],      # off
+                                  [0.5, 0.1, 0.9, 0.5]]],    # off
+                                np.float32))
+    labels = nd.array(np.array([[[1, 0.0, 0.0, 0.4, 0.4]]], np.float32))
+    # logits (N, C+1, A): anchor 1 is the hardest negative (lowest bg
+    # logit), anchors 2/3 are confidently background
+    preds = np.zeros((1, 3, 4), np.float32)
+    preds[0, 0] = [0.0, -5.0, 5.0, 5.0]       # background logit per anchor
+    preds[0, 1] = [0.0, 5.0, 0.0, 0.0]
+    loc_t, loc_m, cls_t = nd.invoke(
+        "_contrib_MultiBoxTarget", [anchors, labels, nd.array(preds)],
+        {"negative_mining_ratio": 1.0, "negative_mining_thresh": 0.5})
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0                       # matched -> class 1 + 1
+    assert ct[1] == 0.0                       # hardest negative kept as bg
+    assert ct[2] == -1.0 and ct[3] == -1.0    # rest ignored
+    # without mining every unmatched anchor is background
+    _, _, cls_all = nd.invoke(
+        "_contrib_MultiBoxTarget", [anchors, labels, nd.array(preds)], {})
+    np.testing.assert_array_equal(cls_all.asnumpy()[0], [2.0, 0, 0, 0])

@@ -239,3 +239,92 @@ def test_scheduler_in_optimizer():
         opt.update(0, w, g, state)  # zero grads: only lr schedule advances
     assert w.asscalar() == pytest.approx(1.0)
 
+
+
+# ------------------------------------------------------------- detection mAP
+
+def _det(cls, score, x0, y0, x1, y1):
+    return [cls, score, x0, y0, x1, y1]
+
+
+def test_voc_map_perfect_and_miss():
+    """Hand-checked AP: one gt matched perfectly -> 1.0; detector silent on
+    a gt -> 0.0; both present -> mean."""
+    m = mx.metric.VOCMApMetric(ovp_thresh=0.5)
+    labels = np.array([[[0, .1, .1, .5, .5]]], np.float32)
+    preds = np.array([[_det(0, .9, .1, .1, .5, .5)]], np.float32)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert m.get() == ("mAP", 1.0)
+
+    m.reset()
+    # gt for class 1 never detected; class 0 perfect
+    labels = np.array([[[0, .1, .1, .5, .5], [1, .6, .6, .9, .9]]], np.float32)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    name, val = m.get()
+    np.testing.assert_allclose(val, 0.5)
+
+
+def test_voc_map_duplicate_is_fp():
+    """Two detections on one gt: higher score = TP, duplicate = FP.
+    recall steps: [1, 1]; precision: [1, .5] -> AP 1.0 (envelope).  A third
+    spurious box on empty ground drags precision but not the envelope
+    before recall 1."""
+    m = mx.metric.VOCMApMetric(ovp_thresh=0.5)
+    labels = np.array([[[0, .1, .1, .5, .5]]], np.float32)
+    preds = np.array([[_det(0, .9, .1, .1, .5, .5),
+                       _det(0, .8, .12, .12, .5, .5)]], np.float32)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert m.get()[1] == 1.0
+
+
+def test_voc_map_low_iou_is_fp():
+    m = mx.metric.VOCMApMetric(ovp_thresh=0.5)
+    labels = np.array([[[0, .1, .1, .5, .5]]], np.float32)
+    preds = np.array([[_det(0, .9, .6, .6, .9, .9)]], np.float32)  # elsewhere
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert m.get()[1] == 0.0
+
+
+def test_voc_map_difficult_ignored():
+    """A detection matching a difficult gt counts neither way by default,
+    and the difficult gt doesn't inflate the gt count."""
+    m = mx.metric.VOCMApMetric(ovp_thresh=0.5)
+    labels = np.array([[[0, .1, .1, .5, .5, 1],      # difficult
+                        [0, .6, .6, .9, .9, 0]]], np.float32)
+    preds = np.array([[_det(0, .9, .1, .1, .5, .5),  # hits the difficult gt
+                       _det(0, .8, .6, .6, .9, .9)]], np.float32)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert m.get()[1] == 1.0
+    # with use_difficult both gts count; the difficult match becomes a TP
+    m2 = mx.metric.VOCMApMetric(ovp_thresh=0.5, use_difficult=True)
+    m2.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert m2.get()[1] == 1.0
+
+
+def test_voc_map_per_class_names_and_padding():
+    """class_names mode reports per-class rows + mean; cls<0 rows (padding /
+    NMS-discarded) are ignored."""
+    m = mx.metric.VOCMApMetric(class_names=["cat", "dog"])
+    labels = np.array([[[0, .1, .1, .5, .5], [-1, 0, 0, 0, 0]]], np.float32)
+    preds = np.array([[_det(0, .9, .1, .1, .5, .5),
+                       _det(-1, .0, 0, 0, 0, 0)]], np.float32)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    names, values = m.get()
+    assert names == ["cat", "dog", "mAP"]
+    assert values[0] == 1.0 and np.isnan(values[1]) and values[2] == 1.0
+
+
+def test_voc07_map_eleven_point():
+    """11-point AP for a single perfect detection: recall>=t holds for all
+    t<=1.0 with precision 1 -> AP = 1.0; a miss gives 0."""
+    m = mx.metric.VOC07MApMetric(ovp_thresh=0.5)
+    labels = np.array([[[0, .1, .1, .5, .5]]], np.float32)
+    preds = np.array([[_det(0, .9, .1, .1, .5, .5)]], np.float32)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    np.testing.assert_allclose(m.get()[1], 1.0)  # 11 * (1/11) in fp64
+
+
+def test_voc_map_create_by_name():
+    assert isinstance(mx.metric.create("voc_map"), mx.metric.VOCMApMetric)
+    assert isinstance(mx.metric.create("voc07_map"),
+                      mx.metric.VOC07MApMetric)

@@ -1,0 +1,127 @@
+"""Pretrained-checkpoint loading into the model zoo (reference
+model_store.py:77-120 + vision/__init__.py:91 — there the .params file is
+downloaded; here it is staged and passed as ``pretrained=<path>``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.gluon.model_zoo.model_store import map_reference_params
+
+
+def _forward(net, x):
+    return net(nd.array(x)).asnumpy()
+
+
+def test_pretrained_path_roundtrip(tmp_path):
+    """save_parameters (reference binary format) -> get_model(pretrained=path)
+    reproduces the forward pass bitwise."""
+    src = vision.get_model("mobilenet0.25", classes=5)
+    src.initialize(mx.init.Xavier())
+    x = np.random.RandomState(0).uniform(-1, 1, (2, 3, 64, 64)).astype(
+        np.float32)
+    want = _forward(src, x)
+    f = str(tmp_path / "m.params")
+    src.save_parameters(f)
+    dst = vision.get_model("mobilenet0.25", classes=5, pretrained=f)
+    got = _forward(dst, x)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_pretrained_true_still_raises():
+    with pytest.raises(NotImplementedError, match="zero-egress"):
+        vision.get_model("resnet18_v1", pretrained=True)
+
+
+def test_pretrained_reference_prefix_names(tmp_path):
+    """A checkpoint keyed the reference-1.x way (block-prefix names like
+    resnetv10_batchnorm0_gamma, moving_* running stats, arg:/aux: Module
+    prefixes) maps structurally onto the zoo block."""
+    src = vision.get_model("mobilenet0.25", classes=5)
+    src.initialize(mx.init.Xavier())
+    x = np.random.RandomState(1).uniform(-1, 1, (2, 3, 64, 64)).astype(
+        np.float32)
+    want = _forward(src, x)
+
+    params = src._collect_params_with_prefix()
+    ref_spell = {"running_mean": "moving_mean", "running_var": "moving_var"}
+    args, auxes = {}, {}
+    for i, (name, p) in enumerate(params.items()):
+        kind = name.rsplit(".", 1)[-1]
+        refname = "mobilenet0_p%03d_%s" % (i, ref_spell.get(kind, kind))
+        arr = p._reduce()
+        if kind in ref_spell:
+            auxes["aux:" + refname] = arr
+        else:
+            args["arg:" + refname] = arr
+    # Module checkpoints list every arg, then every aux — the global order
+    # differs from construction order, which the kind-grouping must absorb
+    blob = dict(args)
+    blob.update(auxes)
+    f = str(tmp_path / "ref.params")
+    nd.save(f, blob)
+
+    dst = vision.get_model("mobilenet0.25", classes=5, pretrained=f)
+    got = _forward(dst, x)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_pretrained_into_channels_last(tmp_path):
+    """A canonical NCHW checkpoint loads into a channels_last() model: conv
+    weights are permuted into the stored (O, spatial..., I) layout on the
+    way in (Parameter._load_init init_perm path)."""
+    from mxnet_tpu.gluon import nn
+    src = vision.get_model("mobilenet0.25", classes=5)
+    src.initialize(mx.init.Xavier())
+    x = np.random.RandomState(2).uniform(-1, 1, (2, 3, 64, 64)).astype(
+        np.float32)
+    want = _forward(src, x)
+    f = str(tmp_path / "m.params")
+    src.save_parameters(f)
+
+    with nn.channels_last():
+        dst = vision.get_model("mobilenet0.25", classes=5, pretrained=f)
+    got = _forward(dst, x.transpose(0, 2, 3, 1))
+    np.testing.assert_allclose(want, got, rtol=1e-4, atol=1e-5)
+
+
+def test_pretrained_channels_last_roundtrip(tmp_path):
+    """A checkpoint SAVED from a channels_last model reloads through
+    pretrained= without permutation — the file-level layout vote must
+    recognize stored-layout files even though the stem conv (8,3,3,3) is
+    shape-ambiguous (fits both interpretations)."""
+    from mxnet_tpu.gluon import nn
+    with nn.channels_last():
+        src = vision.get_model("mobilenet0.25", classes=5)
+    src.initialize(mx.init.Xavier())
+    x = np.random.RandomState(3).uniform(-1, 1, (2, 64, 64, 3)).astype(
+        np.float32)
+    want = _forward(src, x)
+    f = str(tmp_path / "cl.params")
+    src.save_parameters(f)
+    with nn.channels_last():
+        dst = vision.get_model("mobilenet0.25", classes=5, pretrained=f)
+    got = _forward(dst, x)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_map_reference_params_rejects_mismatched_architecture():
+    loaded = {"net0_conv0_weight": nd.zeros((4, 3, 3, 3))}
+    params = {}  # model with no parameters at all
+
+    class _P:
+        shape = (4, 3, 3, 3)
+        init_perm = None
+    params = {"features.0.weight": _P(), "features.0.bias": _P()}
+    with pytest.raises(ValueError, match="mismatch"):
+        map_reference_params(loaded, params)
+
+
+def test_map_reference_params_rejects_unknown_kind():
+    class _P:
+        shape = (2,)
+        init_perm = None
+    with pytest.raises(ValueError, match="unrecognized"):
+        map_reference_params({"net0_mystery_stat": nd.zeros((2,))},
+                             {"a.weight": _P()})
