@@ -128,6 +128,8 @@ def main():
     A = len(scales) * len(ratios)
     post_n = 4                   # proposals per image
 
+    # deterministic init: Xavier draws from the numpy global RNG
+    np.random.seed(0)
     backbone = Backbone()
     rpn = RPN(A)
     head = RoiHead(num_classes=2)

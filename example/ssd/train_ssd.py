@@ -85,8 +85,7 @@ class MultiScaleSSD(gluon.Block):
             else:
                 from mxnet_tpu.gluon.model_zoo import vision
                 zoo = vision.get_model(backbone, classes=2)
-                self.trunk = zoo.features
-                self.register_child(self.trunk, "trunk")
+                self.trunk = zoo.features   # __setattr__ registers the child
             # extra pyramid levels if the trunk is too shallow (ref: '' layers)
             self.extras = nn.HybridSequential(prefix="extra_")
             with self.extras.name_scope():
@@ -193,6 +192,8 @@ def main():
     ap.add_argument("--lr", type=float, default=0.002)
     args = ap.parse_args()
 
+    # deterministic init: Xavier draws from the numpy global RNG
+    np.random.seed(0)
     ctxs = [mx.cpu(i) for i in range(args.num_devices)]
     net = MultiScaleSSD(args.num_classes, backbone=args.backbone,
                         num_scales=args.num_scales)

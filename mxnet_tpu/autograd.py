@@ -142,8 +142,13 @@ class TapeEntry:
 
 
 def record_op(fn, input_arrays, output_arrays, name="", vjp_fn=None,
-              primals_out=None):
-    """Called by the dispatch layer after computing outputs under record()."""
+              primals_out=None, extra_input_vals=()):
+    """Called by the dispatch layer after computing outputs under record().
+
+    ``extra_input_vals``: raw (non-NDArray) trailing arguments of ``fn``
+    with no tape entry — the PRNG key of rng ops.  ``primals_out`` defaults
+    to the outputs just computed, so backward never re-runs the forward
+    merely to learn output shapes."""
     entries = [getattr(a, "_ag_entry", None) for a in input_arrays]
     if all(e is None for e in entries) and not any(
             getattr(a, "_ag_is_leaf", False) for a in input_arrays):
@@ -162,7 +167,9 @@ def record_op(fn, input_arrays, output_arrays, name="", vjp_fn=None,
             e = TapeEntry(None, 0, array_ref=a)
             a._ag_entry = e
         ins.append(e)
-    vals = [a._data for a in input_arrays]
+    vals = [a._data for a in input_arrays] + list(extra_input_vals)
+    if primals_out is None:
+        primals_out = tuple(a._data for a in output_arrays)
     node = TapeNode(fn, ins, vals, len(output_arrays), name=name,
                     vjp_fn=vjp_fn, primals_out=primals_out)
     for i, o in enumerate(output_arrays):
@@ -218,17 +225,46 @@ def _acc(a, b):
     return a + b
 
 
+_BWD_JIT_CACHE = {}
+
+
+def _cached_bwd(fn):
+    """Jitted recompute-based vjp, memoized on the traceable's identity.
+
+    ``jax.vjp(fn, *vals)`` at backward time re-traces ``fn`` in Python on
+    EVERY training step — for scan-heavy ops (CTC, fused RNN) that is
+    seconds per step.  Building the vjp INSIDE a jit turns the retrace into
+    a jax compile-cache hit; the cost is that backward recomputes the
+    forward for residuals (one extra op-forward — the reference's
+    do-mirror tradeoff).  Only traceables marked ``_mx_cacheable`` (shared
+    across calls by Op._traceable) go through here: jitting a one-shot
+    closure (custom Function) would pay XLA compilation for a single use."""
+    bwd = _BWD_JIT_CACHE.get(fn)
+    if bwd is None:
+        import jax
+
+        def bwd(vals, cts):
+            return jax.vjp(fn, *vals)[1](cts)
+        bwd = jax.jit(bwd)
+        _BWD_JIT_CACHE[fn] = bwd
+    return bwd
+
+
 def _propagate(order, cts):
     """Reverse-propagate cotangents through tape nodes (shared by backward/grad)."""
     import jax
     import jax.numpy as jnp
     for node in reversed(order):
-        if node.vjp_fn is not None:
-            primals_out, vjp_fn = node.primals_out, node.vjp_fn
-        else:
-            primals_out, vjp_fn = jax.vjp(node.fn, *node.input_vals)
-        if not isinstance(primals_out, (tuple, list)):
+        primals_out = node.primals_out
+        if primals_out is not None and not isinstance(primals_out,
+                                                      (tuple, list)):
             primals_out = (primals_out,)
+        vjp_fn = node.vjp_fn
+        if vjp_fn is None and primals_out is None:
+            # legacy path: callers that recorded without output snapshots
+            primals_out, vjp_fn = jax.vjp(node.fn, *node.input_vals)
+            if not isinstance(primals_out, (tuple, list)):
+                primals_out = (primals_out,)
         from .ndarray.sparse import RowSparseCotangent
         out_cts = []
         any_ct = False
@@ -246,7 +282,14 @@ def _propagate(order, cts):
         if not any_ct:
             continue
         single = node.vjp_fn is None and node.n_out == 1
-        in_cts = vjp_fn(out_cts[0] if single else tuple(out_cts))
+        ct_arg = out_cts[0] if single else tuple(out_cts)
+        if vjp_fn is not None:
+            in_cts = vjp_fn(ct_arg)
+        elif getattr(node.fn, "_mx_cacheable", False):
+            in_cts = _cached_bwd(node.fn)(tuple(node.input_vals), ct_arg)
+        else:
+            _, one_shot_vjp = jax.vjp(node.fn, *node.input_vals)
+            in_cts = one_shot_vjp(ct_arg)
         for e, g in zip(node.inputs, in_cts):
             if e is None or g is None:
                 continue

@@ -56,6 +56,10 @@ def _make_hashable(v):
     return v
 
 
-def attrs_key(attrs):
-    """Stable hashable key for an op attribute dict (jit-cache key)."""
-    return tuple(sorted((k, _make_hashable(v)) for k, v in attrs.items()))
+def attrs_key(attrs, skip=None):
+    """Stable hashable key for an op attribute dict (jit-cache key).
+
+    ``skip``: one key to exclude (the per-call PRNG key) — passed by name so
+    the eager hot path doesn't allocate a filtered copy of the dict."""
+    return tuple(sorted((k, _make_hashable(v)) for k, v in attrs.items()
+                        if k != skip))

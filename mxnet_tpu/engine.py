@@ -10,7 +10,19 @@ reads synchronize (``NDArray.wait_to_read`` = ``block_until_ready``).  Bulking
 (batching many small ops into one engine segment, threaded_engine.h:411) is
 superseded by jit: the ``bulk`` context is kept as API but XLA fusion already
 bulk-compiles any jitted region.  ``set_bulk_size`` is accepted and recorded
-for compatibility."""
+for compatibility.
+
+Measured decision (round 4, ``tools/eager_overhead.py`` on the 1-core CPU
+container): a 100-step LSTMCell unroll runs 1,650 cell-steps/s eager vs
+34,593 hybridized — a 21x gap, ~58 us/op eager dispatch overhead, of which
+~15-20 us is jax.jit's own per-call floor.  So for small-op chains the
+bulking question is real, and the framework's answer is ``hybridize()``:
+the whole region traces into ONE cached XLA module, which is strictly
+stronger than the reference's engine bulking (segments still launch one
+kernel per op; XLA fuses).  Making ``bulk()`` itself collect eager ops into
+a deferred trace would duplicate CachedOp for at most the same win, so it
+stays a no-op; eager mode remains the flexible/debug path, hybridize the
+fast one (same split the reference documents for Gluon)."""
 from __future__ import annotations
 
 import contextlib

@@ -80,6 +80,7 @@ def map_reference_params(loaded, params):
         by_kind_dst.setdefault(kind, []).append(name)
 
     mapped = {}
+    ambiguous_kinds = []
     for kind, dst_names in by_kind_dst.items():
         src = by_kind_src.get(kind, [])
         if len(src) != len(dst_names):
@@ -87,6 +88,13 @@ def map_reference_params(loaded, params):
                 "checkpoint/model mismatch for kind %r: file has %d, model "
                 "needs %d (is this checkpoint for a different architecture?)"
                 % (kind, len(src), len(dst_names)))
+        # in-order pairing is exact when the file preserves construction
+        # order (reference model-store files do); with repeated identical
+        # shapes a re-ordered file (e.g. keys re-saved sorted) could pair
+        # same-shaped layers wrongly without tripping the shape check
+        shapes = [tuple(arr.shape) for _, arr in src]
+        if len(set(shapes)) < len(shapes):
+            ambiguous_kinds.append(kind)
         for dst, (src_name, arr) in zip(dst_names, src):
             p = params[dst]
             if p.shape and not any(s == 0 for s in p.shape):
@@ -104,6 +112,14 @@ def map_reference_params(loaded, params):
     if extra:
         raise ValueError("checkpoint has parameter kinds %s the model lacks"
                          % sorted(extra))
+    if ambiguous_kinds:
+        import warnings
+        warnings.warn(
+            "checkpoint has repeated shapes within kinds %s; structural "
+            "name-mapping pairs them in file order, which is exact only if "
+            "the file preserves construction order — verify outputs, or use "
+            "save_parameters (dotted names) for exact matching"
+            % ambiguous_kinds, stacklevel=3)
     return mapped
 
 

@@ -37,6 +37,33 @@ from . import optimizer as opt
 __all__ = ["KVStore", "create"]
 
 
+def _profile_span(name):
+    """A profiler span (B/E events + aggregate-table row) when profiling is
+    running, else None — so the dist eager path's per-key cost shows up in
+    ``profiler.dumps()`` / ``merge_dumps`` (reference server-side profiling
+    analog, include/mxnet/kvstore.h:49)."""
+    from . import profiler
+    if profiler.state() != "run":
+        return None
+    return profiler._Span("kvstore", name).start()
+
+
+def _profile_count(name, n=1):
+    """Bump a count row in the aggregate table (host round-trips) AND emit
+    zero-duration B/E event pairs so the row survives ``merge_dumps``
+    (which rebuilds its table purely from dumped trace events)."""
+    from . import profiler
+    if profiler.state() != "run":
+        return
+    import time as _time
+    ts = _time.time() * 1e6
+    for _ in range(n):
+        profiler._record(name, "kvstore", "B", ts=ts)
+        profiler._record(name, "kvstore", "E", ts=ts)
+    with profiler._lock:
+        profiler._agg[name][0] += n
+
+
 def _key_list(key):
     if isinstance(key, (str, int)):
         return [key], True
@@ -310,6 +337,7 @@ class KVStoreDist(KVStoreTPUSync):
             self._jit_cross_reduce = jax.jit(
                 lambda a: a.sum(axis=0),
                 out_shardings=NamedSharding(mesh, P()))
+        _profile_count("KVStoreDist.host_roundtrip", 2)  # to-global + back
         g = multihost_utils.host_local_array_to_global_array(
             merged._data[None], mesh, P("host"))
         out = self._jit_cross_reduce(g)
@@ -347,6 +375,7 @@ class KVStoreDist(KVStoreTPUSync):
             self._jit_code_reduce = jax.jit(
                 lambda a: a.astype(jnp.int32).sum(axis=0),
                 out_shardings=NamedSharding(mesh, P()))
+        _profile_count("KVStoreDist.host_roundtrip", 2)  # to-global + back
         g = multihost_utils.host_local_array_to_global_array(
             codes[None], mesh, P("host"))
         out = self._jit_code_reduce(g)
@@ -354,21 +383,34 @@ class KVStoreDist(KVStoreTPUSync):
             out, mesh, P())
 
     def push(self, key, value, priority=0):
+        """Eager per-key push: reduce local copies, allreduce across hosts.
+
+        Cost note (measured via the profiler rows below): every key makes a
+        host round-trip — host_local_array_to_global_array, the jitted sum,
+        then back to host — so eager Module-style multi-host training pays
+        2 transfers/key/step.  The compiled-step path
+        (parallel/data_parallel.py, train_imagenet.py --fused-step 1) keeps
+        the whole update in-graph and avoids this; see docs/MIGRATION.md."""
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
             k = str(k)
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
-            merged = self._reduce(vlist)
-            if self._compression.get("type") == "2bit":
-                merged = self._compressed_allreduce(k, merged)
-            else:
-                merged = self._allreduce_across_hosts(merged)
-            if self._updater is not None:
-                self._updater(self._key_to_int(k), merged, self._store[k])
-            else:
-                self._store[k]._set_data(merged._data)
+            span = _profile_span("KVStoreDist.push(%s)" % k)
+            try:
+                merged = self._reduce(vlist)
+                if self._compression.get("type") == "2bit":
+                    merged = self._compressed_allreduce(k, merged)
+                else:
+                    merged = self._allreduce_across_hosts(merged)
+                if self._updater is not None:
+                    self._updater(self._key_to_int(k), merged, self._store[k])
+                else:
+                    self._store[k]._set_data(merged._data)
+            finally:
+                if span is not None:
+                    span.stop()
 
     def barrier(self):
         if self._num_workers > 1:

@@ -636,10 +636,12 @@ class VOCMApMetric(EvalMetric):
     def _class_ap(self, cid):
         recs = self._records[cid]
         count = self._gt_counts[cid]
+        if not recs and count == 0:
+            # every gt of this class was difficult and nothing was detected
+            # as it: the class counts neither way
+            return None
         if not recs:
-            # gts exist but nothing was detected: AP 0; no gts and no
-            # detections can't happen (the class wouldn't be recorded)
-            return 0.0
+            return 0.0   # gts exist but nothing was detected
         order = sorted(recs, key=lambda r: -r[0])
         flags = numpy.array([r[1] for r in order], dtype=float)
         tp = numpy.cumsum(flags)
@@ -649,7 +651,8 @@ class VOCMApMetric(EvalMetric):
         return self._average_precision(recall, precision)
 
     def get(self):
-        aps = {cid: self._class_ap(cid) for cid in sorted(self._records)}
+        aps = {cid: ap for cid in sorted(self._records)
+               for ap in [self._class_ap(cid)] if ap is not None}
         mean = float(numpy.mean(list(aps.values()))) if aps else float("nan")
         if self.class_names is None:
             return (self.name, mean)

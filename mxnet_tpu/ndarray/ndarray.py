@@ -560,7 +560,11 @@ def invoke(op_name, inputs, attrs, out=None):
     if autograd.is_recording():
         nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
         if len(nd_inputs) == len(inputs):
-            autograd.record_op(op._traceable(attrs), nd_inputs, outputs, name=op_name)
+            # rng ops take the key as a trailing tape input so the cached
+            # traceable (and its jitted backward) is shared across calls
+            extra = (attrs["_rng_key"],) if op.needs_rng else ()
+            autograd.record_op(op._traceable(attrs), nd_inputs, outputs,
+                               name=op_name, extra_input_vals=extra)
 
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]

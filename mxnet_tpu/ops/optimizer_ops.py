@@ -20,9 +20,15 @@ def _jnp():
     return jnp
 
 
+def _scalar(v):
+    """MXNet string attrs parse to float; traced jax scalars pass through
+    untouched (dynamic_attrs values must stay traced)."""
+    return float(v) if isinstance(v, (str, bytes)) else v
+
+
 def _common(attrs):
-    lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
+    lr = _scalar(attrs["lr"])
+    wd = _scalar(attrs.get("wd", 0.0))
     rescale = float(attrs.get("rescale_grad", 1.0))
     clip = attrs.get("clip_gradient", -1.0)
     return lr, wd, rescale, (float(clip) if clip is not None else -1.0)
@@ -35,7 +41,7 @@ def _prep_grad(jnp, grad, rescale, clip):
     return g
 
 
-@register("sgd_update")
+@register("sgd_update", dynamic_attrs=("lr", "wd"))
 def _sgd_update(attrs, weight, grad):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -43,7 +49,7 @@ def _sgd_update(attrs, weight, grad):
     return weight - lr * (g + wd * weight)
 
 
-@register("sgd_mom_update", num_outputs=2)
+@register("sgd_mom_update", num_outputs=2, dynamic_attrs=("lr", "wd"))
 def _sgd_mom_update(attrs, weight, grad, mom):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -53,7 +59,7 @@ def _sgd_mom_update(attrs, weight, grad, mom):
     return weight + mom_new, mom_new
 
 
-@register("mp_sgd_update", num_outputs=2)
+@register("mp_sgd_update", num_outputs=2, dynamic_attrs=("lr", "wd"))
 def _mp_sgd_update(attrs, weight, grad, weight32):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -62,7 +68,7 @@ def _mp_sgd_update(attrs, weight, grad, weight32):
     return w32.astype(weight.dtype), w32
 
 
-@register("mp_sgd_mom_update", num_outputs=3)
+@register("mp_sgd_mom_update", num_outputs=3, dynamic_attrs=("lr", "wd"))
 def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -73,7 +79,7 @@ def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
     return w32.astype(weight.dtype), mom_new, w32
 
 
-@register("adam_update", num_outputs=3)
+@register("adam_update", num_outputs=3, dynamic_attrs=("lr", "wd"))
 def _adam_update(attrs, weight, grad, mean, var):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -88,7 +94,7 @@ def _adam_update(attrs, weight, grad, mean, var):
     return w, m, v
 
 
-@register("rmsprop_update", num_outputs=2)
+@register("rmsprop_update", num_outputs=2, dynamic_attrs=("lr", "wd"))
 def _rmsprop_update(attrs, weight, grad, n):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -103,7 +109,7 @@ def _rmsprop_update(attrs, weight, grad, n):
     return w, n_new
 
 
-@register("rmspropalex_update", num_outputs=4)
+@register("rmspropalex_update", num_outputs=4, dynamic_attrs=("lr", "wd"))
 def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -117,7 +123,7 @@ def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
     return weight + delta_new, n_new, g_new, delta_new
 
 
-@register("ftrl_update", num_outputs=3)
+@register("ftrl_update", num_outputs=3, dynamic_attrs=("lr", "wd"))
 def _ftrl_update(attrs, weight, grad, z, n):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -134,7 +140,7 @@ def _ftrl_update(attrs, weight, grad, z, n):
     return w, z_new, n_new
 
 
-@register("signsgd_update")
+@register("signsgd_update", dynamic_attrs=("lr", "wd"))
 def _signsgd_update(attrs, weight, grad):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -142,7 +148,7 @@ def _signsgd_update(attrs, weight, grad):
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
-@register("signum_update", num_outputs=2)
+@register("signum_update", num_outputs=2, dynamic_attrs=("lr", "wd"))
 def _signum_update(attrs, weight, grad, mom):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
@@ -156,14 +162,14 @@ def _signum_update(attrs, weight, grad, mom):
     return w, mom_new
 
 
-@register("ftml_update", num_outputs=4)
+@register("ftml_update", num_outputs=4, dynamic_attrs=("lr", "wd", "t"))
 def _ftml_update(attrs, weight, grad, d, v, z):
     jnp = _jnp()
     lr, wd, rescale, clip = _common(attrs)
     beta1 = float(attrs.get("beta1", 0.6))
     beta2 = float(attrs.get("beta2", 0.999))
     eps = float(attrs.get("epsilon", 1e-8))
-    t = int(attrs.get("t", 1))
+    t = _scalar(attrs.get("t", 1))  # traced per-step counter (dynamic_attrs)
     g = _prep_grad(jnp, grad, rescale, clip) + wd * weight
     v_new = beta2 * v + (1 - beta2) * jnp.square(g)
     d_new = (1 - beta1 ** t) / lr * (jnp.sqrt(v_new / (1 - beta2 ** t)) + eps)
@@ -173,7 +179,7 @@ def _ftml_update(attrs, weight, grad, d, v, z):
     return w, d_new, v_new, z_new
 
 
-@register("_contrib_group_adagrad_update", num_outputs=2)
+@register("_contrib_group_adagrad_update", num_outputs=2, dynamic_attrs=("lr", "wd"))
 def _group_adagrad_update(attrs, weight, grad, history):
     """Group AdaGrad (src/operator/contrib/optimizer_op.cc): ONE history
     scalar per row — history[i] += mean(grad[i]^2) — so embedding tables
@@ -188,7 +194,7 @@ def _group_adagrad_update(attrs, weight, grad, history):
     return weight - lr * g / denom, new_h
 
 
-@register("_sparse_adagrad_update", num_outputs=2)
+@register("_sparse_adagrad_update", num_outputs=2, dynamic_attrs=("lr", "wd"))
 def _sparse_adagrad_update(attrs, weight, grad, history):
     """Dense fallback of the row-sparse AdaGrad update (optimizer_op.cc
     AdagradUpdateEx): elementwise history, used when the gradient has been

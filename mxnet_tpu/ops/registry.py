@@ -48,7 +48,7 @@ class Op:
 
     def __init__(self, name, fcompute, num_outputs=1, needs_rng=False,
                  mode_dependent=False, no_jit=False, doc=None,
-                 visible_outputs=None):
+                 visible_outputs=None, dynamic_attrs=()):
         self.name = name
         self.fcompute = fcompute
         self.num_outputs = num_outputs
@@ -59,8 +59,13 @@ class Op:
         self.needs_rng = needs_rng
         self.mode_dependent = mode_dependent
         self.no_jit = no_jit
+        # attrs traced as scalar ARGUMENTS instead of baked-in statics, so a
+        # per-step value (optimizer lr with bias correction / schedule) hits
+        # the jit cache instead of recompiling the update kernel every step
+        self.dynamic_attrs = tuple(dynamic_attrs)
         self.__doc__ = doc or (fcompute.__doc__ if fcompute else None)
         self._jit_cache = {}
+        self._traceable_cache = {}
         # arg_spec: ordered input names for the symbolic API's auto-created
         # parameter variables (reference: NNVM FListInputNames — e.g.
         # FullyConnected lists [data, weight, bias] and binding creates the
@@ -89,13 +94,35 @@ class Op:
         return no(attrs) if callable(no) else no
 
     def _traceable(self, attrs):
-        """A positional-arg closure over attrs, suitable for jax.jit / jax.vjp."""
-        fcompute = self.fcompute
+        """A positional-arg closure over attrs, suitable for jax.jit / jax.vjp.
 
-        def fn(*arrays):
-            out = fcompute(attrs, *arrays)
-            return out
+        Cached per attrs-key so repeated eager calls with equal attrs share
+        ONE function object — the autograd tape keys its jitted-backward
+        cache on that identity, turning per-step vjp re-tracing into a
+        compile-cache hit.  For rng ops the per-call key is threaded as a
+        trailing ARGUMENT (not baked into the closure), keeping the cache
+        hot across steps."""
+        fcompute = self.fcompute
+        key = attrs_key(attrs, skip="_rng_key")
+        fn = self._traceable_cache.get(key)
+        if fn is not None:
+            return fn
+        if self.needs_rng:
+            static_attrs = {k: v for k, v in attrs.items() if k != "_rng_key"}
+
+            def fn(*arrays_and_key):
+                a = dict(static_attrs)
+                a["_rng_key"] = arrays_and_key[-1]
+                return fcompute(a, *arrays_and_key[:-1])
+            fn._mx_rng_arg = True
+        else:
+            static_attrs = dict(attrs)
+
+            def fn(*arrays):
+                return fcompute(static_attrs, *arrays)
         fn.__name__ = self.name
+        fn._mx_cacheable = True
+        self._traceable_cache[key] = fn
         return fn
 
     def apply(self, attrs, *arrays):
@@ -106,26 +133,43 @@ class Op:
         if self.no_jit:
             return self.fcompute(attrs, *arrays)
         rng_key = attrs.get("_rng_key")
-        key = attrs_key({k: v for k, v in attrs.items() if k != "_rng_key"})
+        dyn = tuple(k for k in self.dynamic_attrs if attrs.get(k) is not None)
+        if dyn:
+            dyn_set = set(dyn) | {"_rng_key"}
+            key = (attrs_key({k: v for k, v in attrs.items()
+                              if k not in dyn_set}), dyn)
+        else:
+            key = attrs_key(attrs, skip="_rng_key")
         fn = self._jit_cache.get(key)
         if fn is None:
             import jax
             fcompute = self.fcompute
-            static_attrs = {k: v for k, v in attrs.items() if k != "_rng_key"}
+            skip = set(dyn) | {"_rng_key"}
+            static_attrs = {k: v for k, v in attrs.items() if k not in skip}
             if self.needs_rng:
                 def traced(key_arr, *arrs):
                     a = dict(static_attrs)
                     a["_rng_key"] = key_arr
-                    return fcompute(a, *arrs)
+                    a.update(zip(dyn, arrs[len(arrs) - len(dyn):]))
+                    return fcompute(a, *arrs[:len(arrs) - len(dyn)])
+            elif dyn:
+                def traced(*arrs):
+                    a = dict(static_attrs)
+                    a.update(zip(dyn, arrs[len(arrs) - len(dyn):]))
+                    return fcompute(a, *arrs[:len(arrs) - len(dyn)])
             else:
                 def traced(*arrs):
                     return fcompute(static_attrs, *arrs)
             traced.__name__ = self.name
             fn = jax.jit(traced)
             self._jit_cache[key] = fn
+        # MXNet-style string attrs must become numbers before being traced
+        dyn_vals = tuple(float(attrs[k])
+                         if isinstance(attrs[k], (str, bytes)) else attrs[k]
+                         for k in dyn)
         if self.needs_rng:
-            return fn(rng_key, *arrays)
-        return fn(*arrays)
+            return fn(rng_key, *arrays, *dyn_vals)
+        return fn(*arrays, *dyn_vals)
 
     def __repr__(self):
         return "Op(%s)" % self.name

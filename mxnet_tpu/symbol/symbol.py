@@ -415,10 +415,12 @@ def _infer_shapes(sym, specs, partial):
         attrs = dict(n.attrs)
         if op.mode_dependent:
             attrs["_training"] = False
+        eval_args = list(in_specs)
         if op.needs_rng:
-            attrs["_rng_key"] = jax.ShapeDtypeStruct((2,), _np.uint32)
+            # rng traceables take the key as a trailing argument
+            eval_args.append(jax.ShapeDtypeStruct((2,), _np.uint32))
         try:
-            out = jax.eval_shape(op._traceable(attrs), *in_specs)
+            out = jax.eval_shape(op._traceable(attrs), *eval_args)
         except Exception:
             if partial:
                 for i in range(n.num_outputs):

@@ -43,6 +43,9 @@ def main():
     if profile_dir:
         profiler.set_config(filename=os.path.join(
             profile_dir, "dist_profile_rank%d.json" % rank))
+        # running state also arms the kvstore-internal per-key spans +
+        # host-roundtrip counter (kvstore.py _profile_span/_profile_count)
+        profiler.set_state("run")
     kv_domain = profiler.Domain("kvstore")
 
     kv.init("3", mx.nd.ones(SHAPE))
@@ -140,6 +143,12 @@ def main():
         np.testing.assert_allclose(out.asnumpy(), expected, rtol=0, atol=1e-6)
 
     if profile_dir:
+        # the local aggregate table must surface the eager path's cost:
+        # per-key push spans and the host round-trip counter
+        table = profiler.dumps()
+        assert "KVStoreDist.push(3)" in table, table
+        assert "KVStoreDist.host_roundtrip" in table, table
+        profiler.set_state("stop")
         profiler.dump()
     print("dist_sync_kvstore rank %d/%d: OK" % (rank, nworker), flush=True)
 

@@ -59,6 +59,9 @@ def test_dist_sync_kvstore_4_workers(tmp_path):
     # 3 iterations x 4 ranks
     push_row = next(l for l in table.splitlines() if "push_dense" in l)
     assert push_row.split()[1] == "12", table
+    # the kvstore-internal per-key spans (eager-path cost surfacing) merge
+    # across ranks too
+    assert "KVStoreDist.push(3)" in table, table
     assert (tmp_path / "merged_trace.json").exists()
 
 

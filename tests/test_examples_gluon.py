@@ -32,3 +32,19 @@ def test_transformer_lm_sequence_parallel_mode():
                           "--xla_force_host_platform_device_count=4"})
     assert res.returncode == 0, res.stderr[-2000:]
     assert "ring vs fused attention" in res.stdout
+
+
+def test_ctc_ocr_example_learns():
+    """LSTM+CTC OCR (example/ctc/lstm_ocr.py): CTC loss drives the op
+    end-to-end (reference example/ctc/lstm_ocr.py + ctc_loss.cc:38) and
+    greedy-decoded sequence accuracy must rise well above the untrained
+    net on held-out synthetic captchas."""
+    import re
+    res = _run("example/ctc/lstm_ocr.py", "--steps", "800")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"sequence accuracy: ([\d.]+) \(untrained ([\d.]+)\)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    acc, acc0 = float(m.group(1)), float(m.group(2))
+    assert acc > 0.4, "trained seq acc %.3f too low\n%s" % (acc, res.stdout)
+    assert acc > acc0 + 0.3, "no meaningful learning: %.3f -> %.3f" % (acc0, acc)

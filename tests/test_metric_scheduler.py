@@ -328,3 +328,14 @@ def test_voc_map_create_by_name():
     assert isinstance(mx.metric.create("voc_map"), mx.metric.VOCMApMetric)
     assert isinstance(mx.metric.create("voc07_map"),
                       mx.metric.VOC07MApMetric)
+
+
+def test_voc_map_difficult_only_class_excluded():
+    """A class whose only ground truths are difficult (and with no
+    detections) must not drag the mean down — it counts neither way."""
+    m = mx.metric.VOCMApMetric(ovp_thresh=0.5)
+    labels = np.array([[[0, .1, .1, .5, .5, 0],
+                        [1, .6, .6, .9, .9, 1]]], np.float32)  # cls1 difficult
+    preds = np.array([[_det(0, .9, .1, .1, .5, .5)]], np.float32)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert m.get()[1] == 1.0
