@@ -107,6 +107,8 @@ def main():
     args = ap.parse_args()
 
     max_len = 5
+    # deterministic init: Xavier draws from the numpy global RNG
+    np.random.seed(0)
     net = OCRNet(max_len * GLYPH_W, args.hidden)
     net.initialize(mx.init.Xavier())
     net.hybridize()

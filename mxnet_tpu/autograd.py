@@ -225,27 +225,41 @@ def _acc(a, b):
     return a + b
 
 
-_BWD_JIT_CACHE = {}
-
-
-def _cached_bwd(fn):
-    """Jitted recompute-based vjp, memoized on the traceable's identity.
+def make_jitted_vjp(fn):
+    """Jitted recompute-based vjp of ``fn``: ``bwd(vals, cts) -> in_cts``.
 
     ``jax.vjp(fn, *vals)`` at backward time re-traces ``fn`` in Python on
     EVERY training step — for scan-heavy ops (CTC, fused RNN) that is
     seconds per step.  Building the vjp INSIDE a jit turns the retrace into
     a jax compile-cache hit; the cost is that backward recomputes the
-    forward for residuals (one extra op-forward — the reference's
-    do-mirror tradeoff).  Only traceables marked ``_mx_cacheable`` (shared
-    across calls by Op._traceable) go through here: jitting a one-shot
-    closure (custom Function) would pay XLA compilation for a single use."""
+    forward for residuals (the reference's MXNET_BACKWARD_DO_MIRROR
+    tradeoff).  Shared by the tape (_cached_bwd) and CachedOp._get_bwd."""
+    import jax
+
+    def bwd(vals, cts):
+        return jax.vjp(fn, *vals)[1](cts)
+    return jax.jit(bwd)
+
+
+_BWD_JIT_CACHE = {}
+_BWD_JIT_CACHE_MAX = 512
+
+
+def _cached_bwd(fn):
+    """``make_jitted_vjp`` memoized on the traceable's identity.
+
+    Only traceables marked ``_mx_cacheable`` (shared across calls by
+    Op._traceable) go through here: jitting a one-shot closure (custom
+    Function) would pay XLA compilation for a single use.  Bounded:
+    dynamic-attr workloads (bucketed shapes) could otherwise grow compiled
+    executables without limit; on overflow the oldest half is dropped
+    (the jitted pairs are rebuilt on demand)."""
     bwd = _BWD_JIT_CACHE.get(fn)
     if bwd is None:
-        import jax
-
-        def bwd(vals, cts):
-            return jax.vjp(fn, *vals)[1](cts)
-        bwd = jax.jit(bwd)
+        if len(_BWD_JIT_CACHE) >= _BWD_JIT_CACHE_MAX:
+            for k in list(_BWD_JIT_CACHE)[:_BWD_JIT_CACHE_MAX // 2]:
+                del _BWD_JIT_CACHE[k]
+        bwd = make_jitted_vjp(fn)
         _BWD_JIT_CACHE[fn] = bwd
     return bwd
 

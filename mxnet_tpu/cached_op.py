@@ -131,12 +131,7 @@ class CachedOp:
         free since the recompute IS remat."""
         fn = self._bwd_jitted.get(training)
         if fn is None:
-            import jax
-            lowerable = self._make_lowerable(training)
-
-            def bwd(vals, cts):
-                return jax.vjp(lowerable, *vals)[1](cts)
-            fn = jax.jit(bwd)
+            fn = autograd.make_jitted_vjp(self._make_lowerable(training))
             self._bwd_jitted[training] = fn
         return fn
 

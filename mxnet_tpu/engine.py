@@ -13,9 +13,10 @@ bulk-compiles any jitted region.  ``set_bulk_size`` is accepted and recorded
 for compatibility.
 
 Measured decision (round 4, ``tools/eager_overhead.py`` on the 1-core CPU
-container): a 100-step LSTMCell unroll runs 1,650 cell-steps/s eager vs
-34,593 hybridized — a 21x gap, ~58 us/op eager dispatch overhead, of which
-~15-20 us is jax.jit's own per-call floor.  So for small-op chains the
+container; recorded in EAGER_OVERHEAD.json): a 100-step LSTMCell unroll
+runs 1,981 cell-steps/s eager vs 40,254 hybridized — a 20x gap, ~48 us/op
+eager dispatch overhead, of which ~15-20 us is jax.jit's own per-call
+floor.  So for small-op chains the
 bulking question is real, and the framework's answer is ``hybridize()``:
 the whole region traces into ONE cached XLA module, which is strictly
 stronger than the reference's engine bulking (segments still launch one

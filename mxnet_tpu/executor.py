@@ -53,6 +53,9 @@ class Executor:
         self._fwd_train = None
         self._fwd_infer = None
         self._vjp = None
+        self._jit_train_fwd = None
+        self._jit_train_bwd = None
+        self._jit_wrt = None       # wrt snapshot the jitted pair was built for
         self._monitor_callback = None
 
     @property
@@ -139,19 +142,48 @@ class Executor:
                 raw = self._build_fn(True)
                 self._raw_train = raw
             keys = self._keys()
-            # vjp at forward time so backward() can run later
             wrt_names = [n for n in self.arg_names
                          if self.grad_req.get(n, "null") != "null"]
             wrt_idx = [self.arg_names.index(n) for n in wrt_names]
+            if self._group2dev:
+                # per-op device placement needs eager dispatch, so the vjp
+                # is built at forward time (re-traced per call — group2ctx
+                # is a placement feature, not a throughput path)
+                def f_wrt(*wrt_vals):
+                    vals = list(arg_vals)
+                    for i, v in zip(wrt_idx, wrt_vals):
+                        vals[i] = v
+                    return tuple(self._raw_train(vals, aux_vals, keys))
 
-            def f_wrt(*wrt_vals):
-                vals = list(arg_vals)
-                for i, v in zip(wrt_idx, wrt_vals):
-                    vals[i] = v
-                return tuple(self._raw_train(vals, aux_vals, keys))
+                outs, vjp = jax.vjp(f_wrt, *[arg_vals[i] for i in wrt_idx])
+                self._vjp = (vjp, wrt_names)
+            else:
+                # compiled train path: jitted forward + separately-jitted
+                # recompute backward, both cached on the executor — per-step
+                # jax.vjp would re-trace the whole graph every iteration
+                # (same defect class as CachedOp._get_bwd; see cached_op.py)
+                if (self._jit_train_fwd is None
+                        or self._jit_wrt != tuple(wrt_idx)):
+                    raw = self._raw_train
+                    idx = tuple(wrt_idx)
+                    self._jit_train_fwd = jax.jit(
+                        lambda a, x, k: tuple(raw(list(a), x, k)))
 
-            outs, vjp = jax.vjp(f_wrt, *[arg_vals[i] for i in wrt_idx])
-            self._vjp = (vjp, wrt_names)
+                    def bwd(a, x, k, cts):
+                        def f_wrt(*wv):
+                            vals = list(a)
+                            for i, v in zip(idx, wv):
+                                vals[i] = v
+                            return tuple(raw(vals, x, k))
+                        wv = [a[i] for i in idx]
+                        return jax.vjp(f_wrt, *wv)[1](cts)
+                    self._jit_train_bwd = jax.jit(bwd)
+                    self._jit_wrt = idx
+                outs = self._jit_train_fwd(tuple(arg_vals), tuple(aux_vals),
+                                           keys)
+                saved = (tuple(arg_vals), tuple(aux_vals), keys)
+                bwd_fn = self._jit_train_bwd
+                self._vjp = ((lambda cts: bwd_fn(*saved, cts)), wrt_names)
             self.outputs = [_wrap(o, ctx=self._ctx) for o in outs]
         else:
             if self._fwd_infer is None:

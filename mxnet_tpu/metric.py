@@ -638,7 +638,9 @@ class VOCMApMetric(EvalMetric):
         count = self._gt_counts[cid]
         if not recs and count == 0:
             # every gt of this class was difficult and nothing was detected
-            # as it: the class counts neither way
+            # as it: the class counts neither way.  (With a stray FP the
+            # class DOES count, at AP 0 — reference semantics: recall is
+            # tp*0.0 when the counted-gt total is zero, eval_metric.py:220)
             return None
         if not recs:
             return 0.0   # gts exist but nothing was detected

@@ -107,6 +107,11 @@ class Op:
         fn = self._traceable_cache.get(key)
         if fn is not None:
             return fn
+        if len(self._traceable_cache) >= 512:
+            # varying-attrs workloads (bucketed shapes): drop the oldest
+            # half rather than grow closures without bound
+            for k in list(self._traceable_cache)[:256]:
+                del self._traceable_cache[k]
         if self.needs_rng:
             static_attrs = {k: v for k, v in attrs.items() if k != "_rng_key"}
 
