@@ -139,8 +139,7 @@ class Executor:
         aux_vals = [self.aux_dict[n]._data for n in self.aux_names]
         if is_train:
             if self._fwd_train is None:
-                raw = self._build_fn(True)
-                self._raw_train = raw
+                self._raw_train = self._fwd_train = self._build_fn(True)
             keys = self._keys()
             wrt_names = [n for n in self.arg_names
                          if self.grad_req.get(n, "null") != "null"]

@@ -109,9 +109,12 @@ class Op:
             return fn
         if len(self._traceable_cache) >= 512:
             # varying-attrs workloads (bucketed shapes): drop the oldest
-            # half rather than grow closures without bound
+            # half rather than grow closures without bound — and purge the
+            # evicted closures' identity-keyed jitted backwards, which
+            # could never be looked up again
+            from ..autograd import _BWD_JIT_CACHE
             for k in list(self._traceable_cache)[:256]:
-                del self._traceable_cache[k]
+                _BWD_JIT_CACHE.pop(self._traceable_cache.pop(k), None)
         if self.needs_rng:
             static_attrs = {k: v for k, v in attrs.items() if k != "_rng_key"}
 
@@ -148,6 +151,11 @@ class Op:
         fn = self._jit_cache.get(key)
         if fn is None:
             import jax
+            if len(self._jit_cache) >= 512:
+                # same varying-attrs bound as _traceable_cache, but these
+                # entries hold compiled XLA executables
+                for k in list(self._jit_cache)[:256]:
+                    del self._jit_cache[k]
             fcompute = self.fcompute
             skip = set(dyn) | {"_rng_key"}
             static_attrs = {k: v for k, v in attrs.items() if k not in skip}

@@ -48,3 +48,36 @@ def test_ctc_ocr_example_learns():
     acc, acc0 = float(m.group(1)), float(m.group(2))
     assert acc > 0.4, "trained seq acc %.3f too low\n%s" % (acc, res.stdout)
     assert acc > acc0 + 0.3, "no meaningful learning: %.3f -> %.3f" % (acc0, acc)
+
+
+def test_dcgan_example_learns():
+    """DCGAN (example/gan/dcgan.py): Deconvolution generator + conv
+    discriminator trained adversarially; the generator's sample moments
+    must move decisively toward the real distribution (reference
+    example/gan/dcgan.py, measured instead of eyeballed)."""
+    import re
+    res = _run("example/gan/dcgan.py", "--steps", "500")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"real=\(([\d.]+), ([\d.]+)\) fake=\(([\d.]+), ([\d.]+)\) "
+                  r"untrained=\(([\d.]+), ([\d.]+)\)", res.stdout)
+    assert m, res.stdout[-2000:]
+    real_mean, real_std, fake_mean, fake_std, un_mean, un_std = map(
+        float, m.groups())
+    assert abs(fake_mean - real_mean) < 0.15, res.stdout
+    # spatial structure emerged: far above the untrained near-constant output
+    assert fake_std > max(4 * un_std, 0.08), res.stdout
+
+
+def test_bi_lstm_sort_example_learns():
+    """Bidirectional LSTM sorts digit sequences (reference
+    example/bi-lstm-sort): held-out per-position accuracy must be near
+    exact — the task is fully determined given both directions."""
+    import re
+    res = _run("example/bi-lstm-sort/sort_lstm.py", "--steps", "600")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"sort accuracy: ([\d.]+) \(untrained ([\d.]+)\)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    acc, acc0 = float(m.group(1)), float(m.group(2))
+    assert acc > 0.85, res.stdout
+    assert acc0 < 0.3, res.stdout
