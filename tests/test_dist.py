@@ -109,3 +109,15 @@ def test_dist_rendezvous_timeout_diagnosis():
     assert elapsed < 60, "rendezvous hung instead of timing out: %gs" % elapsed
     assert ("DEADLINE_EXCEEDED" in res.stderr
             or "rendezvous failed" in res.stderr), res.stderr[-500:]
+
+
+def test_dist_fused_step_2_workers():
+    """The compiled-step multi-host path (make_data_parallel_train_step over
+    a 2-process global mesh, grad psum in-graph): the distributed
+    trajectory must match a single-process run over the full batch — the
+    fused-path counterpart of the per-key kvstore checks above."""
+    res = _launch(2, "tests/dist/dist_fused_step.py")
+    assert res.returncode == 0, \
+        "launcher failed\nstdout:\n%s\nstderr:\n%s" % (res.stdout, res.stderr)
+    for rank in range(2):
+        assert "dist_fused_step rank %d/2: OK" % rank in res.stdout

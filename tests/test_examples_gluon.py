@@ -81,3 +81,16 @@ def test_bi_lstm_sort_example_learns():
     acc, acc0 = float(m.group(1)), float(m.group(2))
     assert acc > 0.85, res.stdout
     assert acc0 < 0.3, res.stdout
+
+
+def test_neural_style_example_optimizes_input():
+    """Neural style (example/neural-style/nstyle.py): gradient descent on
+    the INPUT image through VGG feature taps + Gram losses — the combined
+    loss must collapse from the noise init (reference nstyle.py)."""
+    import re
+    res = _run("example/neural-style/nstyle.py", "--steps", "80")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"loss: ([\d.]+) -> ([\d.]+) \(([\d.]+)x reduction\)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    assert float(m.group(3)) > 5.0, res.stdout
