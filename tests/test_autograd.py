@@ -156,3 +156,38 @@ def test_mutation_does_not_corrupt_tape():
     x[:] = 100.0  # mutate after recording
     y.backward()
     assert_almost_equal(x.grad.asnumpy(), [2.0, 4.0])
+
+
+def test_traceable_cache_eviction_keeps_grads_correct():
+    """Op._traceable_cache evicts at 512 varying-attrs entries, purging the
+    evicted closures' identity-keyed jitted backwards; gradients stay
+    correct through and after an eviction wave (backwards rebuild on
+    demand).  The flood uses _traceable() directly — cheap closure
+    creation, no XLA compiles — so only two real forward/backward pairs
+    run."""
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.autograd import _BWD_JIT_CACHE
+    op = get_op("smooth_l1")
+    op._traceable_cache.clear()
+    x = nd.array(np.array([2.0, -3.0], np.float32))
+    x.attach_grad()
+    # one REAL backward populates the jitted-backward cache for this closure
+    with autograd.record():
+        y = nd.invoke("smooth_l1", [x], {"scalar": 7.5})
+    y.backward()
+    early_fn = op._traceable_cache[
+        next(iter(op._traceable_cache))]
+    assert early_fn in _BWD_JIT_CACHE
+    # flood the cache past the bound with distinct attrs (closures only)
+    for i in range(520):
+        op._traceable({"scalar": 1.0 + i * 1e-4})
+    assert len(op._traceable_cache) <= 512
+    # the evicted closure's jitted backward was purged with it
+    assert early_fn not in _BWD_JIT_CACHE
+    # and a fresh attrs value after the wave still differentiates
+    with autograd.record():
+        y = nd.invoke("smooth_l1", [x], {"scalar": 1.0})
+        s = (y * nd.array(np.array([1.0, 2.0], np.float32))).sum()
+    s.backward()
+    # smooth_l1 sigma=1: |x|>1 -> d/dx = sign(x)
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0, -2.0], atol=1e-6)
