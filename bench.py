@@ -35,16 +35,19 @@ BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 IMG = int(os.environ.get("BENCH_IMG", "224"))
 # BENCH_MODE=train (default, the driver metric) | inference
 # (docs/faq/perf.md:150-180: 1076.81 img/s fp32 / 2085.51 fp16 on V100)
+# | transformer (beyond-parity: GPT-2-small-ish decoder LM with the Pallas
+# flash-attention kernel; tokens/sec + MFU, no reference baseline exists)
 MODE = os.environ.get("BENCH_MODE", "train")
 # BENCH_LAYOUT=auto (default: measure NCHW first, then NHWC, report the
 # faster — settles SURVEY §7(f) with data in every driver capture) |
 # NCHW (reference layout) | NHWC (channels-last only)
 LAYOUT = os.environ.get("BENCH_LAYOUT", "auto").upper()
-if MODE not in ("train", "inference"):
+if MODE not in ("train", "inference", "transformer"):
     # still honor the one-JSON-line-on-stdout contract
     print(json.dumps({"metric": "invalid_bench_mode", "value": None,
                       "unit": None, "vs_baseline": None,
-                      "error": "unknown BENCH_MODE=%r (train|inference)" % MODE}))
+                      "error": "unknown BENCH_MODE=%r "
+                               "(train|inference|transformer)" % MODE}))
     sys.exit(1)
 if LAYOUT not in ("AUTO", "NCHW", "NHWC"):
     print(json.dumps({"metric": "invalid_bench_layout", "value": None,
@@ -55,9 +58,12 @@ if LAYOUT not in ("AUTO", "NCHW", "NHWC"):
 BASELINE_IMGS_PER_SEC = 298.51 if MODE == "train" else 2085.51
 # the baseline ratio is only meaningful for the headline config
 IS_HEADLINE = (BATCH == 32 and IMG == 224)
-_KIND = "train" if MODE == "train" else "infer"
-METRIC = ("resnet50_%s_imgs_per_sec_bs32" % _KIND if IS_HEADLINE
-          else "resnet50_%s_imgs_per_sec_bs%d_img%d" % (_KIND, BATCH, IMG))
+if MODE == "transformer":
+    METRIC = "transformer_lm_train_tokens_per_sec"
+else:
+    _KIND = "train" if MODE == "train" else "infer"
+    METRIC = ("resnet50_%s_imgs_per_sec_bs32" % _KIND if IS_HEADLINE
+              else "resnet50_%s_imgs_per_sec_bs%d_img%d" % (_KIND, BATCH, IMG))
 
 # peak bf16 matmul throughput per chip, by device_kind substring
 # (public spec-sheet numbers; used only to report MFU alongside img/s)
@@ -93,6 +99,32 @@ def _init_backend():
     devs = jax.devices()
     print("backend: %s x%d" % (devs[0].platform, len(devs)), file=sys.stderr)
     return devs
+
+
+def _timed_rate(run_step, block, items_per_step):
+    """Shared measurement harness: 1 compile-absorbing call + block, 2 more
+    warmup calls + block, then BENCH_ITERS timed calls + block.  Returns
+    items/sec.  ``run_step()`` advances one step; ``block()`` syncs."""
+    run_step()
+    block()
+    for _ in range(2):
+        run_step()
+    block()
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_step()
+    block()
+    return items_per_step * iters / (time.perf_counter() - t0)
+
+
+def _mfu(flops_per_step, rate, items_per_step, device_kind):
+    """Model-flops-utilization from XLA's own cost model (None if either
+    the cost analysis or the device peak is unknown)."""
+    peak = _peak_flops(device_kind)
+    if not flops_per_step or not peak:
+        return None
+    return round(flops_per_step * rate / items_per_step / peak, 4)
 
 
 def _step_flops(compiled):
@@ -173,38 +205,100 @@ def _measure(layout):
             return outs[0]
 
         compiled = jax.jit(infer_step).lower(all_params, x).compile()
-        compiled(all_params, x).block_until_ready()
-        iters = int(os.environ.get("BENCH_ITERS", "50"))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = compiled(all_params, x)
-        out.block_until_ready()
-        dt = time.perf_counter() - t0
-        return {"imgs_per_sec": BATCH * iters / dt,
-                "flops": _step_flops(compiled)}
+        state = {}
+
+        def run_step():
+            state["out"] = compiled(all_params, x)
+        rate = _timed_rate(run_step,
+                           lambda: state["out"].block_until_ready(), BATCH)
+        return {"imgs_per_sec": rate, "flops": _step_flops(compiled)}
 
     # AOT-compile the whole training iteration as one XLA module with the
     # previous step's buffers donated (params/momenta/aux update in place)
     compiled = jax.jit(train_step, donate_argnums=(0, 1, 2)).lower(
         train_params, momenta, aux_params, x, y).compile()
     flops = _step_flops(compiled)
-    # warmup (donation consumes the inputs, so thread the outputs forward)
-    train_params, momenta, aux_params, loss = compiled(
-        train_params, momenta, aux_params, x, y)
-    loss.block_until_ready()
-    for _ in range(2):
-        train_params, momenta, aux_params, loss = compiled(
-            train_params, momenta, aux_params, x, y)
-    loss.block_until_ready()
+    # donation consumes the inputs, so thread the outputs forward
+    state = {"t": (train_params, momenta, aux_params)}
 
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        train_params, momenta, aux_params, loss = compiled(
-            train_params, momenta, aux_params, x, y)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
-    return {"imgs_per_sec": BATCH * iters / dt, "flops": flops}
+    def run_step():
+        tp, mo, ax = state["t"]
+        tp, mo, ax, loss = compiled(tp, mo, ax, x, y)
+        state["t"] = (tp, mo, ax)
+        state["loss"] = loss
+    rate = _timed_rate(run_step, lambda: state["loss"].block_until_ready(),
+                       BATCH)
+    return {"imgs_per_sec": rate, "flops": flops}
+
+
+def _measure_transformer(device_kind):
+    """Decoder-LM training throughput: one donated-buffer XLA module per
+    step (fwd+bwd+sgd) over the flash-attention TransformerLM.  Prints the
+    JSON line itself (tokens/sec; no layout loop, no reference baseline —
+    this is the beyond-parity transformer headline)."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "example", "gluon"))
+    from transformer_lm import TransformerLM
+    from mxnet_tpu.gluon.block import functional_call, param_values
+    from mxnet_tpu import nd
+
+    B = int(os.environ.get("BENCH_TFM_BATCH", "8"))
+    T = int(os.environ.get("BENCH_TFM_SEQ", "1024"))
+    dim = int(os.environ.get("BENCH_TFM_DIM", "768"))
+    depth = int(os.environ.get("BENCH_TFM_DEPTH", "12"))
+    vocab = int(os.environ.get("BENCH_TFM_VOCAB", "32768"))
+    dtype = jnp.bfloat16
+
+    net = TransformerLM(vocab, dim=dim, heads=dim // 64, depth=depth,
+                        max_len=T)
+    net.initialize(mx.init.Xavier())
+    pos_np = np.tile(np.arange(T, dtype=np.int32), (1, 1))
+    net(nd.zeros((1, T), dtype="int32"), nd.array(pos_np))  # materialize
+    params = param_values(net)
+    pos = jnp.asarray(np.tile(np.arange(T, dtype=np.int32), (B, 1)))
+
+    def loss_fn(train_params, idx, y):
+        p = {n: (v.astype(dtype) if v.dtype == jnp.float32 else v)
+             for n, v in train_params.items()}
+        outs, _ = functional_call(net, p, idx, pos, training=True)
+        logits = outs[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None], axis=-1))
+
+    lr = 0.01
+
+    def train_step(train_params, idx, y):
+        loss, grads = jax.value_and_grad(loss_fn)(train_params, idx, y)
+        return ({n: train_params[n] - lr * grads[n] for n in train_params},
+                loss)
+
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, vocab, (B, T)).astype(np.int32))
+    y = jnp.asarray(rng.randint(0, vocab, (B, T)).astype(np.int32))
+    compiled = jax.jit(train_step, donate_argnums=(0,)).lower(
+        params, idx, y).compile()
+    flops = _step_flops(compiled)
+    state = {"p": params}
+
+    def run_step():
+        state["p"], state["loss"] = compiled(state["p"], idx, y)
+    tokens_per_sec = _timed_rate(
+        run_step, lambda: state["loss"].block_until_ready(), B * T)
+    print(json.dumps({
+        "metric": "transformer_lm_train_tokens_per_sec_d%d_T%d" % (depth, T),
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "mfu": _mfu(flops, tokens_per_sec, B * T, device_kind),
+        "step_flops": flops,
+        "device": device_kind,
+        "config": {"batch": B, "seq": T, "dim": dim, "depth": depth,
+                   "vocab": vocab},
+        "mode": MODE,
+    }), flush=True)
 
 
 def _emit(results, device_kind):
@@ -217,10 +311,7 @@ def _emit(results, device_kind):
     winner = max(results, key=lambda l: results[l]["imgs_per_sec"])
     best = results[winner]
     imgs_per_sec = best["imgs_per_sec"]
-    mfu = None
-    peak = _peak_flops(device_kind)
-    if best["flops"] and peak:
-        mfu = round(best["flops"] * imgs_per_sec / BATCH / peak, 4)
+    mfu = _mfu(best["flops"], imgs_per_sec, BATCH, device_kind)
     print(json.dumps({
         "metric": METRIC,
         "value": round(imgs_per_sec, 2),
@@ -240,6 +331,10 @@ def _emit(results, device_kind):
 def main():
     devs = _init_backend()
     device_kind = getattr(devs[0], "device_kind", devs[0].platform)
+
+    if MODE == "transformer":
+        _measure_transformer(device_kind)
+        return
 
     layouts = ("NCHW", "NHWC") if LAYOUT == "AUTO" else (LAYOUT,)
     results = {}

@@ -10,6 +10,8 @@ immediately runs the full capture battery:
   1. bench.py           (train, BENCH_LAYOUT=auto -> NCHW + NHWC, MFU)
   2. bench.py inference (BENCH_MODE=inference, bf16)
   3. tools/bandwidth.py (on-chip tpu_sync allreduce GB/s)
+  4. bench.py transformer (BENCH_MODE=transformer: decoder-LM tokens/sec
+     + MFU through the Pallas flash-attention kernel)
 
 Every resulting JSON line is appended to BENCH_LIVE.json with a timestamp
 and the probe evidence; every probe (success or failure) is logged to
@@ -139,6 +141,9 @@ BATTERY = [
     ("bandwidth_onchip", [sys.executable, "tools/bandwidth.py",
                           "--size-mb", "64", "--copies", "4"],
      {}, 400),
+    ("transformer", [sys.executable, "bench.py"],
+     {"BENCH_MODE": "transformer", "BENCH_BUDGET": "700",
+      "BENCH_TIMEOUT": "400"}, 800),
 ]
 
 
