@@ -59,9 +59,9 @@ BASELINE_IMGS_PER_SEC = 298.51 if MODE == "train" else 2085.51
 # the baseline ratio is only meaningful for the headline config
 IS_HEADLINE = (BATCH == 32 and IMG == 224)
 if MODE == "transformer":
-    METRIC = ("transformer_lm_train_tokens_per_sec_d%s_T%s"
-              % (os.environ.get("BENCH_TFM_DEPTH", "12"),
-                 os.environ.get("BENCH_TFM_SEQ", "1024")))
+    METRIC = ("transformer_lm_train_tokens_per_sec_d%d_T%d"
+              % (int(os.environ.get("BENCH_TFM_DEPTH", "12")),
+                 int(os.environ.get("BENCH_TFM_SEQ", "1024"))))
 else:
     _KIND = "train" if MODE == "train" else "infer"
     METRIC = ("resnet50_%s_imgs_per_sec_bs32" % _KIND if IS_HEADLINE

@@ -80,7 +80,9 @@ _V = [
     # --- benchmarks -------------------------------------------------------
     EnvVar("BENCH_BATCH", int, 32, "bench.py batch size."),
     EnvVar("BENCH_IMG", int, 224, "bench.py image edge length."),
-    EnvVar("BENCH_ITERS", int, 20, "bench.py timed iterations."),
+    EnvVar("BENCH_ITERS", int, 20,
+           "bench.py timed iterations (mode-dependent default: 20 for "
+           "train/transformer, 50 for inference)."),
     EnvVar("BENCH_MODE", str, "train",
            "bench.py measurement: train (headline), inference, or "
            "transformer (decoder-LM tokens/sec with flash attention)."),

@@ -94,3 +94,19 @@ def test_neural_style_example_optimizes_input():
                   res.stdout)
     assert m, res.stdout[-2000:]
     assert float(m.group(3)) > 5.0, res.stdout
+
+
+def test_quantization_example_int8_matches_fp32():
+    """Post-training int8 quantization example (reference
+    example/quantization): calibrated int8 inference must keep accuracy
+    and agree with fp32 top-1 on held-out data."""
+    import re
+    res = _run("example/quantization/quantize_infer.py")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"fp32 accuracy: ([\d.]+)\s+int8 accuracy: ([\d.]+)\s+"
+                  r"top-1 agreement: ([\d.]+)", res.stdout)
+    assert m, res.stdout[-2000:]
+    fp_acc, q_acc, agree = map(float, m.groups())
+    assert fp_acc > 0.9, res.stdout
+    assert q_acc > fp_acc - 0.1, res.stdout
+    assert agree > 0.9, res.stdout
