@@ -55,16 +55,20 @@ if LAYOUT not in ("AUTO", "NCHW", "NHWC"):
                       "error": "unknown BENCH_LAYOUT=%r (auto|NCHW|NHWC)"
                                % LAYOUT}))
     sys.exit(1)
-BASELINE_IMGS_PER_SEC = 298.51 if MODE == "train" else 2085.51
-# the baseline ratio is only meaningful for the headline config
-IS_HEADLINE = (BATCH == 32 and IMG == 224)
+# reference numbers per (mode, batch) at 224x224 (BASELINE.md; train =
+# docs/faq/perf.md:208-217 fp32 V100, inference = :164-180 fp16 V100)
+_BASELINES = {("train", 32): 298.51, ("train", 128): 363.69,
+              ("inference", 32): 2085.51, ("inference", 128): 2355.04}
+BASELINE_IMGS_PER_SEC = _BASELINES.get((MODE, BATCH))
+# the baseline ratio is only meaningful where the reference published one
+IS_HEADLINE = (IMG == 224 and BASELINE_IMGS_PER_SEC is not None)
 if MODE == "transformer":
     METRIC = ("transformer_lm_train_tokens_per_sec_d%d_T%d"
               % (int(os.environ.get("BENCH_TFM_DEPTH", "12")),
                  int(os.environ.get("BENCH_TFM_SEQ", "1024"))))
 else:
     _KIND = "train" if MODE == "train" else "infer"
-    METRIC = ("resnet50_%s_imgs_per_sec_bs32" % _KIND if IS_HEADLINE
+    METRIC = ("resnet50_%s_imgs_per_sec_bs%d" % (_KIND, BATCH) if IS_HEADLINE
               else "resnet50_%s_imgs_per_sec_bs%d_img%d" % (_KIND, BATCH, IMG))
 
 # peak bf16 matmul throughput per chip, by device_kind substring
@@ -129,7 +133,9 @@ def _timed_rate(run_step, block, items_per_step, default_iters=20):
     for _ in range(iters):
         run_step()
     _sync()
-    return items_per_step * iters / (time.perf_counter() - t0)
+    wall = time.perf_counter() - t0
+    _timed_rate.last_window = {"iters": iters, "wall_s": round(wall, 4)}
+    return items_per_step * iters / wall
 
 
 def _mfu(flops_per_step, rate, items_per_step, device_kind):
@@ -243,7 +249,8 @@ def _measure(layout):
         rate = _timed_rate(run_step,
                            lambda: state["out"].block_until_ready(), BATCH,
                            default_iters=50)
-        return {"imgs_per_sec": rate, "flops": _step_flops(compiled)}
+        return {"imgs_per_sec": rate, "flops": _step_flops(compiled),
+                "window": getattr(_timed_rate, "last_window", None)}
 
     # AOT-compile the whole training iteration as one XLA module with the
     # previous step's buffers donated (params/momenta/aux update in place)
@@ -260,7 +267,8 @@ def _measure(layout):
         state["loss"] = loss
     rate = _timed_rate(run_step, lambda: state["loss"].block_until_ready(),
                        BATCH)
-    return {"imgs_per_sec": rate, "flops": flops}
+    return {"imgs_per_sec": rate, "flops": flops,
+            "window": getattr(_timed_rate, "last_window", None)}
 
 
 def _measure_transformer(device_kind):
@@ -322,8 +330,10 @@ def _measure_transformer(device_kind):
         run_step, lambda: state["loss"].block_until_ready(), B * T)
     tfm_mfu = _mfu(flops, tokens_per_sec, B * T, device_kind)
     tfm_note = _mfu_note(tfm_mfu)
+    tfm_window = getattr(_timed_rate, "last_window", None)
     print(json.dumps({
         **({"mfu_note": tfm_note} if tfm_note else {}),
+        **({"timed_window": tfm_window} if tfm_window else {}),
         "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
@@ -349,8 +359,10 @@ def _emit(results, device_kind):
     imgs_per_sec = best["imgs_per_sec"]
     mfu = _mfu(best["flops"], imgs_per_sec, BATCH, device_kind)
     note = _mfu_note(mfu)
+    window = best.get("window")
     print(json.dumps({
         **({"mfu_note": note} if note else {}),
+        **({"timed_window": window} if window else {}),
         "metric": METRIC,
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec",

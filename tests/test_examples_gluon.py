@@ -110,3 +110,21 @@ def test_quantization_example_int8_matches_fp32():
     assert fp_acc > 0.9, res.stdout
     assert q_acc > fp_acc - 0.1, res.stdout
     assert agree > 0.9, res.stdout
+
+
+def test_deepspeech_toy_example_learns():
+    """Speech CTC (example/speech_recognition/deepspeech_toy.py): the
+    deepspeech-shaped Conv1D + BiLSTM acoustic net must drive the phone
+    error rate on held-out variable-duration synthetic utterances well
+    below the untrained net's (reference example/speech_recognition/
+    arch_deepspeech.py scored by stt_metric.py's CTC label error rate)."""
+    import re
+    res = _run("example/speech_recognition/deepspeech_toy.py",
+               "--steps", "250")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"phone error rate: ([\d.]+) \(untrained ([\d.]+)\)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    per, per0 = float(m.group(1)), float(m.group(2))
+    assert per < 0.35, "trained PER %.3f too high\n%s" % (per, res.stdout)
+    assert per < per0 / 2, "no meaningful learning: %.3f -> %.3f" % (per0, per)

@@ -135,6 +135,11 @@ BATTERY = [
     ("train_auto", [sys.executable, "bench.py"],
      {"BENCH_LAYOUT": "auto", "BENCH_BUDGET": "1100",
       "BENCH_TIMEOUT": "500"}, 1200),
+    # second reference training headline (363.69 img/s bs=128 on V100,
+    # docs/faq/perf.md:208-217); NCHW only to keep the item short
+    ("train_bs128", [sys.executable, "bench.py"],
+     {"BENCH_BATCH": "128", "BENCH_LAYOUT": "NCHW",
+      "BENCH_BUDGET": "700", "BENCH_TIMEOUT": "340"}, 800),
     ("inference", [sys.executable, "bench.py"],
      {"BENCH_MODE": "inference", "BENCH_BUDGET": "700",
       "BENCH_TIMEOUT": "340"}, 800),
