@@ -128,3 +128,19 @@ def test_deepspeech_toy_example_learns():
     per, per0 = float(m.group(1)), float(m.group(2))
     assert per < 0.35, "trained PER %.3f too high\n%s" % (per, res.stdout)
     assert per < per0 / 2, "no meaningful learning: %.3f -> %.3f" % (per0, per)
+
+
+def test_vae_example_learns():
+    """VAE (example/vae/vae_mnist_like.py): the reparameterized stochastic
+    layer trains under the autograd tape (RNG inside record()), and the
+    trained ELBO + posterior-mean reconstructions must beat the untrained
+    net decisively (reference example/vae/VAE.py's MLP VAE on MNIST)."""
+    import re
+    res = _run("example/vae/vae_mnist_like.py", "--steps", "400")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"elbo: (-?[\d.]+) \(untrained (-?[\d.]+)\), "
+                  r"recon mode accuracy: ([\d.]+)", res.stdout)
+    assert m, res.stdout[-2000:]
+    elbo, elbo0, acc = (float(m.group(i)) for i in (1, 2, 3))
+    assert elbo > elbo0 + 50, "ELBO barely moved: %.1f -> %.1f" % (elbo0, elbo)
+    assert acc > 0.9, "reconstructions off-mode: %.3f\n%s" % (acc, res.stdout)
