@@ -144,3 +144,37 @@ def test_vae_example_learns():
     elbo, elbo0, acc = (float(m.group(i)) for i in (1, 2, 3))
     assert elbo > elbo0 + 50, "ELBO barely moved: %.1f -> %.1f" % (elbo0, elbo)
     assert acc > 0.9, "reconstructions off-mode: %.3f\n%s" % (acc, res.stdout)
+
+
+def test_multitask_example_learns_both_heads():
+    """Multi-task (example/multi-task/multitask.py): one shared conv trunk
+    must drive BOTH the 10-class head and the independent parity head to
+    high held-out accuracy through a joint loss (reference
+    example/multi-task/example_multi_task.py)."""
+    import re
+    res = _run("example/multi-task/multitask.py", "--steps", "250")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"class acc: ([\d.]+) \(untrained ([\d.]+)\), "
+                  r"parity acc: ([\d.]+) \(untrained ([\d.]+)\)", res.stdout)
+    assert m, res.stdout[-2000:]
+    a_cls, a0_cls, a_inv, a0_inv = (float(m.group(i)) for i in (1, 2, 3, 4))
+    assert a_cls > 0.9, "class head stuck at %.3f\n%s" % (a_cls, res.stdout)
+    assert a_inv > 0.9, "parity head stuck at %.3f\n%s" % (a_inv, res.stdout)
+    assert a_cls > a0_cls + 0.3 and a_inv > a0_inv + 0.2
+
+
+def test_reinforce_example_learns_policy():
+    """REINFORCE (example/reinforcement-learning/reinforce_track.py):
+    return-weighted log-prob ascent on on-policy rollouts must take the
+    greedy policy from ~0 return to near-optimal (reference
+    example/reinforcement-learning's policy-gradient loops)."""
+    import re
+    res = _run("example/reinforcement-learning/reinforce_track.py",
+               "--updates", "120")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"greedy avg return: ([\d.]+) \(untrained ([\d.]+)\)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    ret, ret0 = float(m.group(1)), float(m.group(2))
+    assert ret > 0.5, "policy return %.3f too low\n%s" % (ret, res.stdout)
+    assert ret > ret0 + 0.3, "no learning: %.3f -> %.3f" % (ret0, ret)
