@@ -20,7 +20,7 @@ import threading
 from collections import defaultdict
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "merge_dumps",
-           "pause", "resume",
+           "pause", "resume", "memory_summary",
            "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
 
 _config = {"profile_all": False, "profile_symbolic": True, "profile_imperative": True,
@@ -93,6 +93,42 @@ def dumps(reset=False):
                          % (name, cnt, total, total / max(cnt, 1)))
         if reset:
             _agg.clear()
+    return "\n".join(lines)
+
+
+def memory_summary(device=None):
+    """Live-allocation table: one row per (dtype, shape) bucket of the
+    arrays currently alive on ``device`` (all devices if None), sorted by
+    resident bytes — the storage-profiler analog (reference
+    src/profiler/storage_profiler.h tags every Storage::Alloc with the
+    requesting scope; here XLA owns allocation, so the observable unit is
+    the live ``jax.Array`` population).
+
+    Returns the formatted table; the last line totals bytes and count.
+    Device-side internals (XLA scratch, donated aliasing) are invisible by
+    design — for whole-HBM accounting use TensorBoard's memory_viewer on
+    an XPlane trace from ``set_state('run')``/``dump()``."""
+    import jax
+    buckets = defaultdict(lambda: [0, 0])   # (dtype, shape) -> [count, bytes]
+    total = n = 0
+    for arr in jax.live_arrays():
+        try:
+            devs = getattr(arr, "devices", lambda: set())()
+        except Exception:
+            devs = set()
+        if device is not None and devs and device not in devs:
+            continue
+        nbytes = arr.size * arr.dtype.itemsize
+        key = (str(arr.dtype), tuple(arr.shape))
+        buckets[key][0] += 1
+        buckets[key][1] += nbytes
+        total += nbytes
+        n += 1
+    lines = ["%-12s %-28s %8s %14s" % ("Dtype", "Shape", "Count", "Bytes")]
+    for (dt, shp), (cnt, b) in sorted(buckets.items(),
+                                      key=lambda kv: -kv[1][1]):
+        lines.append("%-12s %-28s %8d %14d" % (dt, str(shp), cnt, b))
+    lines.append("%-12s %-28s %8d %14d" % ("TOTAL", "", n, total))
     return "\n".join(lines)
 
 

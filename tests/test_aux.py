@@ -119,3 +119,23 @@ def test_print_summary_counts_params(capsys):
     assert "fc1" in printed and "fc2" in printed
     # fc1: 4*8+8 = 40; fc2: 8*2+2 = 18 -> total 58
     assert "58" in printed
+
+
+def test_profiler_memory_summary_sees_live_arrays():
+    """memory_summary (storage_profiler.h analog) buckets the live jax
+    Arrays by dtype/shape and totals resident bytes; a freshly created
+    NDArray must appear, and dropping it must shrink the total."""
+    import re
+    from mxnet_tpu import nd, profiler
+    x = nd.zeros((137, 11), dtype="float32")
+    x.wait_to_read()
+    table = profiler.memory_summary()
+    assert re.search(r"\(137, 11\)", table), table
+    total_with = int(table.splitlines()[-1].split()[-1])
+    assert total_with >= 137 * 11 * 4
+    del x
+    import gc
+    gc.collect()
+    total_without = int(
+        profiler.memory_summary().splitlines()[-1].split()[-1])
+    assert total_without <= total_with - 137 * 11 * 4
