@@ -178,3 +178,20 @@ def test_reinforce_example_learns_policy():
     ret, ret0 = float(m.group(1)), float(m.group(2))
     assert ret > 0.5, "policy return %.3f too low\n%s" % (ret, res.stdout)
     assert ret > ret0 + 0.3, "no learning: %.3f -> %.3f" % (ret0, ret)
+
+
+def test_text_cnn_example_learns():
+    """Kim-CNN (example/cnn_text_classification/text_cnn.py): parallel
+    multi-width convs + max-over-time pooling must detect the positional-
+    invariant trigram signal to high held-out accuracy (reference
+    example/cnn_text_classification/text_cnn.py)."""
+    import re
+    res = _run("example/cnn_text_classification/text_cnn.py",
+               "--steps", "300")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"sentence accuracy: ([\d.]+) \(untrained ([\d.]+)\)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    acc, acc0 = float(m.group(1)), float(m.group(2))
+    assert acc > 0.9, "accuracy %.3f too low\n%s" % (acc, res.stdout)
+    assert acc > acc0 + 0.3, "no learning: %.3f -> %.3f" % (acc0, acc)
