@@ -195,3 +195,19 @@ def test_text_cnn_example_learns():
     acc, acc0 = float(m.group(1)), float(m.group(2))
     assert acc > 0.9, "accuracy %.3f too low\n%s" % (acc, res.stdout)
     assert acc > acc0 + 0.3, "no learning: %.3f -> %.3f" % (acc0, acc)
+
+
+def test_dec_example_improves_purity():
+    """DEC (example/deep-embedded-clustering/dec.py): AE pretraining,
+    Lloyd centroid init, then the student-t/KL self-sharpening phase
+    training encoder AND a first-class centroid Parameter jointly must
+    end at near-perfect cluster purity (reference
+    example/deep-embedded-clustering/dec.py)."""
+    import re
+    res = _run("example/deep-embedded-clustering/dec.py")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"cluster purity: ([\d.]+) \(kmeans-on-pretrained "
+                  r"([\d.]+)\)", res.stdout)
+    assert m, res.stdout[-2000:]
+    pur = float(m.group(1))
+    assert pur > 0.85, "purity %.3f too low\n%s" % (pur, res.stdout)
