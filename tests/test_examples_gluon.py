@@ -211,3 +211,19 @@ def test_dec_example_improves_purity():
     assert m, res.stdout[-2000:]
     pur = float(m.group(1))
     assert pur > 0.85, "purity %.3f too low\n%s" % (pur, res.stdout)
+
+
+def test_nce_example_learns_embeddings():
+    """NCE (example/nce-loss/nce_lm.py): the sampled binary objective —
+    no full-vocab logits matrix ever built — must still organize the
+    input embedding by topic, far above the 1/8 chance coherence
+    (reference example/nce-loss/nce.py)."""
+    import re
+    res = _run("example/nce-loss/nce_lm.py", "--steps", "400")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"topic coherence: ([\d.]+) \(untrained ([\d.]+)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    coh, coh0 = float(m.group(1)), float(m.group(2))
+    assert coh > 0.5, "coherence %.3f too low\n%s" % (coh, res.stdout)
+    assert coh > coh0 + 0.3, "no learning: %.3f -> %.3f" % (coh0, coh)
