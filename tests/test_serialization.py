@@ -107,3 +107,26 @@ def test_save_0d_raises(tmp_path):
     with pytest.raises(ValueError, match="0-d"):
         nd.save(str(tmp_path / "s.params"),
                 {"s": nd.array(np.float32(5.0))})
+
+
+def test_gluon_export_rebinds_with_aux_states(tmp_path):
+    """HybridBlock.export -> load_checkpoint -> simple_bind round trip:
+    BN running stats must classify as auxiliary states in the exported
+    graph (reference: gluon export / SymbolBlock.imports contract), and
+    the executor forward must match the gluon forward bitwise."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("resnet18_v1", classes=7)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(3).normal(
+        0, 1, (2, 3, 32, 32)).astype(np.float32))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "r18")
+    net.export(prefix)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    assert len(sym.list_auxiliary_states()) == len(aux) > 0
+    exe = sym.simple_bind(mx.cpu(), data=(2, 3, 32, 32), grad_req="null")
+    exe.copy_params_from(arg, aux)
+    out = exe.forward(is_train=False, data=x)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
