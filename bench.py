@@ -42,12 +42,12 @@ MODE = os.environ.get("BENCH_MODE", "train")
 # faster — settles SURVEY §7(f) with data in every driver capture) |
 # NCHW (reference layout) | NHWC (channels-last only)
 LAYOUT = os.environ.get("BENCH_LAYOUT", "auto").upper()
-if MODE not in ("train", "inference", "transformer"):
+if MODE not in ("train", "inference", "transformer", "int8"):
     # still honor the one-JSON-line-on-stdout contract
     print(json.dumps({"metric": "invalid_bench_mode", "value": None,
                       "unit": None, "vs_baseline": None,
                       "error": "unknown BENCH_MODE=%r "
-                               "(train|inference|transformer)" % MODE}))
+                               "(train|inference|transformer|int8)" % MODE}))
     sys.exit(1)
 if LAYOUT not in ("AUTO", "NCHW", "NHWC"):
     print(json.dumps({"metric": "invalid_bench_layout", "value": None,
@@ -66,6 +66,8 @@ if MODE == "transformer":
     METRIC = ("transformer_lm_train_tokens_per_sec_d%d_T%d"
               % (int(os.environ.get("BENCH_TFM_DEPTH", "12")),
                  int(os.environ.get("BENCH_TFM_SEQ", "1024"))))
+elif MODE == "int8":
+    METRIC = "resnet50_int8_infer_imgs_per_sec_bs%d" % BATCH
 else:
     _KIND = "train" if MODE == "train" else "infer"
     METRIC = ("resnet50_%s_imgs_per_sec_bs%d" % (_KIND, BATCH) if IS_HEADLINE
@@ -271,6 +273,67 @@ def _measure(layout):
             "window": getattr(_timed_rate, "last_window", None)}
 
 
+def _measure_int8(device_kind):
+    """int8 quantized ResNet-50 inference through the executor: gluon
+    model-zoo net -> HybridBlock.export -> quantize_model graph pass
+    (minmax calibration) -> jitted executor forward.  The quantized conv/FC
+    kernels issue int8 x int8 -> int32 dot/conv (ops/quantization_ops.py),
+    the MXU's native int8 path — the TPU-side analog of the reference's
+    example/quantization int8 deployment.  No int8 V100 number exists in
+    the reference's perf.md, so vs_baseline compares against its fp16
+    inference headline (2085.51 img/s bs=32) with a note."""
+    import tempfile
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.contrib import quantization as q
+
+    net = vision.get_model("resnet50_v1", classes=1000)
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 3, IMG, IMG)))  # materialize params
+    tmp = tempfile.mkdtemp()
+    prefix = os.path.join(tmp, "r50")
+    net.export(prefix)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 0)
+
+    rng = np.random.RandomState(0)
+    x_np = rng.uniform(-1, 1, (BATCH, 3, IMG, IMG)).astype(np.float32)
+    calib = mx.io.NDArrayIter(
+        rng.uniform(-1, 1, (BATCH, 3, IMG, IMG)).astype(np.float32),
+        np.zeros(BATCH, np.float32), BATCH)
+    qsym, qargs, qaux = q.quantize_model(sym, arg_params, aux_params,
+                                         calib_data=calib,
+                                         calib_mode="minmax")
+    exe = qsym.simple_bind(mx.tpu(0), data=(BATCH, 3, IMG, IMG),
+                           grad_req="null")
+    exe.copy_params_from(qargs, qaux)
+    x = nd.array(x_np)
+    state = {}
+
+    def run_step():
+        state["out"] = exe.forward(is_train=False, data=x)[0]
+
+    rate = _timed_rate(run_step, lambda: state["out"]._data, BATCH,
+                       default_iters=50)
+    window = getattr(_timed_rate, "last_window", None)
+    print(json.dumps({
+        **({"timed_window": window} if window else {}),
+        "metric": METRIC,
+        "value": round(rate, 2),
+        "unit": "images/sec",
+        "vs_baseline": (round(rate / 2085.51, 3)
+                        if BATCH == 32 and IMG == 224 else None),
+        "baseline_note": "vs the reference's fp16 V100 inference headline "
+                         "(docs/faq/perf.md:164-180); no int8 V100 number "
+                         "is published in-tree",
+        "mfu": None,
+        "step_flops": None,
+        "device": device_kind,
+        "calib": "minmax",
+        "mode": MODE,
+    }), flush=True)
+
+
 def _measure_transformer(device_kind):
     """Decoder-LM training throughput: one donated-buffer XLA module per
     step (fwd+bwd+sgd) over the flash-attention TransformerLM.  Prints the
@@ -384,6 +447,9 @@ def main():
 
     if MODE == "transformer":
         _measure_transformer(device_kind)
+        return
+    if MODE == "int8":
+        _measure_int8(device_kind)
         return
 
     layouts = ("NCHW", "NHWC") if LAYOUT == "AUTO" else (LAYOUT,)

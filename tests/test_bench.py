@@ -66,3 +66,15 @@ def test_bench_bad_mode_still_emits_json():
                          capture_output=True, text=True)
     rec = json.loads(res.stdout.splitlines()[-1])
     assert rec["value"] is None and "BENCH_MODE" in rec["error"]
+
+
+def test_bench_int8_mode_smoke():
+    """BENCH_MODE=int8: export -> quantize_model -> executor path stays
+    runnable and reports the timed window it measured."""
+    res, rec = _run_bench(dict(TINY_RESNET, BENCH_MODE="int8",
+                               BENCH_IMG="64"), timeout=560)
+    assert res.returncode == 0, res.stdout
+    assert rec["value"] > 0 and rec["mode"] == "int8"
+    assert rec["metric"] == "resnet50_int8_infer_imgs_per_sec_bs2"
+    assert rec["calib"] == "minmax"
+    assert rec["timed_window"]["iters"] >= 1

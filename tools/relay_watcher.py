@@ -155,6 +155,11 @@ BATTERY = [
      {"BENCH_MODE": "inference", "BENCH_BATCH": "128",
       "BENCH_LAYOUT": "NCHW", "BENCH_BUDGET": "700",
       "BENCH_TIMEOUT": "340"}, 800),
+    # beyond-parity: int8 quantized inference through the executor path
+    # (MXU native int8); the reference publishes no comparable number
+    ("int8_infer", [sys.executable, "bench.py"],
+     {"BENCH_MODE": "int8", "BENCH_BUDGET": "700",
+      "BENCH_TIMEOUT": "400"}, 800),
 ]
 
 
