@@ -148,3 +148,36 @@ def test_quantize_no_bias_path():
     qsym, qargs, _ = q.quantize_model(out, args, {})
     got = _run(qsym, qargs, {}, x)
     np.testing.assert_allclose(got, want, rtol=0.05, atol=0.02)
+
+
+def test_quantize_model_zoo_resnet_agreement(tmp_path):
+    """Model-zoo-scale int8: export resnet18_v1 (the bench.py int8 path),
+    quantize with minmax calibration, and require near-total top-1
+    agreement plus bounded logit drift vs the fp32 executor — the
+    example/quantization accuracy-parity check at real-model depth."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    rng = np.random.RandomState(0)
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, 32, 32)))
+    prefix = str(tmp_path / "r18")
+    net.export(prefix)
+    s, args, aux = mx.model.load_checkpoint(prefix, 0)
+    x = rng.uniform(-1, 1, (16, 3, 32, 32)).astype(np.float32)
+    fp_exe = s.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+    fp_exe.copy_params_from(args, aux)
+    want = fp_exe.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    calib = mx.io.NDArrayIter(
+        rng.uniform(-1, 1, (16, 3, 32, 32)).astype(np.float32),
+        np.zeros(16, np.float32), 16)
+    qsym, qargs, qaux = q.quantize_model(s, args, aux, calib_data=calib,
+                                         calib_mode="minmax")
+    q_exe = qsym.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+    q_exe.copy_params_from(qargs, qaux)
+    got = q_exe.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    agree = (got.argmax(1) == want.argmax(1)).mean()
+    assert agree >= 0.9, "top-1 agreement %.2f" % agree
+    # logits drift bounded relative to the fp32 dynamic range
+    scale = np.abs(want).max() + 1e-6
+    assert np.abs(got - want).max() / scale < 0.35
