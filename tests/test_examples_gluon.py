@@ -227,3 +227,19 @@ def test_nce_example_learns_embeddings():
     coh, coh0 = float(m.group(1)), float(m.group(2))
     assert coh > 0.5, "coherence %.3f too low\n%s" % (coh, res.stdout)
     assert coh > coh0 + 0.3, "no learning: %.3f -> %.3f" % (coh0, coh)
+
+
+def test_stochastic_depth_example_learns():
+    """Stochastic depth (example/stochastic-depth/sd_resnet.py): per-batch
+    Bernoulli-gated residual blocks (fresh random graph every step through
+    the tape) must still train to high held-out accuracy, with inference
+    switching to the expectation path (reference
+    example/stochastic-depth/sd_cifar10.py)."""
+    import re
+    res = _run("example/stochastic-depth/sd_resnet.py", "--steps", "300")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"accuracy: ([\d.]+) \(untrained ([\d.]+)\)", res.stdout)
+    assert m, res.stdout[-2000:]
+    acc, acc0 = float(m.group(1)), float(m.group(2))
+    assert acc > 0.8, "accuracy %.3f too low\n%s" % (acc, res.stdout)
+    assert acc > acc0 + 0.4, "no learning: %.3f -> %.3f" % (acc0, acc)
