@@ -275,3 +275,14 @@ class Marker:
 
     def mark(self, scope="process"):
         _record(self.name, str(self.domain), "i", args={"s": scope[0]})
+
+
+# env autostart (reference: MXNET_PROFILER_AUTOSTART, docs/faq/env_var.md:152
+# — begin profiling at import so short scripts profile without code changes;
+# registered in env.py).  jax.profiler.start_trace is deferred to the first
+# set_state call's path, so a missing backend cannot break import.
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0").lower() in ("1", "true"):
+    try:
+        set_state("run")
+    except Exception:
+        pass

@@ -139,3 +139,22 @@ def test_profiler_memory_summary_sees_live_arrays():
     total_without = int(
         profiler.memory_summary().splitlines()[-1].split()[-1])
     assert total_without <= total_with - 137 * 11 * 4
+
+
+def test_profiler_autostart_env(tmp_path):
+    """MXNET_PROFILER_AUTOSTART=1 starts the profiler at import
+    (env_var.md:152 analog; knob registered in env.py)."""
+    import subprocess
+    import sys as _sys
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import mxnet_tpu as mx;"
+            "print('running:', mx.profiler.state())")
+    env = dict(os.environ, MXNET_PROFILER_AUTOSTART="1",
+               JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run([_sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=180,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert "running: run" in res.stdout, res.stdout
