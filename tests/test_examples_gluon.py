@@ -243,3 +243,22 @@ def test_stochastic_depth_example_learns():
     acc, acc0 = float(m.group(1)), float(m.group(2))
     assert acc > 0.8, "accuracy %.3f too low\n%s" % (acc, res.stdout)
     assert acc > acc0 + 0.4, "no learning: %.3f -> %.3f" % (acc0, acc)
+
+
+def test_lstnet_example_beats_naive():
+    """LSTNet (example/multivariate_time_series/lstnet.py): conv + GRU +
+    seasonal skip-GRU + AR highway must forecast the held-out window far
+    below the naive last-value RSE (reference
+    example/multivariate_time_series/src/lstnet.py, scored like its
+    metrics.py RSE)."""
+    import re
+    res = _run("example/multivariate_time_series/lstnet.py",
+               "--steps", "200")
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"held-out RSE: ([\d.]+) \(naive last-value ([\d.]+)\)",
+                  res.stdout)
+    assert m, res.stdout[-2000:]
+    model, naive = float(m.group(1)), float(m.group(2))
+    assert model < 0.6, "RSE %.3f too high\n%s" % (model, res.stdout)
+    assert model < naive / 2, "no edge over naive: %.3f vs %.3f" % (
+        model, naive)
