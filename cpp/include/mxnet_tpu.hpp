@@ -1,0 +1,232 @@
+// C++ frontend for the TPU-native framework — the cpp-package analog.
+//
+// The reference's C++ frontend (cpp-package/include/mxnet-cpp/*.hpp) is a
+// header-only RAII layer over the C ABI in include/mxnet/c_api.h: NDArray
+// wraps NDArrayHandle (ndarray.hpp), Operator invokes by name through
+// MXImperativeInvoke (operator.hpp), and optimizers call the *_update ops
+// (optimizer.hpp).  This frontend follows the same architecture over
+// build/libmxnet_tpu_c.so (src/c_api.cc), but trains Gluon-style — the
+// imperative autograd flow (MXAutogradSetIsRecording / MarkVariables /
+// Backward) rather than the legacy Symbol/Executor flow, because on TPU the
+// imperative path IS the compiled path (every op dispatch is a jit-cached
+// XLA executable; see mxnet_tpu/ops/registry.py).
+//
+// A host program links (or dlopens) libmxnet_tpu_c.so and must run with
+// PYTHONPATH covering the repo and the JAX site-packages (the ABI embeds
+// CPython; mxnet_tpu.capi.embed_env() produces the right environment).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_c_api.h"  // the shared ABI surface (no duplicated decls)
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) throw Error(MXGetLastError());
+}
+
+inline int Version() {
+  int v = 0;
+  Check(MXGetVersion(&v));
+  return v;
+}
+
+enum DType { kFloat32 = 0, kFloat64 = 1, kUint8 = 3, kInt32 = 4, kInt64 = 6 };
+
+// RAII NDArray over an owned C handle (reference: mxnet-cpp/ndarray.hpp,
+// whose NDBlob holds the handle and frees it on destruction).
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(NDArrayHandle h) : h_(h) {}
+  NDArray(const std::vector<mx_uint> &shape, DType dtype = kFloat32) {
+    Check(MXNDArrayCreateEx(shape.data(), static_cast<mx_uint>(shape.size()),
+                            /*dev_type=*/1, /*dev_id=*/0, /*delay_alloc=*/0,
+                            dtype, &h_));
+  }
+  NDArray(const std::vector<mx_uint> &shape, const std::vector<float> &data)
+      : NDArray(shape, kFloat32) {
+    CopyFrom(data.data(), data.size());
+  }
+  ~NDArray() { reset(); }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) {
+      reset();
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+
+  NDArrayHandle handle() const { return h_; }
+  bool valid() const { return h_ != nullptr; }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *p = nullptr;
+    Check(MXNDArrayGetShape(h_, &ndim, &p));
+    return std::vector<mx_uint>(p, p + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+  void CopyFrom(const float *data, size_t n) {
+    // size is an ELEMENT count, matching the reference ABI's contract
+    EnsureFloat32("NDArray::CopyFrom");
+    Check(MXNDArraySyncCopyFromCPU(h_, data, n));
+  }
+  std::vector<float> ToVector() const {
+    // The float-typed convenience buffer would overflow for 8-byte dtypes
+    // (the ABI sizes the transfer by the array's real dtype), so this
+    // helper is float32-only; other dtypes go through the raw C ABI.
+    EnsureFloat32("NDArray::ToVector");
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(h_, out.data(), out.size()));
+    return out;
+  }
+  float Scalar() const { return ToVector().at(0); }
+
+  // The gradient buffer attached by autograd::MarkVariables (a fresh
+  // owned handle to the same underlying buffer).
+  NDArray Grad() const {
+    NDArrayHandle g = nullptr;
+    Check(MXNDArrayGetGrad(h_, &g));
+    if (g == nullptr) throw Error("no gradient attached");
+    return NDArray(g);
+  }
+
+ private:
+  void EnsureFloat32(const char *what) const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(h_, &dt));
+    if (dt != kFloat32) {
+      throw Error(std::string(what) +
+                  ": float32-only convenience helper; use the raw C ABI "
+                  "copies for other dtypes");
+    }
+  }
+  void reset() {
+    if (h_ != nullptr) MXNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  NDArrayHandle h_ = nullptr;
+};
+
+using KwArgs = std::vector<std::pair<std::string, std::string>>;
+
+// Invoke a registered op by name (reference: mxnet-cpp/operator.hpp wraps
+// MXImperativeInvoke the same way; op handles are cached per name).
+inline std::vector<NDArray> Invoke(const std::string &op,
+                                   const std::vector<const NDArray *> &inputs,
+                                   const KwArgs &kwargs = {}) {
+  // NNGetOpHandle caches per name behind its own mutex, so no second
+  // (and otherwise racy) cache is needed here.
+  AtomicSymbolCreator creator;
+  Check(NNGetOpHandle(op.c_str(), &creator));
+  std::vector<NDArrayHandle> ins;
+  ins.reserve(inputs.size());
+  for (const NDArray *a : inputs) ins.push_back(a->handle());
+  std::vector<const char *> keys, vals;
+  for (const auto &kv : kwargs) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int num_outputs = 0;
+  NDArrayHandle *outputs = nullptr;
+  Check(MXImperativeInvoke(creator, static_cast<int>(ins.size()), ins.data(),
+                           &num_outputs, &outputs,
+                           static_cast<int>(keys.size()), keys.data(),
+                           vals.data()));
+  std::vector<NDArray> out;
+  out.reserve(num_outputs);
+  for (int i = 0; i < num_outputs; ++i) out.emplace_back(outputs[i]);
+  return out;
+}
+
+inline NDArray Invoke1(const std::string &op,
+                       const std::vector<const NDArray *> &inputs,
+                       const KwArgs &kwargs = {}) {
+  auto out = Invoke(op, inputs, kwargs);
+  if (out.empty()) throw Error(op + ": no outputs");
+  return std::move(out[0]);
+}
+
+namespace autograd {
+
+// Scoped MXAutogradSetIsRecording(1) + SetIsTraining(1): the C++ analog of
+// `with autograd.record():`.
+class RecordScope {
+ public:
+  RecordScope() {
+    Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    Check(MXAutogradSetIsTraining(1, &prev_train_));
+  }
+  ~RecordScope() {
+    int ignore = 0;
+    MXAutogradSetIsRecording(prev_rec_, &ignore);
+    MXAutogradSetIsTraining(prev_train_, &ignore);
+  }
+
+ private:
+  int prev_rec_ = 0, prev_train_ = 0;
+};
+
+// Attach a zero-initialized gradient buffer (grad_req='write').
+inline void MarkVariable(NDArray &var) {
+  NDArray grad(var.Shape(), kFloat32);
+  NDArrayHandle vh = var.handle(), gh = grad.handle();
+  mx_uint req = 1;  // write
+  Check(MXAutogradMarkVariables(1, &vh, &req, &gh));
+  // the runtime now holds the grad reference; releasing ours is safe
+}
+
+inline void Backward(const NDArray &loss) {
+  NDArrayHandle h = loss.handle();
+  Check(MXAutogradBackward(1, &h, nullptr, /*retain_graph=*/0));
+}
+
+}  // namespace autograd
+
+// Plain SGD via the registered sgd_update fused op, writing in place —
+// reference optimizer.hpp dispatches to the same op name.
+class SGD {
+ public:
+  // rescale_grad: set to 1/batch when the loss op sums over the batch
+  // (softmax_cross_entropy does, matching the reference's convention).
+  explicit SGD(float lr, float wd = 0.f, float rescale_grad = 1.f)
+      : lr_(lr), wd_(wd), rescale_(rescale_grad) {}
+  void Step(NDArray &weight) const {
+    NDArray grad = weight.Grad();
+    NDArrayHandle ins[2] = {weight.handle(), grad.handle()};
+    NDArrayHandle outs[1] = {weight.handle()};
+    NDArrayHandle *pout = outs;
+    int n_out = 1;
+    AtomicSymbolCreator creator;
+    Check(NNGetOpHandle("sgd_update", &creator));
+    const char *keys[3] = {"lr", "wd", "rescale_grad"};
+    std::string lr = std::to_string(lr_), wd = std::to_string(wd_),
+                rs = std::to_string(rescale_);
+    const char *vals[3] = {lr.c_str(), wd.c_str(), rs.c_str()};
+    Check(MXImperativeInvoke(creator, 2, ins, &n_out, &pout, 3, keys, vals));
+  }
+
+ private:
+  float lr_, wd_, rescale_;
+};
+
+}  // namespace mxtpu

@@ -1,0 +1,101 @@
+// Shared C ABI declarations for the TPU-native framework.
+//
+// The single source of truth for the exported surface (the analog of the
+// reference's include/mxnet/c_api.h): src/c_api.cc includes this so the
+// compiler cross-checks every definition against the declaration, and the
+// C++ frontend (mxnet_tpu.hpp) includes it so the two can never drift.
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#else
+#include <stdbool.h>
+#endif
+
+typedef uint32_t mx_uint;
+typedef void *NDArrayHandle;
+typedef void *KVStoreHandle;
+typedef void *AtomicSymbolCreator;  // an interned op-name handle
+
+#define MXTPU_DLL __attribute__((visibility("default")))
+
+MXTPU_DLL const char *MXGetLastError(void);
+MXTPU_DLL int MXGetVersion(int *out);
+
+// NDArray lifecycle.  Sync copy sizes are ELEMENT counts (the reference
+// checks size against shape().Size()).
+MXTPU_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayCreateNone(NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayFree(NDArrayHandle handle);
+MXTPU_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXTPU_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+MXTPU_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                       size_t size);
+MXTPU_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXTPU_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXTPU_DLL int MXNDArrayWaitAll(void);
+MXTPU_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+// Ops: listing, name resolution, imperative invoke.
+MXTPU_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+MXTPU_DLL int NNGetOpHandle(const char *name, AtomicSymbolCreator *out);
+MXTPU_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals);
+
+// Autograd.
+MXTPU_DLL int MXAutogradSetIsRecording(int is_recording, int *prev);
+MXTPU_DLL int MXAutogradSetIsTraining(int is_training, int *prev);
+MXTPU_DLL int MXAutogradIsRecording(bool *curr);
+MXTPU_DLL int MXAutogradIsTraining(bool *curr);
+MXTPU_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle *var_handles,
+                                      mx_uint *reqs_array,
+                                      NDArrayHandle *grad_handles);
+MXTPU_DLL int MXAutogradBackward(mx_uint num_output,
+                                 NDArrayHandle *output_handles,
+                                 NDArrayHandle *ograd_handles,
+                                 int retain_graph);
+MXTPU_DLL int MXAutogradBackwardEx(mx_uint num_output,
+                                   NDArrayHandle *output_handles,
+                                   NDArrayHandle *ograd_handles,
+                                   mx_uint num_variables,
+                                   NDArrayHandle *var_handles,
+                                   int retain_graph, int create_graph,
+                                   int is_train, NDArrayHandle **grad_handles,
+                                   int **grad_stypes);
+
+// KVStore.
+MXTPU_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+MXTPU_DLL int MXKVStoreFree(KVStoreHandle handle);
+MXTPU_DLL int MXKVStoreGetType(KVStoreHandle handle, const char **out);
+MXTPU_DLL int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals);
+MXTPU_DLL int MXKVStorePushEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority);
+MXTPU_DLL int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority);
+
+// Misc.
+MXTPU_DLL int MXRandomSeed(int seed);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // MXNET_TPU_C_API_H_

@@ -29,8 +29,22 @@ def _honor_jax_platforms_env():
         return
     try:
         import jax
-        if jax.config.jax_platforms:
-            return  # an explicit earlier config (e.g. conftest) wins
+        current = jax.config.jax_platforms
+        # Three possible writers of jax_platforms before this point:
+        #   1. nothing (None/empty)           -> apply the env var
+        #   2. the TPU site hook (writes an "axon"-containing list during
+        #      jax import)                    -> apply the env var; the
+        #      hook's write is not user intent, and honoring it makes
+        #      jax.devices() block forever when the relay is down
+        #   3. an explicit earlier update to something ELSE (a conftest
+        #      forcing cpu while the ambient env still says axon) -> keep it
+        # "Hook-written" is detected by the axon component rather than one
+        # literal value so a hook variant writing e.g. "axon" alone is
+        # still overridden.  A user who wants the axon backend says so in
+        # JAX_PLATFORMS, which is exactly the value applied below.
+        hook_written = "axon" in (current or "").split(",")
+        if current and current != plat and not hook_written:
+            return
         jax.config.update("jax_platforms", plat)
     except Exception:
         pass  # backends already initialized

@@ -1,0 +1,78 @@
+"""Build + load the C API ABI library (``src/c_api.cc``).
+
+The reference ships its C ABI as part of ``libmxnet.so`` (built by the main
+Makefile; surface in include/mxnet/c_api.h).  Here the ABI is a separate
+shared object, ``build/libmxnet_tpu_c.so``, because it links libpython (it
+embeds CPython to reach the JAX runtime) and Python-side users never need
+it — it exists for non-Python frontends (``cpp/``) and ABI-level
+interop tests.
+
+Usage:
+    python -m mxnet_tpu.capi        # build (prints the .so path)
+    lib = mxnet_tpu.capi.load()     # ctypes handle with restypes set
+    env = mxnet_tpu.capi.embed_env()  # env vars a C++ host process needs
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import sysconfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "src", "c_api.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+LIB_PATH = os.path.join(_BUILD_DIR, "libmxnet_tpu_c.so")
+
+
+def build(force=False):
+    """Compile src/c_api.cc -> build/libmxnet_tpu_c.so; returns the path.
+
+    Raises RuntimeError (with the compiler's stderr) on failure, unlike the
+    soft-fallback IO library (_native.py): there is no Python fallback for
+    an ABI whose entire point is serving non-Python callers.
+    """
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    hdr = os.path.join(_REPO_ROOT, "cpp", "include", "mxnet_tpu_c_api.h")
+    newest = max(os.path.getmtime(_SRC),
+                 os.path.getmtime(hdr) if os.path.exists(hdr) else 0)
+    if (not force and os.path.exists(LIB_PATH)
+            and os.path.getmtime(LIB_PATH) >= newest):
+        return LIB_PATH
+    include = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = "%d.%d" % sys.version_info[:2]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-I" + include,
+           "-I" + os.path.join(_REPO_ROOT, "cpp", "include"),
+           "-L" + libdir, "-lpython" + ver,
+           "-Wl,-rpath," + libdir, "-o", LIB_PATH]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError("c_api build failed:\n%s" % proc.stderr[-4000:])
+    return LIB_PATH
+
+
+def load():
+    """Build if needed and return a ctypes CDLL with key restypes set."""
+    lib = ctypes.CDLL(build(), mode=ctypes.RTLD_GLOBAL)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def embed_env(extra_pythonpath=()):
+    """Environment for a host process that embeds the interpreter via the C
+    ABI: sys.path must reach both this repo and the (venv) site-packages,
+    which libpython alone does not know about."""
+    site = [p for p in sys.path
+            if p.endswith(("site-packages", "dist-packages"))]
+    parts = [_REPO_ROOT] + list(extra_pythonpath) + site
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        parts + [env["PYTHONPATH"]] if env.get("PYTHONPATH") else parts)
+    return env
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv))

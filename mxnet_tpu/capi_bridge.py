@@ -1,0 +1,209 @@
+"""Python-side half of the C API (`src/c_api.cc` calls these).
+
+Role split, mirroring the reference: the reference's ``src/c_api/c_api.cc``
+is a thin marshalling layer over the real runtime (Imperative::Invoke,
+autograd, KVStore) — see c_api.cc:181-210 (NDArray create),
+c_api_ndarray.cc:54-120 (imperative invoke).  Here the runtime is the
+mxnet_tpu Python package (ops dispatch through JAX/XLA), so the C ABI
+library embeds CPython and marshals through these helpers.  Every function
+takes/returns only primitives, bytes, lists, and NDArray objects so the C
+side never touches numpy internals.
+
+The C ABI is the compatibility surface the reference exposes to its other-
+language frontends (include/mxnet/c_api.h); implementing it on top of the
+TPU runtime lets those frontends (see ``cpp/``) drive XLA without Python
+source-level integration.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import autograd as _autograd
+from . import kvstore as _kvstore
+from . import random as _random
+from .context import cpu, tpu, Context
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray
+from .ndarray.serialization import _TYPE_FLAG_TO_DTYPE, _DTYPE_TO_TYPE_FLAG
+from .ops import get_op, list_ops
+
+# parity version: the reference this framework tracks is MXNet ~1.3.0
+_VERSION = 10300
+
+
+def version():
+    return _VERSION
+
+
+def _ctx(dev_type, dev_id):
+    # reference device codes: 1=cpu, 2=gpu (mshadow); gpu maps to tpu here
+    if dev_type == 2:
+        return tpu(dev_id)
+    return cpu(dev_id)
+
+
+def create(shape, dev_type, dev_id, dtype_code):
+    dtype = _np.dtype(_TYPE_FLAG_TO_DTYPE[int(dtype_code)])
+    return _nd.zeros(tuple(int(s) for s in shape), ctx=_ctx(dev_type, dev_id),
+                     dtype=dtype)
+
+
+def create_none():
+    """An uninitialized handle usable as a mutate target (MXNDArrayCreateNone).
+
+    Divergence from the reference: the handle is a concrete (1,) float32
+    array, so using it as a caller-provided op OUTPUT coerces the result to
+    float32 (the reference's none-handle adopts the op's output dtype).
+    Callers needing a non-float32 output should create the target with
+    MXNDArrayCreateEx at the right dtype instead."""
+    return _nd.zeros((1,), ctx=cpu())
+
+
+def shape_of(arr):
+    return tuple(int(s) for s in arr.shape)
+
+
+def dtype_code_of(arr):
+    return int(_DTYPE_TO_TYPE_FLAG[_np.dtype(arr.dtype)])
+
+
+def _check_size(arr, n_elems, what):
+    n_elems = int(n_elems)
+    size = 1
+    for s in arr.shape:
+        size *= int(s)
+    if n_elems != size:
+        raise ValueError("%s: size mismatch (caller passed %d elements, "
+                         "array has %d)" % (what, n_elems, size))
+    return size
+
+
+def copy_to_addr(arr, addr, n_elems):
+    """WaitToRead + copy out to a raw host pointer (MXNDArraySyncCopyToCPU).
+
+    ``n_elems`` is an element count, per the reference ABI contract; numpy
+    supplies the dtype width, so the C side carries no dtype table."""
+    import ctypes
+    _check_size(arr, n_elems, "MXNDArraySyncCopyToCPU")
+    host = _np.ascontiguousarray(arr.asnumpy())
+    ctypes.memmove(int(addr), host.ctypes.data, host.nbytes)
+    return 0
+
+
+def copy_from_addr(arr, addr, n_elems):
+    """In-place write from a raw host pointer (MXNDArraySyncCopyFromCPU)."""
+    import ctypes
+    size = _check_size(arr, n_elems, "MXNDArraySyncCopyFromCPU")
+    dtype = _np.dtype(arr.dtype)
+    buf = (ctypes.c_char * (size * dtype.itemsize)).from_address(int(addr))
+    host = _np.frombuffer(buf, dtype=dtype).reshape(arr.shape).copy()
+    arr[:] = _nd.array(host, ctx=arr.context, dtype=dtype)
+    return 0
+
+
+def op_exists(name):
+    try:
+        get_op(name)
+        return True
+    except Exception:
+        return False
+
+
+def invoke(name, inputs, keys, vals, outputs=None):
+    """Imperative invoke by op name (MXImperativeInvoke).
+
+    Returns the list of output NDArrays.  When ``outputs`` is given, results
+    are written into them (the handle-reuse path of the reference API).
+    """
+    attrs = dict(zip([str(k) for k in keys], [str(v) for v in vals]))
+    out = list(outputs) if outputs else None
+    result = _nd.invoke(name, list(inputs), attrs, out=out)
+    if isinstance(result, (list, tuple)):
+        return list(result)
+    return [result]
+
+
+def all_op_names():
+    return sorted(list_ops())
+
+
+def wait_to_read(arr):
+    arr.wait_to_read()
+    return 0
+
+
+def waitall():
+    _nd.waitall()
+    return 0
+
+
+def set_recording(flag):
+    return 1 if _autograd.set_recording(bool(flag)) else 0
+
+
+def set_training(flag):
+    return 1 if _autograd.set_training(bool(flag)) else 0
+
+
+def is_recording():
+    return 1 if _autograd.is_recording() else 0
+
+
+def is_training():
+    return 1 if _autograd.is_training() else 0
+
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "add"}
+
+
+def mark_variables(variables, gradients, reqs):
+    _autograd.mark_variables(
+        list(variables), list(gradients),
+        grad_reqs=[_GRAD_REQ.get(int(r), "write") for r in reqs])
+    return 0
+
+
+def backward(outputs, ograds, retain_graph, is_train):
+    heads = list(outputs)
+    head_grads = None
+    if ograds:
+        head_grads = [g for g in ograds]
+        if all(g is None for g in head_grads):
+            head_grads = None
+    _autograd.backward(heads, head_grads=head_grads,
+                       retain_graph=bool(retain_graph),
+                       train_mode=bool(is_train))
+    return 0
+
+
+def grad_of(arr):
+    return arr.grad
+
+
+def kv_create(kind):
+    return _kvstore.create(kind)
+
+
+def kv_init(kv, keys, values, priority=0):
+    del priority  # init has no priority; accepted so the C marshalling
+    kv.init(list(keys), list(values))  # helper is shared with push/pull
+    return 0
+
+
+def kv_push(kv, keys, values, priority):
+    kv.push(list(keys), list(values), priority=int(priority))
+    return 0
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+    return 0
+
+
+def kv_type(kv):
+    return getattr(kv, "type", "local")
+
+
+def random_seed(seed):
+    _random.seed(int(seed))
+    return 0

@@ -1,0 +1,592 @@
+// C API ABI for the TPU-native framework (SURVEY §1 L8).
+//
+// The reference exposes its runtime to every non-Python frontend through a
+// C ABI (include/mxnet/c_api.h; implementation src/c_api/c_api.cc,
+// c_api_ndarray.cc) — handles are opaque pointers, errors are -1 plus
+// MXGetLastError(), per-thread return stores keep returned pointers alive
+// until the next call on the same thread (src/c_api/c_api_common.h,
+// MXAPIThreadLocalEntry).
+//
+// TPU-native redesign: the runtime here is the mxnet_tpu package (ops
+// dispatch through JAX/XLA), so this library embeds CPython and marshals
+// through mxnet_tpu/capi_bridge.py.  That keeps the C surface identical in
+// shape to the reference's (create/free/copy/invoke/autograd/kvstore) while
+// the execution path stays the XLA one.  An NDArrayHandle is an owned
+// PyObject* reference to an mxnet_tpu NDArray; MXNDArrayFree drops it.
+//
+// Thread model: every entry point takes the GIL via PyGILState_Ensure, so
+// the ABI is callable from any native thread, including threads Python has
+// never seen.  When the host process has no interpreter yet (a pure C++
+// frontend, e.g. cpp/examples), the first call initializes one.
+//
+// Build: g++ -shared -fPIC -std=c++17 src/c_api.cc \
+//            -I$(python3-config --includes) -lpython3.12 \
+//            -o build/libmxnet_tpu_c.so
+// (see mxnet_tpu/capi.py, which drives this build and caches the result).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+// Declarations shared with the C++ frontend — including them here makes the
+// compiler cross-check every definition below against the public surface.
+#include "mxnet_tpu_c_api.h"
+
+namespace {
+
+thread_local std::string tls_last_error;
+
+// Per-thread return store: pointers handed back to the caller (shape
+// arrays, string lists, output-handle arrays) stay valid until that
+// thread's next API call, same contract as the reference's
+// MXAPIThreadLocalEntry.
+struct RetStore {
+  std::vector<mx_uint> shape;
+  std::vector<NDArrayHandle> handles;
+  std::vector<std::string> strings;
+  std::vector<const char *> cstrs;
+};
+thread_local RetStore tls_ret;
+
+std::once_flag g_py_once;
+
+void init_python_once() {
+  std::call_once(g_py_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // Release the GIL the initializing thread now holds so every entry
+      // point can use the uniform PyGILState_Ensure/Release pairing.
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// RAII GIL hold for one API call.
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    init_python_once();
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// Capture the pending Python exception into tls_last_error; returns -1.
+int fail() {
+#if PY_VERSION_HEX >= 0x030C0000
+  PyObject *exc = PyErr_GetRaisedException();
+#else
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject *exc = value;
+  Py_XDECREF(type);
+  Py_XDECREF(tb);
+#endif
+  if (exc == nullptr) {
+    tls_last_error = "unknown error (no Python exception pending)";
+    return -1;
+  }
+  PyObject *s = PyObject_Str(exc);
+  PyObject *t = PyObject_Str(reinterpret_cast<PyObject *>(Py_TYPE(exc)));
+  tls_last_error.clear();
+  if (t != nullptr) {
+    tls_last_error += PyUnicode_AsUTF8(t);
+    tls_last_error += ": ";
+  }
+  tls_last_error += (s != nullptr) ? PyUnicode_AsUTF8(s) : "<unprintable>";
+  Py_XDECREF(s);
+  Py_XDECREF(t);
+  Py_DECREF(exc);
+  return -1;
+}
+
+int fail_msg(const char *msg) {
+  tls_last_error = msg;
+  return -1;
+}
+
+PyObject *bridge() {  // borrowed ref, cached; GIL must be held
+  static PyObject *mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.capi_bridge");
+  }
+  return mod;
+}
+
+// call bridge.<fn>(*args); steals nothing, returns new ref or null
+PyObject *bcall(const char *fn, PyObject *args) {
+  PyObject *mod = bridge();
+  if (mod == nullptr) return nullptr;
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return r;
+}
+
+PyObject *handle_list(int n, NDArrayHandle *arr) {  // new ref
+  PyObject *lst = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject *o = arr != nullptr && arr[i] != nullptr
+                      ? reinterpret_cast<PyObject *>(arr[i])
+                      : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(lst, i, o);
+  }
+  return lst;
+}
+
+PyObject *str_list(int n, const char **strs) {  // new ref
+  PyObject *lst = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(strs[i]));
+  }
+  return lst;
+}
+
+// Interned op names backing AtomicSymbolCreator handles (NNGetOpHandle).
+std::mutex g_ops_mu;
+std::map<std::string, std::unique_ptr<std::string>> g_op_handles;
+
+}  // namespace
+
+MXTPU_DLL const char *MXGetLastError() { return tls_last_error.c_str(); }
+
+MXTPU_DLL int MXGetVersion(int *out) {
+  Gil gil;
+  PyObject *r = bcall("version", nullptr);
+  if (r == nullptr) return fail();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// NDArray lifecycle
+// ---------------------------------------------------------------------------
+
+static int create_impl(const mx_uint *shape, mx_uint ndim, int dev_type,
+                       int dev_id, int dtype, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *pyshape = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(pyshape, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject *args = Py_BuildValue("(Oiii)", pyshape, dev_type, dev_id, dtype);
+  Py_DECREF(pyshape);
+  PyObject *r = bcall("create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;  // ownership transferred to the handle
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                              int dev_id, int delay_alloc, NDArrayHandle *out) {
+  (void)delay_alloc;  // XLA owns allocation; arrays materialize lazily anyway
+  return create_impl(shape, ndim, dev_type, dev_id, /*dtype=*/0, out);
+}
+
+MXTPU_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out) {
+  (void)delay_alloc;
+  return create_impl(shape, ndim, dev_type, dev_id, dtype, out);
+}
+
+MXTPU_DLL int MXNDArrayCreateNone(NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = bcall("create_none", nullptr);
+  if (r == nullptr) return fail();
+  *out = r;
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayFree(NDArrayHandle handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("shape_of", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyTuple_Size(r);
+  tls_ret.shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    tls_ret.shape[i] =
+        static_cast<mx_uint>(PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  }
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = tls_ret.shape.data();
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("dtype_code_of", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// Sync copy sizes are ELEMENT counts (the reference checks size against
+// shape().Size()).  The bridge does the byte-width math and the actual
+// memmove — numpy already knows the dtype width, so no parallel
+// flag->itemsize table exists on this side, and each copy costs exactly
+// one GIL acquisition.
+static int copy_addr(const char *fn, NDArrayHandle handle, const void *data,
+                     size_t size) {
+  Gil gil;
+  PyObject *args = Py_BuildValue(
+      "(OKK)", reinterpret_cast<PyObject *>(handle),
+      static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(data)),
+      static_cast<unsigned long long>(size));
+  PyObject *r = bcall(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                       size_t size) {
+  return copy_addr("copy_from_addr", handle, data, size);
+}
+
+MXTPU_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size) {
+  return copy_addr("copy_to_addr", handle, data, size);
+}
+
+MXTPU_DLL int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("wait_to_read", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayWaitAll() {
+  Gil gil;
+  PyObject *r = bcall("waitall", nullptr);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("grad_of", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *out = nullptr;
+    return 0;
+  }
+  *out = r;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Op listing + imperative invoke
+// ---------------------------------------------------------------------------
+
+MXTPU_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  Gil gil;
+  PyObject *r = bcall("all_op_names", nullptr);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyList_Size(r);
+  tls_ret.strings.clear();
+  tls_ret.cstrs.clear();
+  tls_ret.strings.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    tls_ret.strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+  }
+  Py_DECREF(r);
+  for (auto &s : tls_ret.strings) tls_ret.cstrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = tls_ret.cstrs.data();
+  return 0;
+}
+
+MXTPU_DLL int NNGetOpHandle(const char *name, AtomicSymbolCreator *out) {
+  {
+    // fast path: a name validated once never needs the GIL again
+    std::lock_guard<std::mutex> lk(g_ops_mu);
+    auto it = g_op_handles.find(name);
+    if (it != g_op_handles.end()) {
+      *out = it->second.get();
+      return 0;
+    }
+  }
+  {
+    Gil gil;
+    PyObject *args = Py_BuildValue("(s)", name);
+    PyObject *r = bcall("op_exists", args);
+    Py_DECREF(args);
+    if (r == nullptr) return fail();
+    int ok = PyObject_IsTrue(r);
+    Py_DECREF(r);
+    if (!ok) return fail_msg("unknown operator name");
+  }
+  std::lock_guard<std::mutex> lk(g_ops_mu);
+  auto &slot = g_op_handles[name];
+  if (slot == nullptr) slot = std::make_unique<std::string>(name);
+  *out = slot.get();
+  return 0;
+}
+
+MXTPU_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals) {
+  Gil gil;
+  const std::string *name = reinterpret_cast<const std::string *>(creator);
+  if (name == nullptr) return fail_msg("null op handle");
+  PyObject *ins = handle_list(num_inputs, inputs);
+  PyObject *keys = str_list(num_params, param_keys);
+  PyObject *vals = str_list(num_params, param_vals);
+  PyObject *outs = (*num_outputs > 0) ? handle_list(*num_outputs, *outputs)
+                                      : (Py_INCREF(Py_None), Py_None);
+  PyObject *args =
+      Py_BuildValue("(sOOOO)", name->c_str(), ins, keys, vals, outs);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  Py_DECREF(outs);
+  PyObject *r = bcall("invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyList_Size(r);
+  if (*num_outputs > 0) {
+    // caller-provided outputs were written in place; nothing to hand back
+    if (n != *num_outputs) {
+      Py_DECREF(r);
+      return fail_msg("MXImperativeInvoke: output count mismatch");
+    }
+    Py_DECREF(r);
+    return 0;
+  }
+  tls_ret.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);  // each returned handle owns a reference
+    tls_ret.handles.push_back(o);
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = tls_ret.handles.data();
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Autograd
+// ---------------------------------------------------------------------------
+
+static int set_flag(const char *fn, int value, int *prev) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(i)", value);
+  PyObject *r = bcall(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return set_flag("set_recording", is_recording, prev);
+}
+
+MXTPU_DLL int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return set_flag("set_training", is_training, prev);
+}
+
+MXTPU_DLL int MXAutogradIsRecording(bool *curr) {
+  int v = 0;
+  Gil gil;
+  PyObject *r = bcall("is_recording", nullptr);
+  if (r == nullptr) return fail();
+  v = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  *curr = v != 0;
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradIsTraining(bool *curr) {
+  int v = 0;
+  Gil gil;
+  PyObject *r = bcall("is_training", nullptr);
+  if (r == nullptr) return fail();
+  v = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  *curr = v != 0;
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle *var_handles,
+                                      mx_uint *reqs_array,
+                                      NDArrayHandle *grad_handles) {
+  Gil gil;
+  PyObject *vars = handle_list(num_var, var_handles);
+  PyObject *grads = handle_list(num_var, grad_handles);
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i) {
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  }
+  PyObject *args = Py_BuildValue("(OOO)", vars, grads, reqs);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  PyObject *r = bcall("mark_variables", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+static int backward_impl(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int is_train) {
+  Gil gil;
+  PyObject *outs = handle_list(num_output, output_handles);
+  PyObject *ograds = ograd_handles != nullptr
+                         ? handle_list(num_output, ograd_handles)
+                         : (Py_INCREF(Py_None), Py_None);
+  PyObject *args = Py_BuildValue("(OOii)", outs, ograds, retain_graph, is_train);
+  Py_DECREF(outs);
+  Py_DECREF(ograds);
+  PyObject *r = bcall("backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradBackward(mx_uint num_output,
+                                 NDArrayHandle *output_handles,
+                                 NDArrayHandle *ograd_handles,
+                                 int retain_graph) {
+  return backward_impl(num_output, output_handles, ograd_handles, retain_graph,
+                       /*is_train=*/1);
+}
+
+MXTPU_DLL int MXAutogradBackwardEx(mx_uint num_output,
+                                   NDArrayHandle *output_handles,
+                                   NDArrayHandle *ograd_handles,
+                                   mx_uint num_variables,
+                                   NDArrayHandle *var_handles, int retain_graph,
+                                   int create_graph, int is_train,
+                                   NDArrayHandle **grad_handles,
+                                   int **grad_stypes) {
+  if (num_variables != 0 || var_handles != nullptr || create_graph != 0 ||
+      grad_handles != nullptr || grad_stypes != nullptr) {
+    return fail_msg(
+        "MXAutogradBackwardEx: only the mark_variables/.grad flow is "
+        "supported (num_variables=0, create_graph=0)");
+  }
+  return backward_impl(num_output, output_handles, ograd_handles, retain_graph,
+                       is_train);
+}
+
+// ---------------------------------------------------------------------------
+// KVStore
+// ---------------------------------------------------------------------------
+
+MXTPU_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(s)", type);
+  PyObject *r = bcall("kv_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;
+  return 0;
+}
+
+MXTPU_DLL int MXKVStoreFree(KVStoreHandle handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXTPU_DLL int MXKVStoreGetType(KVStoreHandle handle, const char **out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("kv_type", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  tls_ret.strings.assign(1, PyUnicode_AsUTF8(r));
+  Py_DECREF(r);
+  *out = tls_ret.strings[0].c_str();
+  return 0;
+}
+
+static int kv_keys_op(const char *fn, KVStoreHandle handle, mx_uint num,
+                      const char **keys, NDArrayHandle *vals, int priority) {
+  Gil gil;
+  PyObject *pykeys = str_list(num, keys);
+  PyObject *pyvals = handle_list(num, vals);
+  PyObject *args =
+      Py_BuildValue("(OOOi)", reinterpret_cast<PyObject *>(handle), pykeys,
+                    pyvals, priority);
+  Py_DECREF(pykeys);
+  Py_DECREF(pyvals);
+  PyObject *r = bcall(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals) {
+  return kv_keys_op("kv_init", handle, num, keys, vals, /*priority=*/0);
+}
+
+MXTPU_DLL int MXKVStorePushEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority) {
+  return kv_keys_op("kv_push", handle, num, keys, vals, priority);
+}
+
+MXTPU_DLL int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority) {
+  return kv_keys_op("kv_pull", handle, num, keys, vals, priority);
+}
+
+// ---------------------------------------------------------------------------
+// Misc
+// ---------------------------------------------------------------------------
+
+MXTPU_DLL int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(i)", seed);
+  PyObject *r = bcall("random_seed", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}

@@ -1,0 +1,271 @@
+"""C API ABI tests (src/c_api.cc + mxnet_tpu/capi.py + cpp/ frontend).
+
+Reference parity: include/mxnet/c_api.h is the surface every non-Python
+frontend consumes (src/c_api/c_api.cc); cpp-package builds its NDArray/
+Operator classes on it.  These tests drive the TPU build's ABI the same two
+ways the reference's is driven:
+
+  * in-process through ctypes (the ABI loaded into an interpreter that
+    already hosts the runtime — the language-binding configuration), and
+  * from a standalone C++ binary that embeds the interpreter via the ABI
+    (cpp/examples/train_mlp.cpp — the cpp-package configuration), asserting
+    an end-to-end autograd+SGD training run actually learns.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu  # noqa: F401  (runtime must be importable for the bridge)
+from mxnet_tpu import capi
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    return capi.load()
+
+
+def _create(lib, shape, dtype=0):
+    arr = (ctypes.c_uint32 * len(shape))(*shape)
+    h = ctypes.c_void_p()
+    rc = lib.MXNDArrayCreateEx(arr, len(shape), 1, 0, 0, dtype,
+                               ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError()
+    return h
+
+
+def _copy_in(lib, h, np_arr):
+    """size argument is an ELEMENT count (reference ABI contract)."""
+    np_arr = np.ascontiguousarray(np_arr)
+    rc = lib.MXNDArraySyncCopyFromCPU(
+        h, np_arr.ctypes.data_as(ctypes.c_void_p), np_arr.size)
+    assert rc == 0, lib.MXGetLastError()
+
+
+def _copy_out(lib, h, shape, dtype=np.float32):
+    out = np.zeros(shape, dtype=dtype)
+    rc = lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.size)
+    assert rc == 0, lib.MXGetLastError()
+    return out
+
+
+def _op_handle(lib, name):
+    h = ctypes.c_void_p()
+    rc = lib.NNGetOpHandle(name.encode(), ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError()
+    return h
+
+
+def _invoke(lib, op, in_handles, attrs=None, out_handles=None):
+    """Returns list of output handles (owned by caller unless out_handles)."""
+    attrs = attrs or {}
+    keys = (ctypes.c_char_p * len(attrs))(*[k.encode() for k in attrs])
+    vals = (ctypes.c_char_p * len(attrs))(*[str(v).encode()
+                                            for v in attrs.values()])
+    ins = (ctypes.c_void_p * len(in_handles))(*[h.value for h in in_handles])
+    if out_handles:
+        n_out = ctypes.c_int(len(out_handles))
+        out_arr = (ctypes.c_void_p * len(out_handles))(
+            *[h.value for h in out_handles])
+        pout = ctypes.cast(out_arr, ctypes.POINTER(ctypes.c_void_p))
+        rc = lib.MXImperativeInvoke(_op_handle(lib, op), len(in_handles), ins,
+                                    ctypes.byref(n_out), ctypes.byref(pout),
+                                    len(attrs), keys, vals)
+        assert rc == 0, lib.MXGetLastError()
+        return list(out_handles)
+    n_out = ctypes.c_int(0)
+    pout = ctypes.POINTER(ctypes.c_void_p)()
+    rc = lib.MXImperativeInvoke(_op_handle(lib, op), len(in_handles), ins,
+                                ctypes.byref(n_out), ctypes.byref(pout),
+                                len(attrs), keys, vals)
+    assert rc == 0, lib.MXGetLastError()
+    # copy handles out of the thread-local return store before the next call
+    return [ctypes.c_void_p(pout[i]) for i in range(n_out.value)]
+
+
+def test_version_and_error_surface(lib):
+    v = ctypes.c_int()
+    assert lib.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value == 10300
+    bad = ctypes.c_void_p()
+    assert lib.NNGetOpHandle(b"definitely_not_an_op", ctypes.byref(bad)) == -1
+    assert b"unknown operator" in lib.MXGetLastError()
+
+
+def test_ndarray_create_copy_shape_dtype(lib):
+    h = _create(lib, (3, 4))
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    _copy_in(lib, h, x)
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert [pdata[i] for i in range(ndim.value)] == [3, 4]
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 0  # float32 type flag (mshadow code)
+    np.testing.assert_array_equal(_copy_out(lib, h, (3, 4)), x)
+    # size-mismatch is an error, not a truncation
+    small = np.zeros(2, dtype=np.float32)
+    rc = lib.MXNDArraySyncCopyToCPU(
+        h, small.ctypes.data_as(ctypes.c_void_p), small.size)
+    assert rc == -1 and b"size mismatch" in lib.MXGetLastError()
+    assert lib.MXNDArrayFree(h) == 0
+
+
+def test_int32_dtype_roundtrip(lib):
+    h = _create(lib, (2, 2), dtype=4)  # int32 flag
+    x = np.array([[1, -2], [3, -4]], dtype=np.int32)
+    _copy_in(lib, h, x)
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0 and dt.value == 4
+    np.testing.assert_array_equal(_copy_out(lib, h, (2, 2), np.int32), x)
+    lib.MXNDArrayFree(h)
+
+
+def test_list_all_op_names(lib):
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)) == 0
+    names = {arr[i].decode() for i in range(n.value)}
+    assert len(names) >= 300
+    assert {"FullyConnected", "Convolution", "relu", "sgd_update"} <= names
+
+
+def test_imperative_invoke_allocated_outputs(lib):
+    h = _create(lib, (2, 3))
+    x = np.array([[-1, 2, -3], [4, -5, 6]], dtype=np.float32)
+    _copy_in(lib, h, x)
+    outs = _invoke(lib, "relu", [h])
+    assert len(outs) == 1
+    np.testing.assert_array_equal(_copy_out(lib, outs[0], (2, 3)),
+                                  np.maximum(x, 0))
+    lib.MXNDArrayFree(outs[0])
+    lib.MXNDArrayFree(h)
+
+
+def test_imperative_invoke_with_attrs_and_out(lib):
+    h = _create(lib, (4, 8))
+    _copy_in(lib, h, np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    w = _create(lib, (5, 8))
+    _copy_in(lib, w, np.random.RandomState(1).rand(5, 8).astype(np.float32))
+    b = _create(lib, (5,))
+    _copy_in(lib, b, np.zeros(5, dtype=np.float32))
+    outs = _invoke(lib, "FullyConnected", [h, w, b], {"num_hidden": 5})
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    lib.MXNDArrayGetShape(outs[0], ctypes.byref(ndim), ctypes.byref(pdata))
+    assert [pdata[i] for i in range(ndim.value)] == [4, 5]
+    # caller-provided out: write relu(out) back into a preallocated target
+    target = _create(lib, (4, 5))
+    _invoke(lib, "relu", [outs[0]], out_handles=[target])
+    got = _copy_out(lib, target, (4, 5))
+    assert (got >= 0).all()
+    for hh in (outs[0], target, h, w, b):
+        lib.MXNDArrayFree(hh)
+
+
+def test_autograd_through_abi(lib):
+    """mark -> record -> op -> backward -> grad, all via C entry points."""
+    x = _create(lib, (2, 2))
+    _copy_in(lib, x, np.array([[1., 2.], [3., 4.]], dtype=np.float32))
+    gbuf = _create(lib, (2, 2))
+    _copy_in(lib, gbuf, np.zeros((2, 2), dtype=np.float32))
+    req = (ctypes.c_uint32 * 1)(1)  # write
+    xs = (ctypes.c_void_p * 1)(x.value)
+    gs = (ctypes.c_void_p * 1)(gbuf.value)
+    assert lib.MXAutogradMarkVariables(1, xs, req, gs) == 0, \
+        lib.MXGetLastError()
+
+    prev = ctypes.c_int()
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    assert lib.MXAutogradSetIsTraining(1, ctypes.byref(prev)) == 0
+    cur = ctypes.c_bool()
+    assert lib.MXAutogradIsRecording(ctypes.byref(cur)) == 0 and cur.value
+    y = _invoke(lib, "square", [x])[0]
+    loss = _invoke(lib, "sum", [y])[0]
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    assert prev.value == 1
+    assert lib.MXAutogradSetIsTraining(0, ctypes.byref(prev)) == 0
+
+    heads = (ctypes.c_void_p * 1)(loss.value)
+    assert lib.MXAutogradBackward(1, heads, None, 0) == 0, lib.MXGetLastError()
+    g = ctypes.c_void_p()
+    assert lib.MXNDArrayGetGrad(x, ctypes.byref(g)) == 0
+    assert g.value is not None
+    np.testing.assert_allclose(
+        _copy_out(lib, g, (2, 2)),
+        2 * np.array([[1., 2.], [3., 4.]], dtype=np.float32))
+    for hh in (g, loss, y, gbuf, x):
+        lib.MXNDArrayFree(hh)
+
+
+def test_kvstore_through_abi(lib):
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0, \
+        lib.MXGetLastError()
+    t = ctypes.c_char_p()
+    assert lib.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+    assert t.value == b"local"
+    init = _create(lib, (4,))
+    _copy_in(lib, init, np.zeros(4, dtype=np.float32))
+    keys = (ctypes.c_char_p * 1)(b"w0")
+    vals = (ctypes.c_void_p * 1)(init.value)
+    assert lib.MXKVStoreInitEx(kv, 1, keys, vals) == 0, lib.MXGetLastError()
+    push = _create(lib, (4,))
+    _copy_in(lib, push, np.array([1., 2., 3., 4.], dtype=np.float32))
+    pvals = (ctypes.c_void_p * 1)(push.value)
+    assert lib.MXKVStorePushEx(kv, 1, keys, pvals, 0) == 0, \
+        lib.MXGetLastError()
+    out = _create(lib, (4,))
+    ovals = (ctypes.c_void_p * 1)(out.value)
+    assert lib.MXKVStorePullEx(kv, 1, keys, ovals, 0) == 0, \
+        lib.MXGetLastError()
+    np.testing.assert_allclose(_copy_out(lib, out, (4,)),
+                               np.array([1., 2., 3., 4.], dtype=np.float32))
+    for hh in (init, push, out):
+        lib.MXNDArrayFree(hh)
+    assert lib.MXKVStoreFree(kv) == 0
+
+
+def test_waitall_and_seed(lib):
+    assert lib.MXRandomSeed(123) == 0
+    assert lib.MXNDArrayWaitAll() == 0
+
+
+def test_cpp_frontend_trains():
+    """Compile cpp/examples/train_mlp.cpp against the ABI and run it as a
+    standalone process (embedded interpreter) — the cpp-package analog."""
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    capi.build()
+    binary = os.path.join(REPO, "build", "train_mlp")
+    src = os.path.join(REPO, "cpp", "examples", "train_mlp.cpp")
+    headers = [os.path.join(REPO, "cpp", "include", h)
+               for h in ("mxnet_tpu.hpp", "mxnet_tpu_c_api.h")]
+    newest_input = max(os.path.getmtime(p) for p in [src] + headers)
+    if (not os.path.exists(binary)
+            or os.path.getmtime(binary) < newest_input):
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", src,
+             "-I" + os.path.join(REPO, "cpp", "include"),
+             "-L" + os.path.join(REPO, "build"), "-lmxnet_tpu_c",
+             "-Wl,-rpath," + os.path.join(REPO, "build"),
+             "-o", binary],
+            check=True, capture_output=True, timeout=300)
+    env = capi.embed_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single CPU device is enough and faster
+    proc = subprocess.run([binary], env=env, capture_output=True, text=True,
+                          timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "TRAIN_MLP OK" in proc.stdout
