@@ -262,3 +262,36 @@ def test_lstnet_example_beats_naive():
     assert model < 0.6, "RSE %.3f too high\n%s" % (model, res.stdout)
     assert model < naive / 2, "no edge over naive: %.3f vs %.3f" % (
         model, naive)
+
+
+def test_fcn_xs_example_segments():
+    """FCN-16s segmentation (example/fcn-xs/fcn_xs.py): Deconvolution
+    upsampling + Crop-to-reference + skip fusion + multi-output softmax
+    through the symbolic Module path must push held-out mean IoU well
+    above the untrained net's (reference example/fcn-xs/symbol_fcnxs.py)."""
+    import re
+    res = _run("example/fcn-xs/fcn_xs.py", timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "FCN_XS OK" in res.stdout, res.stdout[-2000:]
+    m = re.search(r"mean IoU before ([\d.]+) after ([\d.]+)", res.stdout)
+    assert m and float(m.group(2)) > 0.55
+
+
+def test_matrix_fact_example_generalizes():
+    """Matrix-factorization recommender (example/recommenders/
+    matrix_fact.py): embedding-dot-product MF must recover the noise floor
+    on HELD-OUT (user, item) pairs, not just fit the training triples
+    (reference example/recommenders/matrix_fact.py)."""
+    res = _run("example/recommenders/matrix_fact.py", timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "MATRIX_FACT OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_fgsm_example_attacks():
+    """FGSM adversary (example/adversary/fgsm.py): input-gradient attack
+    must collapse accuracy while an equal-magnitude random-sign
+    perturbation does not (reference example/adversary/
+    adversary_generation.ipynb) — exercising autograd w.r.t. DATA."""
+    res = _run("example/adversary/fgsm.py", timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "FGSM OK" in res.stdout, res.stdout[-2000:]
