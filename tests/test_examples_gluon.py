@@ -295,3 +295,14 @@ def test_fgsm_example_attacks():
     res = _run("example/adversary/fgsm.py", timeout=600)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "FGSM OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_lstm_crf_example_finds_structure():
+    """BiLSTM-CRF (example/gluon/lstm_crf.py): I-tokens are emission-
+    identical to O-tokens, so only the CRF's transition structure can
+    find them — the emission-only ablation must score I-F1 0 while the
+    CRF clears 0.5 with zero BIO violations (reference
+    example/gluon/lstm_crf.py)."""
+    res = _run("example/gluon/lstm_crf.py", timeout=800)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "LSTM_CRF OK" in res.stdout, res.stdout[-2000:]
