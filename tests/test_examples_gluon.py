@@ -300,8 +300,9 @@ def test_fgsm_example_attacks():
 def test_lstm_crf_example_finds_structure():
     """BiLSTM-CRF (example/gluon/lstm_crf.py): I-tokens are emission-
     identical to O-tokens, so only the CRF's transition structure can
-    find them — the emission-only ablation must score I-F1 0 while the
-    CRF clears 0.5 with zero BIO violations (reference
+    find them.  The script's own exit gates (lstm_crf.py main) are
+    crf_f1 > ablation_f1 + 0.15 (structure, not emissions, drives the
+    margin) and BIO-violation rate < 1% of eval positions (reference
     example/gluon/lstm_crf.py)."""
     res = _run("example/gluon/lstm_crf.py", timeout=800)
     assert res.returncode == 0, res.stderr[-2000:]
