@@ -24,7 +24,10 @@ executor + memory planner + op bulking, all in the compiler).  Notes:
 """
 from __future__ import annotations
 
+import time as _time
+
 from . import autograd
+from . import profiler
 from .base import MXNetError
 
 __all__ = ["CachedOp"]
@@ -153,12 +156,18 @@ class CachedOp:
         jitted = self._get_jitted(training)
         n_aux = len(self._aux_names)
 
-        if recording:
+        if profiler.profiling_imperative():
+            # one span per compiled-graph dispatch, named like the
+            # reference's _CachedOp engine op (cached_op.cc registers the
+            # whole capture as a single profilable op)
+            _t0 = _time.time()
             flat_out = jitted(*vals)
-            vjp_fn = _LazyVjp(self._get_bwd(training), vals)
+            profiler.record_op_span("_CachedOp", _t0, _time.time(),
+                                    cat="cached_op")
         else:
             flat_out = jitted(*vals)
-            vjp_fn = None
+        vjp_fn = (_LazyVjp(self._get_bwd(training), vals)
+                  if recording else None)
 
         if n_aux:
             out_vals = flat_out[:-n_aux]

@@ -19,12 +19,15 @@ jit-cached apply, wrap outputs, record on the tape when autograd is active.
 """
 from __future__ import annotations
 
+import time as _time
+
 import numpy as _np
 
 from ..base import MXNetError, numeric_types, integer_types
 from ..context import Context, current_context
 from ..ops.registry import get_op
 from .. import autograd
+from .. import profiler
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "moveaxis", "concat", "stack", "_wrap", "from_jax", "waitall"]
@@ -511,7 +514,19 @@ def from_jax(jax_value, ctx=None):
 def invoke(op_name, inputs, attrs, out=None):
     """Imperative op invocation — the analog of Imperative::Invoke
     (src/imperative/imperative.cc:87): resolve op, apply (jit-cached),
-    wrap/record/write-out."""
+    wrap/record/write-out.  While profiling, every dispatch — including
+    the sparse/FComputeEx early returns — becomes a span + aggregate row
+    (ProfileOperator analog, src/profiler/profiler.h)."""
+    if profiler.profiling_imperative():
+        _t0 = _time.time()
+        try:
+            return _invoke(op_name, inputs, attrs, out)
+        finally:
+            profiler.record_op_span(op_name, _t0, _time.time())
+    return _invoke(op_name, inputs, attrs, out)
+
+
+def _invoke(op_name, inputs, attrs, out=None):
     if (op_name == "Embedding" and out is None and autograd.is_recording()
             and str(attrs.get("sparse_grad", False)).lower() in ("true", "1")):
         # sparse_grad: record a row-sparse weight cotangent instead of the

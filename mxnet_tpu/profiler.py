@@ -39,6 +39,11 @@ def set_config(**kwargs):
 def set_state(state_="stop", profile_process="worker"):
     import jax
     if state_ == "run" and not _state["running"]:
+        with _lock:
+            # each session is a fresh trace: without this, a long-lived
+            # process that profiles periodically re-emits every prior
+            # session's spans on dump() and grows the buffer unboundedly
+            _events.clear()
         trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
         try:
             jax.profiler.start_trace(trace_dir)
@@ -67,6 +72,29 @@ def resume(profile_process="worker"):
     set_state("run")
 
 
+def profiling_imperative():
+    """True when imperative op dispatch should be recorded — the gate the
+    dispatch hot path checks (ProfileOperator's `IsProfiling` analog)."""
+    return _state["running"] and _config.get("profile_imperative", True)
+
+
+def record_op_span(name, t0_s, t1_s, cat="operator"):
+    """One imperative op dispatch: B/E trace events + aggregate-table bump
+    (src/profiler ProfileOperator analog).  Times are ``time.time()``
+    seconds (the same timebase _record uses, so spans line up with
+    Domain/Task events in the dumped trace) and measure host dispatch
+    cost; device-side op timing is the XPlane trace captured alongside
+    (see set_state)."""
+    with _lock:
+        for ph, ts in (("B", t0_s), ("E", t1_s)):
+            _events.append({"name": name, "cat": cat, "ph": ph,
+                            "ts": ts * 1e6, "pid": os.getpid(),
+                            "tid": threading.get_ident(), "args": {}})
+        a = _agg[name]
+        a[0] += 1
+        a[1] += (t1_s - t0_s) * 1e3
+
+
 def _record(name, cat, ph, ts=None, args=None):
     with _lock:
         _events.append({"name": name, "cat": cat, "ph": ph,
@@ -77,9 +105,13 @@ def _record(name, cat, ph, ts=None, args=None):
 
 def dump(finished=True, profile_process="worker"):
     """Write accumulated host events as chrome://tracing JSON; device-side
-    XPlane traces (if any) are in <filename>_xplane for TensorBoard."""
+    XPlane traces (if any) are in <filename>_xplane for TensorBoard.
+    ``finished=True`` (the reference default) also retires the event
+    buffer, so a later session starts clean."""
     with _lock:
         payload = {"traceEvents": list(_events)}
+        if finished:
+            _events.clear()
     with open(_config["filename"], "w") as f:
         json.dump(payload, f)
 

@@ -72,6 +72,43 @@ def test_profiler_aggregate_and_objects(tmp_path):
     assert any("mytask" in str(n) for n in names)
 
 
+def test_profiler_records_imperative_ops_and_cached_op(tmp_path):
+    """Every imperative dispatch while profiling lands in the aggregate
+    table and the trace (ProfileOperator analog, reference
+    src/profiler/profiler.h: engine ops are wrapped when profiling is on);
+    a hybridized forward shows up as one _CachedOp row, matching the
+    reference's registration of the whole capture as a single op
+    (src/imperative/cached_op.cc)."""
+    fname = str(tmp_path / "ops_profile.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.dumps(reset=True)
+    mx.profiler.set_state("run")
+    a = nd.ones((8, 8))
+    (a @ a).wait_to_read()
+    nd.relu(a).wait_to_read()
+
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    # first post-hybridize call builds the graph imperatively; the cached
+    # module serves the second
+    net(nd.ones((2, 8))).wait_to_read()
+    net(nd.ones((2, 8))).wait_to_read()
+    mx.profiler.set_state("stop")
+
+    table = mx.profiler.dumps(reset=True)
+    assert "relu" in table
+    assert "_CachedOp" in table
+    mx.profiler.dump()
+    import json
+    events = json.load(open(fname))["traceEvents"]
+    spans = [e for e in events if e.get("name") == "relu"]
+    assert {e["ph"] for e in spans} == {"B", "E"}
+    # ops dispatched with profiling stopped must NOT be recorded
+    nd.relu(a).wait_to_read()
+    assert "relu" not in mx.profiler.dumps()
+
+
 def test_merge_dumps_skips_nameless_metadata_events(tmp_path):
     """Chrome traces from external tools carry name-less 'M' metadata
     events; merge_dumps must skip them rather than KeyError."""

@@ -160,6 +160,15 @@ BATTERY = [
     ("int8_infer", [sys.executable, "bench.py"],
      {"BENCH_MODE": "int8", "BENCH_BUDGET": "700",
       "BENCH_TIMEOUT": "400"}, 800),
+    # beyond-parity: Pallas flash attention vs dense XLA attention on chip
+    # (writes its own ATTN_BENCH.json; the summary line lands in LIVE too)
+    ("attn_fused", [sys.executable, "tools/attn_bench.py",
+                    "--seqs", "1024,2048,4096", "--iters", "5"],
+     {}, 700),
+    # observability on hardware: mx.profiler aggregate table + XPlane trace
+    # around real train steps (writes PROFILE_TPU.json)
+    ("profiler", [sys.executable, "tools/profile_capture.py"],
+     {}, 500),
 ]
 
 
