@@ -18,6 +18,24 @@ from .sparse import cast_storage
 _register.install_ops(_sys.modules[__name__])
 
 
+class _Internal:
+    """``mx.nd._internal`` — the reference generates a module holding every
+    ``_``-prefixed op (python/mxnet/base.py:578 routes them there; e.g.
+    square_sum.cc:61 documents ``mx.nd._internal._square_sum``).  Here the
+    underscore ops already live on ``nd`` itself, so this is a view."""
+
+    def __getattr__(self, name):
+        if name.startswith("_") and not name.startswith("__"):
+            try:
+                return getattr(_sys.modules[__name__], name)
+            except AttributeError:
+                pass
+        raise AttributeError("mx.nd._internal has no op %r" % name)
+
+
+_internal = _Internal()
+
+
 def save(fname, data):
     from .utils import save as _save
     return _save(fname, data)

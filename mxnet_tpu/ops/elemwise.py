@@ -257,6 +257,18 @@ def _add_n(attrs, *arrays):
 alias("ElementWiseSum", "add_n")
 
 
+@register("hard_sigmoid")
+def _hard_sigmoid(attrs, x):
+    """clip(alpha*x + beta, 0, 1) (reference
+    src/operator/tensor/elemwise_unary_op_basic.cc:109, HardSigmoidParam
+    defaults alpha=0.2 beta=0.5 at elemwise_unary_op.h:395); the clip's
+    vjp matches the reference's zero-outside-(0,1) backward."""
+    jnp = _jnp()
+    alpha = float(attrs.get("alpha", 0.2))
+    beta = float(attrs.get("beta", 0.5))
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
 @register("smooth_l1")
 def _smooth_l1(attrs, x):
     jnp = _jnp()

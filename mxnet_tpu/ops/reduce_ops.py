@@ -58,6 +58,13 @@ alias("sum_axis", "sum")
 alias("max_axis", "max")
 alias("min_axis", "min")
 
+# fused square+sum (reference src/operator/tensor/square_sum.cc:50
+# `_square_sum`, the reduce used on row_sparse gradients e.g. by
+# clip_global_norm); dense path here, the row_sparse FComputeEx that skips
+# absent rows lives in sparse_ops.py
+_make_reduce("_square_sum",
+             lambda jnp, x, a, k: jnp.sum(jnp.square(x), axis=a, keepdims=k))
+
 
 @register("norm")
 def _norm(attrs, x):

@@ -106,6 +106,52 @@ def _add_ex(attrs, lhs, rhs):
 
 
 # ---------------------------------------------------------------------------
+# _square_sum over row_sparse (src/operator/tensor/square_sum-inl.h: the
+# reduce touches only stored rows — zeros contribute nothing to sum(x^2))
+# ---------------------------------------------------------------------------
+
+@register_sparse("_square_sum")
+def _square_sum_ex(attrs, x):
+    if not _is_stype(x, "row_sparse") or len(x.shape) != 2:
+        return NotImplemented
+    jnp = _jnp()
+    from .reduce_ops import _norm_axis
+    axis = _norm_axis(attrs.get("axis"))
+    if isinstance(axis, int):
+        axis = (axis,)
+    if axis is not None:
+        axis = tuple(sorted(a % 2 for a in axis))  # fold negatives (ndim=2)
+    keepdims = bool(attrs.get("keepdims", False))
+    if bool(attrs.get("exclude", False)):
+        return NotImplemented
+    aux = x._get_aux()
+    data, idx = aux["data"], aux["indices"]
+    if axis == (1,):
+        vals = jnp.sum(jnp.square(data), axis=1, keepdims=True)
+        if keepdims:
+            # reference semantics: per-row reduce of a row_sparse input
+            # keeps the output row_sparse over the same stored rows
+            # (square_sum.cc:61)
+            from ..ndarray.sparse import RowSparseNDArray
+            return RowSparseNDArray(_wrap(vals, x), _wrap(idx, x),
+                                    (x.shape[0], 1), ctx=x._ctx,
+                                    _sorted=True)
+        out = jnp.zeros((x.shape[0],), data.dtype).at[idx].set(vals[:, 0])
+        return _wrap(out, x)
+    if axis == (0,):
+        out = jnp.sum(jnp.square(data), axis=0,
+                      keepdims=keepdims)  # absent rows add nothing
+        if keepdims:
+            out = out.reshape((1, x.shape[1]))
+        return _wrap(out, x)
+    if axis is None:
+        out = jnp.sum(jnp.square(data))
+        out = out.reshape((1, 1) if keepdims else (1,))
+        return _wrap(out, x)
+    return NotImplemented  # axis=(0,1): rare spelling, dense fallback
+
+
+# ---------------------------------------------------------------------------
 # lazy-update optimizer kernels (row_sparse gradient)
 # ---------------------------------------------------------------------------
 

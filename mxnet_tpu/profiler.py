@@ -37,13 +37,20 @@ def set_config(**kwargs):
 
 
 def set_state(state_="stop", profile_process="worker"):
+    return _set_state(state_, fresh=True)
+
+
+def _set_state(state_, fresh):
     import jax
     if state_ == "run" and not _state["running"]:
-        with _lock:
-            # each session is a fresh trace: without this, a long-lived
-            # process that profiles periodically re-emits every prior
-            # session's spans on dump() and grows the buffer unboundedly
-            _events.clear()
+        if fresh:
+            with _lock:
+                # each session is a fresh trace: without this, a long-lived
+                # process that profiles periodically re-emits every prior
+                # session's spans on dump() and grows the buffer unboundedly.
+                # resume() passes fresh=False so a pause/resume cycle keeps
+                # the pre-pause spans.
+                _events.clear()
         trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
         try:
             jax.profiler.start_trace(trace_dir)
@@ -65,11 +72,11 @@ def state():
 
 
 def pause(profile_process="worker"):
-    set_state("stop")
+    _set_state("stop", fresh=False)
 
 
 def resume(profile_process="worker"):
-    set_state("run")
+    _set_state("run", fresh=False)
 
 
 def profiling_imperative():

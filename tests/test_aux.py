@@ -195,3 +195,23 @@ def test_profiler_autostart_env(tmp_path):
                              os.path.abspath(__file__))))
     assert res.returncode == 0, res.stderr[-1500:]
     assert "running: run" in res.stdout, res.stdout
+
+
+def test_profiler_pause_resume_keeps_prepause_spans(tmp_path):
+    """pause()/resume() suspend collection without discarding the session's
+    earlier spans; only a fresh set_state('run') starts a new trace."""
+    fname = str(tmp_path / "pause_profile.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.set_state("run")
+    nd.relu(nd.ones((4,))).wait_to_read()
+    mx.profiler.pause()
+    nd.sigmoid(nd.ones((4,))).wait_to_read()  # not recorded
+    mx.profiler.resume()
+    nd.tanh(nd.ones((4,))).wait_to_read()
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    mx.profiler.dumps(reset=True)
+    import json
+    names = {e["name"] for e in json.load(open(fname))["traceEvents"]}
+    assert "relu" in names and "tanh" in names
+    assert "sigmoid" not in names

@@ -206,3 +206,23 @@ def test_nd_sparse_namespace():
     kept = nd.sparse.retain(rsp, nd.array(np.array([1], np.int32),
                                           dtype="int32"))
     np.testing.assert_allclose(kept.asnumpy(), dense.asnumpy())
+
+
+def test_hard_sigmoid_matches_reference_formula():
+    """clip(alpha*x+beta, 0, 1) with zero gradient outside the linear band
+    (reference src/operator/tensor/elemwise_unary_op_basic.cc:109)."""
+    x = nd.array([-10.0, -1.0, 0.0, 1.0, 10.0])
+    y = nd.hard_sigmoid(x)
+    np.testing.assert_allclose(
+        y.asnumpy(), np.clip(0.2 * x.asnumpy() + 0.5, 0, 1), rtol=1e-6)
+    y2 = nd.hard_sigmoid(x, alpha=0.5, beta=0.0)
+    np.testing.assert_allclose(
+        y2.asnumpy(), np.clip(0.5 * x.asnumpy(), 0, 1), rtol=1e-6)
+    xg = x.copy()
+    xg.attach_grad()
+    with mx.autograd.record():
+        out = nd.hard_sigmoid(xg)
+    out.backward()
+    g = xg.grad.asnumpy()
+    assert g[0] == 0.0 and g[-1] == 0.0      # saturated ends
+    np.testing.assert_allclose(g[1:4], 0.2)  # linear band slope = alpha

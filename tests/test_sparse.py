@@ -413,3 +413,43 @@ def test_sparse_reduce_across_devices():
     out = kv._reduce([g0, g1])
     assert out.stype == "row_sparse" and out._data_buf is None
     assert_almost_equal(out.data.asnumpy(), np.full((1, 4), 2.0))
+
+
+def test_square_sum_row_sparse_matches_dense():
+    """_square_sum (reference src/operator/tensor/square_sum.cc:50): the
+    row_sparse FComputeEx reduces only stored rows; axis=1 keepdims keeps
+    the output row_sparse over the same rows (square_sum.cc:61)."""
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[4] = [-2, 0, 5]
+    rsp = nd.array(dense).tostype("row_sparse")
+    full = nd._internal._square_sum(rsp)
+    np.testing.assert_allclose(full.asnumpy(), [np.square(dense).sum()],
+                               rtol=1e-6)
+    per_row = nd._internal._square_sum(rsp, axis=1, keepdims=True)
+    assert per_row.stype == "row_sparse"
+    np.testing.assert_allclose(per_row.asnumpy(),
+                               np.square(dense).sum(axis=1, keepdims=True),
+                               rtol=1e-6)
+    # dense input goes through the reduce-op path with identical numbers
+    per_row_dense = nd._internal._square_sum(nd.array(dense), axis=1,
+                                             keepdims=True)
+    np.testing.assert_allclose(per_row_dense.asnumpy(),
+                               per_row.asnumpy(), rtol=1e-6)
+
+
+def test_square_sum_axis_spellings_stay_sparse_path():
+    """axis=-1/[1]/0 spellings must hit the FComputeEx paths, not silently
+    densify: outputs agree with the dense reduce for every spelling."""
+    dense = np.zeros((5, 4), np.float32)
+    dense[0] = [1, 0, 2, 0]
+    dense[3] = [0, -3, 0, 4]
+    rsp = nd.array(dense).tostype("row_sparse")
+    want_rows = np.square(dense).sum(axis=1)
+    for ax in (1, -1, [1]):
+        got = nd._internal._square_sum(rsp, axis=ax)
+        np.testing.assert_allclose(got.asnumpy(), want_rows, rtol=1e-6)
+    got0 = nd._internal._square_sum(rsp, axis=0, keepdims=True)
+    np.testing.assert_allclose(got0.asnumpy(),
+                               np.square(dense).sum(axis=0, keepdims=True),
+                               rtol=1e-6)

@@ -137,7 +137,7 @@ def main():
     # summary from the largest T that produced a speedup — the dense path
     # is EXPECTED to OOM first at long T, and that must not turn a
     # successful capture into a failed one
-    best = next((r for r in reversed(rows) if r.get("fwd_speedup")), None)
+    best = next((r for r in reversed(rows) if "fwd_speedup" in r), None)
     print(json.dumps({"metric": "attn_fused_vs_dense_fwd_speedup_T%d"
                                 % (best["T"] if best else rows[-1]["T"]),
                       "value": best["fwd_speedup"] if best else None,

@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--out", default=OUT_PATH)
     args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1 (a zero-step window would report "
+                 "set_state overhead as a profile)")
 
     import numpy as np
     import jax
