@@ -91,6 +91,34 @@ MXTPU_DLL int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
                               const char **keys, NDArrayHandle *vals,
                               int priority);
 
+// Predict ABI (reference include/mxnet/c_predict_api.h, implementation
+// src/c_api/c_predict_api.cc): standalone float32 inference from symbol
+// JSON + binary .params blob, no Python source at the call site.  Input
+// shapes arrive CSR-style: input_shape_indptr has num_input_nodes+1
+// entries delimiting each input's span in input_shape_data.
+typedef void *PredictorHandle;
+MXTPU_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out);
+MXTPU_DLL int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            PredictorHandle handle, PredictorHandle *out);
+MXTPU_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data, mx_uint *shape_ndim);
+MXTPU_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const float *data, mx_uint size);
+MXTPU_DLL int MXPredForward(PredictorHandle handle);
+MXTPU_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left);
+MXTPU_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              float *data, mx_uint size);
+MXTPU_DLL int MXPredFree(PredictorHandle handle);
+
 // Misc.
 MXTPU_DLL int MXRandomSeed(int seed);
 

@@ -207,3 +207,91 @@ def kv_type(kv):
 def random_seed(seed):
     _random.seed(int(seed))
     return 0
+
+
+# ---------------------------------------------------------------------------
+# Predict ABI (reference include/mxnet/c_predict_api.h, implemented in
+# src/c_api/c_predict_api.cc over the GraphExecutor).  Float32-only IO per
+# the reference contract; the blob is the binary .params list container.
+# ---------------------------------------------------------------------------
+
+class _Predictor:
+    """MXPred* backing object: symbol JSON + param blob -> bound executor."""
+
+    def __init__(self, symbol_json, param_blob, dev_type, dev_id,
+                 input_shapes, arg_params=None, aux_params=None):
+        from .symbol.symbol import load_json
+        from .ndarray.serialization import load_list
+        self._sym = load_json(symbol_json)
+        if arg_params is None:
+            arg_params, aux_params = {}, {}
+            if param_blob:
+                arrays, names = load_list(bytes(param_blob))
+                for n, a in zip(names, arrays):
+                    if n.startswith("arg:"):
+                        arg_params[n[4:]] = a
+                    elif n.startswith("aux:"):
+                        aux_params[n[4:]] = a
+                    else:
+                        arg_params[n] = a
+        self._arg_params, self._aux_params = arg_params, aux_params
+        self._context = _ctx(dev_type, dev_id)
+        self._dev = (dev_type, dev_id)
+        self._input_shapes = {k: tuple(int(x) for x in s)
+                              for k, s in input_shapes.items()}
+        self._ex = self._sym.simple_bind(self._context, grad_req="null",
+                                         **self._input_shapes)
+        self._ex.copy_params_from(arg_params, aux_params or None,
+                                  allow_extra_params=True)
+        self._inputs = {}
+        _, out_shapes, _ = self._sym.infer_shape(**self._input_shapes)
+        self._out_shapes = [tuple(int(x) for x in s) for s in out_shapes]
+
+    def reshape(self, input_shapes):
+        """MXPredReshape: a NEW predictor sharing this one's params."""
+        new_shapes = dict(self._input_shapes)
+        new_shapes.update({k: tuple(int(x) for x in s)
+                           for k, s in input_shapes.items()})
+        return _Predictor(self._sym.tojson(), b"", *self._dev, new_shapes,
+                          arg_params=self._arg_params,
+                          aux_params=self._aux_params)
+
+
+def pred_create(symbol_json, param_blob, dev_type, dev_id, keys, shapes):
+    return _Predictor(symbol_json, param_blob, int(dev_type), int(dev_id),
+                      dict(zip(keys, shapes)))
+
+
+def pred_reshape(pred, keys, shapes):
+    return pred.reshape(dict(zip(keys, shapes)))
+
+
+def pred_output_shape(pred, index):
+    return pred._out_shapes[int(index)]
+
+
+def pred_set_input(pred, key, addr, n_elems):
+    key = str(key)
+    if key not in pred._input_shapes:
+        raise KeyError("MXPredSetInput: %r is not an input (inputs: %s)"
+                       % (key, sorted(pred._input_shapes)))
+    # same size-validated raw-pointer read as MXNDArraySyncCopyFromCPU
+    # (predict ABI is float32-only, per the reference contract)
+    arr = _nd.zeros(pred._input_shapes[key], ctx=pred._context,
+                    dtype=_np.float32)
+    copy_from_addr(arr, addr, n_elems)
+    pred._inputs[key] = arr
+    return 0
+
+
+def pred_forward(pred):
+    missing = sorted(set(pred._input_shapes) - set(pred._inputs))
+    if missing:
+        raise ValueError("MXPredForward: inputs never set: %s" % missing)
+    pred._ex.forward(is_train=False, **pred._inputs)
+    return 0
+
+
+def pred_get_output(pred, index, addr, n_elems):
+    out = pred._ex.outputs[int(index)]
+    return copy_to_addr(out, addr, n_elems)

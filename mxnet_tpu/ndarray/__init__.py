@@ -25,11 +25,12 @@ class _Internal:
     underscore ops already live on ``nd`` itself, so this is a view."""
 
     def __getattr__(self, name):
-        if name.startswith("_") and not name.startswith("__"):
-            try:
-                return getattr(_sys.modules[__name__], name)
-            except AttributeError:
-                pass
+        from ..ops import list_ops
+        # registry-gated: nd also holds non-op underscore attrs (_sys,
+        # _register, ...) that must not leak as ops
+        if name.startswith("_") and not name.startswith("__") \
+                and name in list_ops():
+            return getattr(_sys.modules[__name__], name)
         raise AttributeError("mx.nd._internal has no op %r" % name)
 
 

@@ -120,6 +120,9 @@ def _square_sum_ex(attrs, x):
     if isinstance(axis, int):
         axis = (axis,)
     if axis is not None:
+        if any(a < -2 or a > 1 for a in axis):
+            raise ValueError("_square_sum: axis %s out of range for 2-d "
+                             "input" % (axis,))  # match the dense path's error
         axis = tuple(sorted(a % 2 for a in axis))  # fold negatives (ndim=2)
     keepdims = bool(attrs.get("keepdims", False))
     if bool(attrs.get("exclude", False)):

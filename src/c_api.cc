@@ -24,6 +24,9 @@
 //            -o build/libmxnet_tpu_c.so
 // (see mxnet_tpu/capi.py, which drives this build and caches the result).
 
+// '#' length formats (Py_BuildValue "y#" in MXPredCreate) read Py_ssize_t
+// only under this define; without it the varargs widths mismatch and crash
+#define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
 #include <cstdint>
@@ -588,5 +591,157 @@ MXTPU_DLL int MXRandomSeed(int seed) {
   Py_DECREF(args);
   if (r == nullptr) return fail();
   Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Predict ABI (reference src/c_api/c_predict_api.cc): symbol JSON + binary
+// .params blob -> bound executor; float32 IO per the reference contract.
+// A PredictorHandle owns a PyObject* _Predictor from capi_bridge.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Build ([keys...], [(shape...)...]) from the CSR-style shape arrays.
+int shapes_to_py(mx_uint num, const char **keys, const mx_uint *indptr,
+                 const mx_uint *data, PyObject **out_keys,
+                 PyObject **out_shapes) {
+  PyObject *pykeys = PyList_New(num);
+  PyObject *pyshapes = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SET_ITEM(pykeys, i, PyUnicode_FromString(keys[i]));
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *shape = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(shape, j - lo, PyLong_FromUnsignedLong(data[j]));
+    }
+    PyList_SET_ITEM(pyshapes, i, shape);
+  }
+  *out_keys = pykeys;
+  *out_shapes = pyshapes;
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out) {
+  Gil gil;
+  PyObject *pykeys = nullptr, *pyshapes = nullptr;
+  shapes_to_py(num_input_nodes, input_keys, input_shape_indptr,
+               input_shape_data, &pykeys, &pyshapes);
+  PyObject *args = Py_BuildValue(
+      "(sy#iiOO)", symbol_json_str,
+      static_cast<const char *>(param_bytes),
+      static_cast<Py_ssize_t>(param_size), dev_type, dev_id, pykeys,
+      pyshapes);
+  Py_DECREF(pykeys);
+  Py_DECREF(pyshapes);
+  PyObject *r = bcall("pred_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;  // ownership transferred to the handle
+  return 0;
+}
+
+MXTPU_DLL int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            PredictorHandle handle, PredictorHandle *out) {
+  Gil gil;
+  PyObject *pykeys = nullptr, *pyshapes = nullptr;
+  shapes_to_py(num_input_nodes, input_keys, input_shape_indptr,
+               input_shape_data, &pykeys, &pyshapes);
+  PyObject *args = Py_BuildValue(
+      "(OOO)", reinterpret_cast<PyObject *>(handle), pykeys, pyshapes);
+  Py_DECREF(pykeys);
+  Py_DECREF(pyshapes);
+  PyObject *r = bcall("pred_reshape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;
+  return 0;
+}
+
+MXTPU_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data,
+                                   mx_uint *shape_ndim) {
+  Gil gil;
+  PyObject *args = Py_BuildValue(
+      "(OI)", reinterpret_cast<PyObject *>(handle), index);
+  PyObject *r = bcall("pred_output_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyTuple_Size(r);
+  tls_ret.shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    tls_ret.shape[i] =
+        static_cast<mx_uint>(PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  }
+  Py_DECREF(r);
+  *shape_ndim = static_cast<mx_uint>(n);
+  *shape_data = tls_ret.shape.data();
+  return 0;
+}
+
+MXTPU_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const float *data, mx_uint size) {
+  Gil gil;
+  PyObject *args = Py_BuildValue(
+      "(OsKI)", reinterpret_cast<PyObject *>(handle), key,
+      static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(data)),
+      size);
+  PyObject *r = bcall("pred_set_input", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredForward(PredictorHandle handle) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("pred_forward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left) {
+  // The whole graph is one XLA executable here, so the first step runs
+  // everything (the reference's partial stepping exists to bound host
+  // memory while debugging layer-by-layer; XLA doesn't expose that cut).
+  if (step <= 0) {
+    int rc = MXPredForward(handle);
+    if (rc != 0) return rc;
+  }
+  *step_left = 0;
+  return 0;
+}
+
+MXTPU_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              float *data, mx_uint size) {
+  Gil gil;
+  PyObject *args = Py_BuildValue(
+      "(OIKI)", reinterpret_cast<PyObject *>(handle), index,
+      static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(data)),
+      size);
+  PyObject *r = bcall("pred_get_output", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
   return 0;
 }

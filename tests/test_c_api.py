@@ -269,3 +269,97 @@ def test_cpp_frontend_trains():
                           timeout=900)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "TRAIN_MLP OK" in proc.stdout
+
+
+# ----------------------------------------------------------------- predict ABI
+
+def test_pred_create_forward_matches_python(lib, tmp_path):
+    """MXPred* (reference include/mxnet/c_predict_api.h): a symbol JSON +
+    binary .params blob served through the C ABI must reproduce the python
+    executor's forward bitwise, and MXPredReshape must serve a new batch
+    size with the same params."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    out = mx.sym.Activation(out, act_type="tanh")
+    out = mx.sym.FullyConnected(out, num_hidden=3, name="fc2")
+    rng = np.random.RandomState(7)
+    params = {
+        "arg:fc_weight": nd.array(rng.randn(5, 4).astype(np.float32)),
+        "arg:fc_bias": nd.array(rng.randn(5).astype(np.float32)),
+        "arg:fc2_weight": nd.array(rng.randn(3, 5).astype(np.float32)),
+        "arg:fc2_bias": nd.array(rng.randn(3).astype(np.float32)),
+    }
+    pfile = str(tmp_path / "net.params")
+    nd.save(pfile, params)
+    blob = open(pfile, "rb").read()
+
+    # python-side reference forward
+    ex = out.simple_bind(mx.cpu(), grad_req="null", data=(2, 4))
+    ex.copy_params_from({k[4:]: v for k, v in params.items()})
+    x = rng.randn(2, 4).astype(np.float32)
+    want = ex.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+    # C ABI forward
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape_data = (ctypes.c_uint32 * 2)(2, 4)
+    h = ctypes.c_void_p()
+    rc = lib.MXPredCreate(out.tojson().encode(), blob, len(blob), 1, 0,
+                          1, keys, indptr, shape_data, ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError()
+
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    sndim = ctypes.c_uint32()
+    rc = lib.MXPredGetOutputShape(h, 0, ctypes.byref(sdata),
+                                  ctypes.byref(sndim))
+    assert rc == 0, lib.MXGetLastError()
+    assert [sdata[i] for i in range(sndim.value)] == [2, 3]
+
+    xin = np.ascontiguousarray(x)
+    rc = lib.MXPredSetInput(h, b"data",
+                            xin.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            xin.size)
+    assert rc == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(h) == 0, lib.MXGetLastError()
+    got = np.zeros((2, 3), np.float32)
+    rc = lib.MXPredGetOutput(h, 0,
+                             got.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                             got.size)
+    assert rc == 0, lib.MXGetLastError()
+    np.testing.assert_array_equal(got, want)
+
+    # partial-forward contract: one step runs everything
+    step_left = ctypes.c_int(99)
+    assert lib.MXPredPartialForward(h, 0, ctypes.byref(step_left)) == 0
+    assert step_left.value == 0
+
+    # reshape to batch 4, same params
+    shape4 = (ctypes.c_uint32 * 2)(4, 4)
+    h4 = ctypes.c_void_p()
+    rc = lib.MXPredReshape(1, keys, indptr, shape4, h, ctypes.byref(h4))
+    assert rc == 0, lib.MXGetLastError()
+    x4 = rng.randn(4, 4).astype(np.float32)
+    ex4 = out.simple_bind(mx.cpu(), grad_req="null", data=(4, 4))
+    ex4.copy_params_from({k[4:]: v for k, v in params.items()})
+    want4 = ex4.forward(is_train=False, data=nd.array(x4))[0].asnumpy()
+    rc = lib.MXPredSetInput(h4, b"data",
+                            x4.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            x4.size)
+    assert rc == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(h4) == 0, lib.MXGetLastError()
+    got4 = np.zeros((4, 3), np.float32)
+    assert lib.MXPredGetOutput(
+        h4, 0, got4.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        got4.size) == 0, lib.MXGetLastError()
+    np.testing.assert_array_equal(got4, want4)
+
+    # wrong-size input must error, not corrupt
+    bad = np.zeros(3, np.float32)
+    assert lib.MXPredSetInput(
+        h, b"data", bad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        bad.size) != 0
+    lib.MXPredFree(h)
+    lib.MXPredFree(h4)
