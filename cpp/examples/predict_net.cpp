@@ -1,0 +1,67 @@
+// Standalone C++ deployment: load an exported net (symbol JSON + binary
+// .params) and serve inference through the MXPred* ABI — the analog of the
+// reference's example/image-classification predict-cpp flow over
+// include/mxnet/c_predict_api.h.
+//
+// Usage: predict_net <symbol.json> <net.params> <batch> <feature_dim>
+// Reads batch*feature_dim float32 values from stdin, prints each row's
+// argmax and the output checksum, then PREDICT_NET OK.
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu.hpp"
+
+namespace {
+
+std::string slurp(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw mxtpu::Error(std::string("cannot read ") + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 5) {
+    std::fprintf(stderr,
+                 "usage: %s <symbol.json> <net.params> <batch> <dim>\n",
+                 argv[0]);
+    return 2;
+  }
+  const mx_uint batch = static_cast<mx_uint>(std::atoi(argv[3]));
+  const mx_uint dim = static_cast<mx_uint>(std::atoi(argv[4]));
+  try {
+    mxtpu::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                          {{"data", {batch, dim}}});
+    std::vector<float> x(static_cast<size_t>(batch) * dim);
+    for (float &v : x) {
+      if (std::scanf("%f", &v) != 1) throw mxtpu::Error("short stdin");
+    }
+    pred.SetInput("data", x);
+    pred.Forward();
+    std::vector<mx_uint> oshape = pred.OutputShape(0);
+    std::vector<float> out = pred.GetOutput(0);
+    const mx_uint classes = oshape.back();
+    double checksum = 0.0;
+    for (mx_uint b = 0; b < batch; ++b) {
+      mx_uint arg = 0;
+      for (mx_uint c = 1; c < classes; ++c) {
+        if (out[b * classes + c] > out[b * classes + arg]) arg = c;
+      }
+      std::printf("row %u argmax %u\n", b, arg);
+    }
+    for (float v : out) checksum += v;
+    std::printf("checksum %.6f\n", checksum);
+    std::printf("PREDICT_NET OK\n");
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

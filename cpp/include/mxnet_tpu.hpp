@@ -229,4 +229,57 @@ class SGD {
   float lr_, wd_, rescale_;
 };
 
+// Deployment-side inference over the MXPred* ABI (reference:
+// include/mxnet/c_predict_api.h as used by example/image-classification's
+// predict-cpp).  Float32 IO; one input name per SetInput call.
+class Predictor {
+ public:
+  // param_blob: contents of a binary .params file (arg:/aux: prefixed list
+  // container, the format save_checkpoint / gluon export writes).
+  Predictor(const std::string &symbol_json, const std::string &param_blob,
+            const std::vector<std::pair<std::string, std::vector<mx_uint>>>
+                &input_shapes,
+            int dev_type = 1, int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0}, data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), param_blob.data(),
+                       static_cast<int>(param_blob.size()), dev_type, dev_id,
+                       static_cast<mx_uint>(keys.size()), keys.data(),
+                       indptr.data(), data.data(), &h_));
+  }
+  ~Predictor() {
+    if (h_ != nullptr) MXPredFree(h_);
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  void SetInput(const std::string &key, const std::vector<float> &data) {
+    Check(MXPredSetInput(h_, key.c_str(), data.data(),
+                         static_cast<mx_uint>(data.size())));
+  }
+  void Forward() { Check(MXPredForward(h_)); }
+  std::vector<mx_uint> OutputShape(mx_uint index = 0) {
+    mx_uint *sdata = nullptr, ndim = 0;
+    Check(MXPredGetOutputShape(h_, index, &sdata, &ndim));
+    return std::vector<mx_uint>(sdata, sdata + ndim);
+  }
+  std::vector<float> GetOutput(mx_uint index = 0) {
+    std::vector<mx_uint> shape = OutputShape(index);
+    size_t n = 1;
+    for (mx_uint s : shape) n *= s;
+    std::vector<float> out(n);
+    Check(MXPredGetOutput(h_, index, out.data(),
+                          static_cast<mx_uint>(n)));
+    return out;
+  }
+
+ private:
+  PredictorHandle h_ = nullptr;
+};
+
 }  // namespace mxtpu

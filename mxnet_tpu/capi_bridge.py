@@ -294,4 +294,9 @@ def pred_forward(pred):
 
 def pred_get_output(pred, index, addr, n_elems):
     out = pred._ex.outputs[int(index)]
+    if _np.dtype(out.dtype) != _np.float32:
+        # the predict ABI is float32-only (the reference's c_predict_api
+        # converts); copying at the native width would overflow the
+        # caller's float32 buffer for wider dtypes
+        out = out.astype("float32")
     return copy_to_addr(out, addr, n_elems)

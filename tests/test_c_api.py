@@ -242,14 +242,13 @@ def test_waitall_and_seed(lib):
     assert lib.MXNDArrayWaitAll() == 0
 
 
-def test_cpp_frontend_trains():
-    """Compile cpp/examples/train_mlp.cpp against the ABI and run it as a
-    standalone process (embedded interpreter) — the cpp-package analog."""
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
+def _build_example(name):
+    """Compile cpp/examples/<name>.cpp against the ABI (if stale); returns
+    the binary path.  One recipe shared by every cpp-example test so the
+    build flags cannot drift between them."""
     capi.build()
-    binary = os.path.join(REPO, "build", "train_mlp")
-    src = os.path.join(REPO, "cpp", "examples", "train_mlp.cpp")
+    binary = os.path.join(REPO, "build", name)
+    src = os.path.join(REPO, "cpp", "examples", name + ".cpp")
     headers = [os.path.join(REPO, "cpp", "include", h)
                for h in ("mxnet_tpu.hpp", "mxnet_tpu_c_api.h")]
     newest_input = max(os.path.getmtime(p) for p in [src] + headers)
@@ -262,6 +261,15 @@ def test_cpp_frontend_trains():
              "-Wl,-rpath," + os.path.join(REPO, "build"),
              "-o", binary],
             check=True, capture_output=True, timeout=300)
+    return binary
+
+
+def test_cpp_frontend_trains():
+    """Compile cpp/examples/train_mlp.cpp against the ABI and run it as a
+    standalone process (embedded interpreter) — the cpp-package analog."""
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    binary = _build_example("train_mlp")
     env = capi.embed_env()
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # single CPU device is enough and faster
@@ -363,3 +371,47 @@ def test_pred_create_forward_matches_python(lib, tmp_path):
         bad.size) != 0
     lib.MXPredFree(h)
     lib.MXPredFree(h4)
+
+
+def test_cpp_predictor_binary_matches_python(tmp_path):
+    """Compile cpp/examples/predict_net.cpp and serve an exported net from
+    a standalone process: row argmaxes must match the python forward."""
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=8, name="h")
+    out = mx.sym.Activation(out, act_type="relu")
+    out = mx.sym.FullyConnected(out, num_hidden=4, name="o")
+    out = mx.sym.softmax(out)
+    rng = np.random.RandomState(11)
+    params = {"arg:h_weight": nd.array(rng.randn(8, 6).astype(np.float32)),
+              "arg:h_bias": nd.array(rng.randn(8).astype(np.float32)),
+              "arg:o_weight": nd.array(rng.randn(4, 8).astype(np.float32)),
+              "arg:o_bias": nd.array(rng.randn(4).astype(np.float32))}
+    sym_path = str(tmp_path / "net-symbol.json")
+    with open(sym_path, "w") as f:
+        f.write(out.tojson())
+    params_path = str(tmp_path / "net.params")
+    nd.save(params_path, params)
+
+    x = rng.randn(3, 6).astype(np.float32)
+    ex = out.simple_bind(mx.cpu(), grad_req="null", data=(3, 6))
+    ex.copy_params_from({k[4:]: v for k, v in params.items()})
+    want = ex.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+    binary = _build_example("predict_net")
+    env = capi.embed_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [binary, sym_path, params_path, "3", "6"],
+        input=" ".join("%r" % float(v) for v in x.ravel()),
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PREDICT_NET OK" in proc.stdout
+    for b in range(3):
+        assert ("row %d argmax %d" % (b, int(want[b].argmax()))) \
+            in proc.stdout, (proc.stdout, want.argmax(axis=1))
