@@ -242,6 +242,16 @@ def test_waitall_and_seed(lib):
     assert lib.MXNDArrayWaitAll() == 0
 
 
+def _embedded_env():
+    """Environment for running a cpp-example binary (embedded interpreter).
+    One recipe shared by every cpp-example test so the runtime env cannot
+    drift between them."""
+    env = capi.embed_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single CPU device is enough and faster
+    return env
+
+
 def _build_example(name):
     """Compile cpp/examples/<name>.cpp against the ABI (if stale); returns
     the binary path.  One recipe shared by every cpp-example test so the
@@ -270,10 +280,7 @@ def test_cpp_frontend_trains():
     if shutil.which("g++") is None:
         pytest.skip("no C++ toolchain")
     binary = _build_example("train_mlp")
-    env = capi.embed_env()
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # single CPU device is enough and faster
-    proc = subprocess.run([binary], env=env, capture_output=True, text=True,
+    proc = subprocess.run([binary], env=_embedded_env(), capture_output=True, text=True,
                           timeout=900)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "TRAIN_MLP OK" in proc.stdout
@@ -403,13 +410,10 @@ def test_cpp_predictor_binary_matches_python(tmp_path):
     want = ex.forward(is_train=False, data=nd.array(x))[0].asnumpy()
 
     binary = _build_example("predict_net")
-    env = capi.embed_env()
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
         [binary, sym_path, params_path, "3", "6"],
         input=" ".join("%r" % float(v) for v in x.ravel()),
-        env=env, capture_output=True, text=True, timeout=900)
+        env=_embedded_env(), capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PREDICT_NET OK" in proc.stdout
     for b in range(3):

@@ -307,3 +307,14 @@ def test_lstm_crf_example_finds_structure():
     res = _run("example/gluon/lstm_crf.py", timeout=800)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "LSTM_CRF OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_sgld_example_samples_posterior():
+    """SGLD toy (example/bayesian-methods/sgld_toy.py, reference
+    example/bayesian-methods/sgld.ipynb): batched 4-chain sampling must
+    keep >60% pooled mass within 1.0 of a posterior mode, visit both
+    modes across chains, and hold within-chain spread >4x the no-noise
+    SGD ablation's (the sampler-vs-point-estimator signature)."""
+    res = _run("example/bayesian-methods/sgld_toy.py", timeout=800)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "SGLD_TOY OK" in res.stdout, res.stdout[-2000:]
