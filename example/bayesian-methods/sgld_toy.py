@@ -104,9 +104,7 @@ def main():
     pooled = np.concatenate(list(sgld_chains))
     d = np.linalg.norm(pooled[:, None, :] - MODES[None], axis=-1)
     near_frac = float((d.min(axis=1) < 1.0).mean())
-    modes_hit = {int(m) for c in sgld_chains for m in np.bincount(
-        np.linalg.norm(c[:, None, :] - MODES[None], axis=-1).argmin(axis=1),
-        minlength=2).nonzero()[0]}
+    modes_hit = {int(m) for m in np.unique(d.argmin(axis=1))}
     # the SGLD-vs-point-estimate signature: injected sqrt(lr) noise keeps
     # the chain exploring the local posterior even after the schedule has
     # cooled, while the deterministic full-batch ablation freezes onto its

@@ -1,0 +1,155 @@
+"""CapsNet with dynamic routing (Sabour et al. 2017) — the reference's
+example/capsnet/capsulenet.py + capsulelayers.py (conv -> PrimaryCaps ->
+DigitCaps with routing-by-agreement -> margin loss), scaled to synthetic
+16x16 glyphs and built as one HybridBlock so the three routing iterations
+unroll into a single fused XLA program under hybridize().
+
+Checks: held-out accuracy (argmax of capsule lengths) clears 0.9 and the
+capsule-length margin structure holds — the winning capsule's length
+approaches 0.9 while losers shrink below 0.1 (the margin-loss targets).
+"""
+import argparse
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+CLASSES = 4
+
+
+def squash(s, axis):
+    """v = |s|^2/(1+|s|^2) * s/|s| (capsulelayers.py squash)."""
+    sq = nd.sum(s ** 2, axis=axis, keepdims=True)
+    return sq / (1.0 + sq) * s / nd.sqrt(sq + 1e-9)
+
+
+class CapsNet(gluon.HybridBlock):
+    """conv1 -> PrimaryCaps (conv + caps reshape + squash) -> DigitCaps
+    (3 routing iterations, statically unrolled)."""
+
+    def __init__(self, n_primary=64, d1=8, d2=8, routing=3, **kw):
+        super().__init__(**kw)
+        self.n_primary, self.d1, self.d2 = n_primary, d1, d2
+        self.routing = routing
+        with self.name_scope():
+            self.conv1 = nn.Conv2D(32, kernel_size=5, activation="relu")
+            self.primary = nn.Conv2D(32, kernel_size=5, strides=2)
+            # routing weights W: (1, N1, C, D2, D1)
+            self.W = self.params.get(
+                "routing_weight",
+                shape=(1, n_primary, CLASSES, d2, d1),
+                init=mx.init.Normal(0.1))
+
+    def hybrid_forward(self, F, x, W):
+        B = x.shape[0]
+        h = self.primary(self.conv1(x))          # (B, 32, 4, 4)
+        u = h.reshape((B, self.d1, -1)).transpose((0, 2, 1))  # (B, N1, D1)
+        u = squash(u, axis=2)
+        # prediction vectors u_hat[b,i,c] = W[i,c] @ u[b,i]
+        u5 = u.reshape((B, self.n_primary, 1, 1, self.d1))
+        u_hat = nd.sum(nd.broadcast_mul(u5, W), axis=4)  # (B, N1, C, D2)
+        # routing by agreement, fixed unroll (capsulelayers.py routing loop)
+        b_route = nd.zeros((B, self.n_primary, CLASSES), ctx=x.context)
+        v = None
+        for it in range(self.routing):
+            c = nd.softmax(b_route, axis=2)          # coupling
+            s = nd.sum(u_hat * c.expand_dims(3), axis=1)  # (B, C, D2)
+            v = squash(s, axis=2)
+            if it < self.routing - 1:
+                agree = nd.sum(u_hat * v.expand_dims(1), axis=3)
+                b_route = b_route + agree
+        return nd.sqrt(nd.sum(v ** 2, axis=2) + 1e-9)    # capsule lengths
+
+
+def margin_loss(lengths, y):
+    t = nd.one_hot(y, CLASSES)
+    pos = nd.relu(0.9 - lengths) ** 2
+    neg = nd.relu(lengths - 0.1) ** 2
+    return nd.sum(t * pos + 0.5 * (1 - t) * neg, axis=1).mean()
+
+
+def make_glyphs(rng, n):
+    """Four synthetic glyph classes on a 16x16 canvas: corner square, bar,
+    cross, diagonal — translation-jittered, which is what capsule pose
+    agreement is for."""
+    x = np.zeros((n, 1, 16, 16), np.float32)
+    y = rng.randint(0, CLASSES, n)
+    for i, cls in enumerate(y):
+        dx, dy = rng.randint(0, 6), rng.randint(0, 6)
+        if cls == 0:
+            x[i, 0, 2 + dy:7 + dy, 2 + dx:7 + dx] = 1.0
+        elif cls == 1:
+            x[i, 0, 4 + dy:6 + dy, 1 + dx:11 + dx] = 1.0
+        elif cls == 2:
+            x[i, 0, 3 + dy:9 + dy, 5 + dx:7 + dx] = 1.0
+            x[i, 0, 5 + dy:7 + dy, 3 + dx:9 + dx] = 1.0
+        else:
+            for k in range(8):
+                x[i, 0, 2 + dy + k, 2 + dx + k] = 1.0
+    x += 0.1 * rng.randn(*x.shape).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def accuracy(net, x, y, batch=50):
+    correct = 0
+    for i in range(0, len(x), batch):
+        lengths = net(nd.array(x[i:i + batch]))
+        correct += int((lengths.asnumpy().argmax(1) ==
+                        y[i:i + batch].astype(np.int64)).sum())
+    return correct / len(x)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=2)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(args.seed)
+    xs, ys = make_glyphs(rng, 1600)
+    xt, yt = make_glyphs(rng, 300)
+
+    mx.random.seed(args.seed)
+    net = CapsNet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 2e-3})
+    acc0 = accuracy(net, xt, yt)
+    n = len(xs)
+    for t in range(args.steps):
+        idx = rng.randint(0, n, args.batch)
+        xb, yb = nd.array(xs[idx]), nd.array(ys[idx])
+        with autograd.record():
+            loss = margin_loss(net(xb), yb)
+        loss.backward()
+        trainer.step(1)
+        if t % 30 == 0:
+            print("step %d margin loss %.4f" % (t, float(loss.asnumpy())))
+
+    acc = accuracy(net, xt, yt)
+    lengths = net(nd.array(xt[:200])).asnumpy()
+    yi = yt[:200].astype(np.int64)
+    win = lengths[np.arange(len(yi)), yi].mean()
+    lose = (lengths.sum(1) - lengths[np.arange(len(yi)), yi]).mean() \
+        / (CLASSES - 1)
+    print("accuracy %.3f (untrained %.3f); capsule length win %.3f lose %.3f"
+          % (acc, acc0, win, lose))
+    assert acc > 0.9, "capsnet failed to classify glyphs"
+    assert win > 0.7 and lose < 0.25, \
+        "margin structure missing (win %.3f lose %.3f)" % (win, lose)
+    print("CAPSNET OK")
+
+
+if __name__ == "__main__":
+    main()

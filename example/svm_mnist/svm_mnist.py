@@ -1,0 +1,78 @@
+"""MLP with an SVM (hinge) output layer — the reference's
+example/svm_mnist/svm_mnist.py flow (SVMOutput at svm_mnist.py:44;
+src/operator/svm_output-inl.h: L2-SVM by default, use_linear=True for L1)
+on synthetic MNIST-shaped digits through the Module API.
+
+Trains the same MLP twice — squared-hinge (default) and linear-hinge
+(use_linear) — and checks both clear a held-out accuracy bar.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_digits(rng, n, protos):
+    """Class prototypes + noise: stands in for MNIST in the zero-egress
+    build, same shapes as the reference's iterator.  The SAME prototypes
+    generate train and test so they share a distribution."""
+    classes = protos.shape[0]
+    y = rng.randint(0, classes, n)
+    x = 0.7 * protos[y] + 0.5 * rng.randn(n, protos.shape[1]).astype(
+        np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def build(use_linear):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    # the output layer IS the loss: hinge on the margin, identity at test
+    return mx.sym.SVMOutput(data=net, name="svm", margin=1.0,
+                            regularization_coefficient=1.0,
+                            use_linear=use_linear)
+
+
+def train_and_score(use_linear, xs, ys, xt, yt, epochs, batch):
+    mod = mx.mod.Module(build(use_linear), data_names=["data"],
+                        label_names=["svm_label"], context=mx.cpu())
+    train = mx.io.NDArrayIter(xs, ys, batch, shuffle=True,
+                              label_name="svm_label")
+    val = mx.io.NDArrayIter(xt, yt, batch, label_name="svm_label")
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "wd": 5e-4},
+            initializer=mx.init.Xavier(), num_epoch=epochs,
+            eval_metric="acc")
+    score = mod.score(val, mx.metric.Accuracy())  # score() resets val
+    return dict(score)["accuracy"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(args.seed)
+    protos = rng.randn(10, 784).astype(np.float32)
+    xs, ys = make_digits(rng, 2000, protos)
+    xt, yt = make_digits(rng, 500, protos)
+
+    acc_l2 = train_and_score(False, xs, ys, xt, yt, args.epochs, args.batch)
+    acc_l1 = train_and_score(True, xs, ys, xt, yt, args.epochs, args.batch)
+    print("held-out accuracy: l2-svm %.3f, l1-svm %.3f" % (acc_l2, acc_l1))
+    assert acc_l2 > 0.8, "L2-SVM failed to learn"
+    assert acc_l1 > 0.8, "L1-SVM failed to learn"
+    print("SVM_MNIST OK")
+
+
+if __name__ == "__main__":
+    main()

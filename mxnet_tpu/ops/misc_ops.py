@@ -457,3 +457,11 @@ alias("_unravel_index", "unravel_index")
 #     nd.sparse.retain and ndarray/sparse.sparse_embedding (NDArray-level
 #     by design — storage type is not a traced property).
 #   _slice_assign(_scalar): NDArray.__setitem__.
+
+# symbol-layer wiring for the SVM output head (reference svm_output.cc
+# declares data+label; Module supplies <name>_label like SoftmaxOutput)
+from .registry import get_op as _get_op_
+
+_get_op_("SVMOutput").arg_spec = ["data", "label:label"]
+_get_op_("SVMOutput").param_shape_fn = lambda attrs, in_shapes: {
+    "label": (in_shapes[0][0],)}

@@ -318,3 +318,32 @@ def test_sgld_example_samples_posterior():
     res = _run("example/bayesian-methods/sgld_toy.py", timeout=800)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "SGLD_TOY OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_svm_mnist_example_learns():
+    """SVMOutput end-to-end (example/svm_mnist/svm_mnist.py, reference
+    example/svm_mnist + svm_output-inl.h): both the squared-hinge and the
+    use_linear hinge heads must clear 0.8 held-out accuracy through the
+    Module API."""
+    res = _run("example/svm_mnist/svm_mnist.py", timeout=800)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "SVM_MNIST OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_numpy_softmax_custom_op_example():
+    """Custom numpy softmax op drives a training run to parity with the
+    built-in SoftmaxOutput (example/numpy-ops/numpy_softmax.py, reference
+    example/numpy-ops/numpy_softmax.py over src/operator/custom/)."""
+    res = _run("example/numpy-ops/numpy_softmax.py", timeout=800)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "NUMPY_SOFTMAX OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_capsnet_example_routes_and_classifies():
+    """CapsNet dynamic routing (example/capsnet/capsnet.py, reference
+    example/capsnet/capsulelayers.py): >0.9 held-out accuracy on jittered
+    glyphs AND the margin-loss capsule-length structure (winner ~0.9,
+    losers <0.25)."""
+    res = _run("example/capsnet/capsnet.py", timeout=800)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "CAPSNET OK" in res.stdout, res.stdout[-2000:]
