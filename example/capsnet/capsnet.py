@@ -99,13 +99,15 @@ def make_glyphs(rng, n):
     return x, y.astype(np.float32)
 
 
-def accuracy(net, x, y, batch=50):
-    correct = 0
+def evaluate(net, x, y, batch=50):
+    """Held-out accuracy + the stacked capsule lengths (one compiled
+    batch-size, reused for the margin-structure check)."""
+    correct, lengths = 0, []
     for i in range(0, len(x), batch):
-        lengths = net(nd.array(x[i:i + batch]))
-        correct += int((lengths.asnumpy().argmax(1) ==
-                        y[i:i + batch].astype(np.int64)).sum())
-    return correct / len(x)
+        l = net(nd.array(x[i:i + batch])).asnumpy()
+        lengths.append(l)
+        correct += int((l.argmax(1) == y[i:i + batch].astype(np.int64)).sum())
+    return correct / len(x), np.concatenate(lengths)
 
 
 def main():
@@ -125,7 +127,7 @@ def main():
     net.hybridize()
     trainer = mx.gluon.Trainer(net.collect_params(), "adam",
                                {"learning_rate": 2e-3})
-    acc0 = accuracy(net, xt, yt)
+    acc0, _ = evaluate(net, xt, yt)
     n = len(xs)
     for t in range(args.steps):
         idx = rng.randint(0, n, args.batch)
@@ -137,8 +139,8 @@ def main():
         if t % 30 == 0:
             print("step %d margin loss %.4f" % (t, float(loss.asnumpy())))
 
-    acc = accuracy(net, xt, yt)
-    lengths = net(nd.array(xt[:200])).asnumpy()
+    acc, all_lengths = evaluate(net, xt, yt)
+    lengths = all_lengths[:200]
     yi = yt[:200].astype(np.int64)
     win = lengths[np.arange(len(yi)), yi].mean()
     lose = (lengths.sum(1) - lengths[np.arange(len(yi)), yi]).mean() \

@@ -76,6 +76,7 @@ def train(custom, xs, ys, epochs, batch, seed):
         out = mx.sym.SoftmaxOutput(fc, name="softmax")
     mod = mx.mod.Module(out, data_names=["data"],
                         label_names=["softmax_label"], context=mx.cpu())
+    np.random.seed(seed)  # NDArrayIter(shuffle=True) uses the global RNG
     it = mx.io.NDArrayIter(xs, ys, batch, shuffle=True)
     mx.random.seed(seed)
     mod.fit(it, optimizer="sgd",

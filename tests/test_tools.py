@@ -1,0 +1,84 @@
+"""Operator tooling parity: parse_log / rec2idx / diagnose (reference
+tools/parse_log.py, tools/rec2idx.py, tools/diagnose.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _run_tool(name, *args, stdin=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", name), *args],
+        input=stdin, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=300)
+
+
+LOG = """\
+INFO:root:Epoch[0] Batch [20]\tSpeed: 5000.00 samples/sec\taccuracy=0.5
+INFO:root:Epoch[0] Batch [40]\tSpeed: 7000.00 samples/sec\taccuracy=0.55
+INFO:root:Epoch[0] Train-accuracy=0.620000
+INFO:root:Epoch[0] Time cost=3.200
+INFO:root:Epoch[0] Validation-accuracy=0.600000
+INFO:root:Epoch[1] Train-accuracy=0.910000
+INFO:root:Epoch[1] Time cost=2.900
+INFO:root:Epoch[1] Validation-accuracy=0.880000
+"""
+
+
+def test_parse_log_table():
+    """Module.fit's exact log lines parse into a per-epoch table with mean
+    throughput (reference tools/parse_log.py over the same format)."""
+    import parse_log
+    table = parse_log.parse(LOG.splitlines())
+    assert table[0]["train"]["accuracy"] == 0.62
+    assert table[0]["val"]["accuracy"] == 0.60
+    assert table[0]["time"] == 3.2
+    assert table[0]["speeds"] == [5000.0, 7000.0]
+    assert table[1]["val"]["accuracy"] == 0.88
+
+    res = _run_tool("parse_log.py", "-", "--format", "tsv", stdin=LOG)
+    assert res.returncode == 0, res.stderr
+    lines = res.stdout.strip().splitlines()
+    assert lines[0].split("\t") == ["epoch", "train-accuracy",
+                                    "val-accuracy", "time(s)", "samples/sec"]
+    assert lines[1].split("\t") == ["0", "0.62", "0.6", "3.2", "6000.0"]
+
+
+def test_rec2idx_rebuilds_usable_index(tmp_path):
+    """An index rebuilt from a bare .rec must drive random access
+    (reference tools/rec2idx.py -> MXIndexedRecordIO)."""
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "data.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    payloads = [("payload-%d-" % i).encode() * (i + 1) for i in range(7)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    res = _run_tool("rec2idx.py", rec)
+    assert res.returncode == 0, res.stderr
+    assert "wrote 7 entries" in res.stdout
+
+    r = recordio.MXIndexedRecordIO(str(tmp_path / "data.idx"), rec, "r")
+    for i in (6, 0, 3):
+        assert r.read_idx(i) == payloads[i]
+    r.close()
+
+
+def test_diagnose_runs_and_probes():
+    """diagnose.py prints every section and completes its killable device
+    probe (reference tools/diagnose.py minus network checks)."""
+    res = _run_tool("diagnose.py", "--probe-timeout", "60")
+    assert res.returncode == 0, res.stderr[-2000:]
+    for needle in ("Platform", "Package versions", "Environment knobs",
+                   "Native libraries", "Device probe", "diagnose done"):
+        assert needle in res.stdout, res.stdout
+    assert ("backend up" in res.stdout) or ("probe FAILED" in res.stdout), \
+        res.stdout

@@ -1,0 +1,86 @@
+"""Environment diagnostic (reference tools/diagnose.py: platform, package
+versions, and health checks — minus its network reachability tests, which
+a zero-egress build cannot run).
+
+Prints python/OS/CPU info, the versions of every runtime dependency, the
+honored MXNET_* environment knobs (mxnet_tpu.env registry), the native
+library build states, and a relay-safe device probe (subprocess with a
+timeout — a down axon relay hangs backend init in native code, so the
+probe must be killable).
+
+Usage: python tools/diagnose.py [--probe-timeout 45]
+"""
+import argparse
+import os
+import platform
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def section(title):
+    print("\n----- %s -----" % title)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe-timeout", type=float, default=45.0)
+    args = ap.parse_args()
+
+    section("Platform")
+    print("python   :", sys.version.replace("\n", " "))
+    print("platform :", platform.platform())
+    print("machine  :", platform.machine())
+    try:
+        print("cpus     :", os.cpu_count())
+    except Exception:
+        pass
+
+    section("Package versions")
+    for mod in ("numpy", "jax", "jaxlib", "flax", "optax", "orbax.checkpoint"):
+        try:
+            m = __import__(mod)
+            print("%-18s %s" % (mod, getattr(m, "__version__", "?")))
+        except Exception as e:
+            print("%-18s MISSING (%s)" % (mod, type(e).__name__))
+    import mxnet_tpu
+    print("%-18s %s" % ("mxnet_tpu", mxnet_tpu.__version__))
+
+    section("Environment knobs (mxnet_tpu.env registry)")
+    from mxnet_tpu import env
+    set_knobs = [(k, os.environ[k]) for k in sorted(env.VARIABLES)
+                 if k in os.environ]
+    if set_knobs:
+        for k, v in set_knobs:
+            print("%-40s = %s" % (k, v))
+    else:
+        print("(none set; `env.describe()` lists all %d honored knobs)"
+              % len(env.VARIABLES))
+    for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS"):
+        if k in os.environ:
+            print("%-40s = %s" % (k, os.environ[k]))
+
+    section("Native libraries")
+    for rel in ("build/libmxtpu.so", "build/libmxnet_tpu_c.so"):
+        path = os.path.join(REPO, rel)
+        print("%-28s %s" % (rel, "built (%d bytes)" % os.path.getsize(path)
+                            if os.path.exists(path) else "not built"))
+
+    section("Device probe (subprocess, %gs timeout)" % args.probe_timeout)
+    # one probe implementation for all tools: relay_watcher owns the
+    # killable-subprocess PROBE_OK protocol
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from relay_watcher import probe
+    got = probe(args.probe_timeout)
+    if got:
+        plat, n, kind = got.split(None, 2)
+        print("backend up: platform=%s devices=%s kind=%s" % (plat, n, kind))
+    else:
+        print("probe FAILED or timed out — backend init hung (axon relay "
+              "down?); CPU work still runs with JAX_PLATFORMS=cpu")
+    print("\ndiagnose done")
+
+
+if __name__ == "__main__":
+    main()

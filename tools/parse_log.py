@@ -1,0 +1,88 @@
+"""Parse training logs into a per-epoch table (reference tools/parse_log.py).
+
+Consumes the log format Module.fit / Speedometer emit (base_module.py
+"Epoch[N] Train-<metric>=V" / "Epoch[N] Validation-<metric>=V" /
+"Epoch[N] Time cost=S"; callback.py "Epoch[N] Batch [B]\tSpeed: X
+samples/sec") and prints a markdown or tsv table of train/validation
+metrics, epoch time, and mean throughput.
+
+Usage: python tools/parse_log.py train.log [--format md|tsv] [--metric acc]
+"""
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+EPOCH_RE = re.compile(
+    r"Epoch\[(\d+)\]\s+(?:"
+    r"(Train|Validation)-([\w-]+)=([-\d.eE]+)"
+    r"|Time cost=([-\d.eE]+)"
+    r"|Batch \[\d+\]\s+Speed: ([-\d.eE]+) samples/sec"
+    r")")
+
+
+def parse(lines):
+    """Returns {epoch: {"train": {m: v}, "val": {m: v}, "time": s,
+    "speeds": [..]}} keeping the LAST value per metric (the reference
+    keeps the end-of-epoch value too)."""
+    table = defaultdict(lambda: {"train": {}, "val": {}, "time": None,
+                                 "speeds": []})
+    for line in lines:
+        m = EPOCH_RE.search(line)
+        if not m:
+            continue
+        ep = int(m.group(1))
+        if m.group(2):  # metric row
+            side = "train" if m.group(2) == "Train" else "val"
+            table[ep][side][m.group(3)] = float(m.group(4))
+        elif m.group(5):
+            table[ep]["time"] = float(m.group(5))
+        elif m.group(6):
+            table[ep]["speeds"].append(float(m.group(6)))
+    return dict(table)
+
+
+def render(table, fmt="md", metric=None):
+    metrics = sorted({m for row in table.values()
+                      for m in list(row["train"]) + list(row["val"])
+                      if metric is None or metric in m})
+    header = (["epoch"] + ["train-%s" % m for m in metrics]
+              + ["val-%s" % m for m in metrics] + ["time(s)", "samples/sec"])
+    rows = []
+    for ep in sorted(table):
+        r = table[ep]
+        speed = (sum(r["speeds"]) / len(r["speeds"])) if r["speeds"] else None
+        rows.append([str(ep)]
+                    + ["%.6g" % r["train"][m] if m in r["train"] else ""
+                       for m in metrics]
+                    + ["%.6g" % r["val"][m] if m in r["val"] else ""
+                       for m in metrics]
+                    + ["%.3g" % r["time"] if r["time"] is not None else "",
+                       "%.1f" % speed if speed is not None else ""])
+    if fmt == "tsv":
+        return "\n".join("\t".join(r) for r in [header] + rows)
+    widths = [max(len(x) for x in col) for col in zip(header, *rows)]
+    def line(r):
+        return "| " + " | ".join(x.ljust(w) for x, w in zip(r, widths)) + " |"
+    sep = "|" + "|".join("-" * (w + 2) for w in widths) + "|"
+    return "\n".join([line(header), sep] + [line(r) for r in rows])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile", nargs="?", default="-",
+                    help="log file ('-' = stdin)")
+    ap.add_argument("--format", choices=("md", "tsv"), default="md")
+    ap.add_argument("--metric", default=None,
+                    help="only show metrics whose name contains this")
+    args = ap.parse_args()
+    lines = (sys.stdin if args.logfile == "-"
+             else open(args.logfile)).readlines()
+    table = parse(lines)
+    if not table:
+        sys.exit("no Epoch[N] lines found")
+    print(render(table, args.format, args.metric))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Rebuild the .idx sidecar for an existing RecordIO file (reference
+tools/rec2idx.py): walks the .rec sequentially with MXRecordIO, recording
+each record's byte offset, and writes `key\toffset` lines — the format
+MXIndexedRecordIO reads back (recordio.py).
+
+Usage: python tools/rec2idx.py data.rec [data.idx]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio
+
+
+def build_index(rec_path, idx_path):
+    reader = recordio.MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as out:
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            out.write("%d\t%d\n" % (n, pos))
+            n += 1
+    reader.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx path (default: .rec with .idx suffix)")
+    args = ap.parse_args()
+    idx_path = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = build_index(args.record, idx_path)
+    print("wrote %d entries to %s" % (n, idx_path))
+
+
+if __name__ == "__main__":
+    main()
