@@ -82,3 +82,27 @@ def test_diagnose_runs_and_probes():
         assert needle in res.stdout, res.stdout
     assert ("backend up" in res.stdout) or ("probe FAILED" in res.stdout), \
         res.stdout
+
+
+def test_flakiness_checker_detects_and_reports(tmp_path):
+    """flakiness_checker (reference tools/flakiness_checker.py): runs a
+    test under N seeds, reports the failure rate, exits nonzero with the
+    reproducing seeds when any fail."""
+    victim = tmp_path / "test_seeded.py"
+    victim.write_text(
+        "import os\n"
+        "def test_fails_on_odd_seed():\n"
+        "    assert int(os.environ.get('MXNET_TEST_SEED', 0)) % 2 == 0\n")
+    res = _run_tool("flakiness_checker.py", str(victim), "--trials", "4",
+                    "--timeout", "120")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "2/4 failed (50.0%)" in res.stdout, res.stdout
+    assert "failing seeds: [1, 3]" in res.stdout, res.stdout
+    assert "MXNET_TEST_SEED=1" in res.stdout
+
+    res = _run_tool("flakiness_checker.py", str(victim), "--trials", "2",
+                    "--seed-start", "0", "--timeout", "120")
+    assert res.returncode == 1  # seed 1 fails
+    res = _run_tool("flakiness_checker.py", str(victim), "--trials", "1",
+                    "--seed-start", "2", "--timeout", "120")
+    assert res.returncode == 0 and "no flakiness" in res.stdout

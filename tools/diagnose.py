@@ -44,19 +44,27 @@ def main():
             print("%-18s %s" % (mod, getattr(m, "__version__", "?")))
         except Exception as e:
             print("%-18s MISSING (%s)" % (mod, type(e).__name__))
-    import mxnet_tpu
-    print("%-18s %s" % ("mxnet_tpu", mxnet_tpu.__version__))
+    try:
+        import mxnet_tpu
+        print("%-18s %s" % ("mxnet_tpu", mxnet_tpu.__version__))
+    except Exception as e:
+        # a broken install is exactly when diagnostics matter: keep going
+        print("%-18s IMPORT FAILED (%s: %s)"
+              % ("mxnet_tpu", type(e).__name__, e))
 
     section("Environment knobs (mxnet_tpu.env registry)")
-    from mxnet_tpu import env
-    set_knobs = [(k, os.environ[k]) for k in sorted(env.VARIABLES)
-                 if k in os.environ]
-    if set_knobs:
-        for k, v in set_knobs:
-            print("%-40s = %s" % (k, v))
-    else:
-        print("(none set; `env.describe()` lists all %d honored knobs)"
-              % len(env.VARIABLES))
+    try:
+        from mxnet_tpu import env
+        set_knobs = [(k, os.environ[k]) for k in sorted(env.VARIABLES)
+                     if k in os.environ]
+        if set_knobs:
+            for k, v in set_knobs:
+                print("%-40s = %s" % (k, v))
+        else:
+            print("(none set; `env.describe()` lists all %d honored knobs)"
+                  % len(env.VARIABLES))
+    except Exception as e:
+        print("(registry unavailable: %s)" % (e,))
     for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS"):
         if k in os.environ:
             print("%-40s = %s" % (k, os.environ[k]))

@@ -1,0 +1,78 @@
+"""Flakiness checker: run one test many times under different seeds
+(reference tools/flakiness_checker.py, which re-runs a nose test with
+MXNET_TEST_SEED randomized to estimate its failure rate).
+
+The suite's conftest seeds numpy/python/mx per test from MXNET_TEST_SEED
+and logs the seed on failure; this tool drives that knob: N trials, each
+a fresh pytest process with a distinct seed, then a pass/fail summary
+with every failing seed listed for reproduction.
+
+Usage: python tools/flakiness_checker.py tests/test_foo.py::test_bar \\
+           [--trials 20] [--seed-start 0] [--timeout 900]
+"""
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_trial(test, seed, timeout):
+    env = dict(os.environ)
+    env["MXNET_TEST_SEED"] = str(seed)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", test, "-x", "-q",
+             "--no-header"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout)
+        # pytest rc semantics: 0 pass, 1 test failures; 2/3/4/5 are
+        # interrupted/internal/usage/no-tests -- NOT seed-dependent, and
+        # counting them as flaky would report a typo'd node id as 100%
+        status = {0: "PASS", 1: "FAIL"}.get(proc.returncode, "ERROR")
+        tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        if status == "ERROR":
+            tail = "pytest rc=%d (collection/usage error): %s" % (
+                proc.returncode, tail)
+    except subprocess.TimeoutExpired:
+        status, tail = "FAIL", "TIMEOUT after %gs" % timeout
+    return status, time.monotonic() - t0, tail
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id, e.g. tests/t.py::test_x")
+    ap.add_argument("--trials", type=int, default=20)
+    ap.add_argument("--seed-start", type=int, default=0,
+                    help="seeds are seed-start .. seed-start+trials-1")
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    failures = []
+    for i in range(args.trials):
+        seed = args.seed_start + i
+        status, wall, tail = run_trial(args.test, seed, args.timeout)
+        print("trial %2d seed %-6d %-5s %6.1fs  %s"
+              % (i, seed, status, wall, tail), flush=True)
+        if status == "ERROR":
+            sys.exit("aborting: the test cannot run at all (not flakiness)")
+        if status == "FAIL":
+            failures.append(seed)
+
+    rate = len(failures) / args.trials
+    print("\n%d/%d failed (%.1f%%)" % (len(failures), args.trials,
+                                       100 * rate))
+    if failures:
+        print("reproduce with: MXNET_TEST_SEED=%d python -m pytest %s"
+              % (failures[0], args.test))
+        print("failing seeds:", failures)
+        sys.exit(1)
+    print("no flakiness detected over %d seeds" % args.trials)
+
+
+if __name__ == "__main__":
+    main()
