@@ -73,7 +73,15 @@ class Context:
         import jax
         local = jax.local_devices()
         if self.device_type == "cpu" or self.device_typeid in (3, 5):
-            devs = [d for d in local if d.platform == "cpu"] or local
+            # local_devices() lists only the DEFAULT backend — on a TPU
+            # host that excludes the always-present cpu backend, and the
+            # old platform filter silently fell back to the accelerator.
+            # Ask the cpu backend directly so cpu(0) means host cpu even
+            # when tpu is default (check_consistency depends on this).
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                devs = [d for d in local if d.platform == "cpu"] or local
             return devs[min(self.device_id, len(devs) - 1)]
         # accelerator ('tpu' or legacy 'gpu' alias)
         accel = [d for d in local if d.platform != "cpu"]

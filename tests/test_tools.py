@@ -106,3 +106,21 @@ def test_flakiness_checker_detects_and_reports(tmp_path):
     res = _run_tool("flakiness_checker.py", str(victim), "--trials", "1",
                     "--seed-start", "2", "--timeout", "120")
     assert res.returncode == 0 and "no flakiness" in res.stdout
+
+
+def test_tpu_consistency_self_test(tmp_path):
+    """The consistency battery's plumbing validated without hardware:
+    cpu-vs-cpu must pass all cases with zero diffs, and without a TPU the
+    real mode must exit 3 with value null (so the relay watcher only
+    records it from a live window)."""
+    import json
+    out = str(tmp_path / "cons.json")
+    res = _run_tool("tpu_consistency.py", "--self-test", "--out", out)
+    assert res.returncode == 0, res.stdout + res.stderr
+    data = json.load(open(out))
+    assert data["passed"] == data["total"] == len(data["cases"])
+    assert all(c["max_abs_diff"] == 0.0 for c in data["cases"])
+
+    res = _run_tool("tpu_consistency.py", "--out", out)
+    assert res.returncode == 3
+    assert '"value": null' in res.stdout

@@ -32,12 +32,18 @@ def run_trial(test, seed, timeout):
             timeout=timeout)
         # pytest rc semantics: 0 pass, 1 test failures; 2/3/4/5 are
         # interrupted/internal/usage/no-tests -- NOT seed-dependent, and
-        # counting them as flaky would report a typo'd node id as 100%
-        status = {0: "PASS", 1: "FAIL"}.get(proc.returncode, "ERROR")
+        # counting them as flaky would report a typo'd node id as 100%.
+        # NEGATIVE rc = killed by a signal (segfault/abort in native code)
+        # -- the crash-flaky class this tool exists for: count as FAIL.
         tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
-        if status == "ERROR":
-            tail = "pytest rc=%d (collection/usage error): %s" % (
-                proc.returncode, tail)
+        if proc.returncode < 0:
+            status = "FAIL"
+            tail = "CRASH (signal %d): %s" % (-proc.returncode, tail)
+        else:
+            status = {0: "PASS", 1: "FAIL"}.get(proc.returncode, "ERROR")
+            if status == "ERROR":
+                tail = "pytest rc=%d (collection/usage error): %s" % (
+                    proc.returncode, tail)
     except subprocess.TimeoutExpired:
         status, tail = "FAIL", "TIMEOUT after %gs" % timeout
     return status, time.monotonic() - t0, tail
@@ -51,6 +57,8 @@ def main():
                     help="seeds are seed-start .. seed-start+trials-1")
     ap.add_argument("--timeout", type=float, default=900.0)
     args = ap.parse_args()
+    if args.trials < 1:
+        ap.error("--trials must be >= 1")
 
     failures = []
     for i in range(args.trials):

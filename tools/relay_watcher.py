@@ -169,6 +169,11 @@ BATTERY = [
     # around real train steps (writes PROFILE_TPU.json)
     ("profiler", [sys.executable, "tools/profile_capture.py"],
      {}, 500),
+    # numerics on hardware: same op, same inputs, cpu(0) vs tpu(0)
+    # (writes CONSISTENCY_TPU.json; the flash-attention case validates
+    # the Pallas kernel against the dense reference ON CHIP)
+    ("consistency", [sys.executable, "tools/tpu_consistency.py"],
+     {}, 600),
 ]
 
 
