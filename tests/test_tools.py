@@ -124,3 +124,41 @@ def test_tpu_consistency_self_test(tmp_path):
     res = _run_tool("tpu_consistency.py", "--out", out)
     assert res.returncode == 3
     assert '"value": null' in res.stdout
+
+
+def test_kill_mxnet_finds_and_kills_fingerprinted_workers():
+    """kill_mxnet (reference tools/kill-mxnet.py): a process carrying the
+    launcher's MX_KV_RANK env fingerprint is listed by --dry-run and
+    terminated by the real run; unrelated processes are untouched."""
+    import signal
+    import time
+    # a unique cmdline token scopes the kill: the fingerprint sweep would
+    # also hit any REAL launch.py workers alive on this machine
+    token = "stray_worker_decoy_%d" % os.getpid()  # must not contain "kill_mxnet" (tool self-exclusion)
+    env = dict(os.environ, MX_KV_RANK="0", MX_KV_NUM_WORKERS="1")
+    victim = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(300) # " + token],
+                              env=env)
+    bystander = subprocess.Popen([sys.executable, "-c",
+                                  "import time; time.sleep(300)"])
+    try:
+        res = _run_tool("kill_mxnet.py", "--dry-run", "--pattern", token)
+        assert ("pid %d" % victim.pid) in res.stdout, res.stdout
+        assert ("pid %d" % bystander.pid) not in res.stdout
+        # the env-fingerprint detector also sees the victim (dry-run only,
+        # so concurrent real workers are merely listed, never touched)
+        res = _run_tool("kill_mxnet.py", "--dry-run")
+        assert ("pid %d" % victim.pid) in res.stdout, res.stdout
+
+        res = _run_tool("kill_mxnet.py", "--pattern", token)
+        assert res.returncode == 0, res.stderr
+        for _ in range(50):
+            if victim.poll() is not None:
+                break
+            time.sleep(0.1)
+        assert victim.poll() is not None, "fingerprinted worker survived"
+        assert bystander.poll() is None, "bystander was killed"
+    finally:
+        for p in (victim, bystander):
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)

@@ -35,7 +35,8 @@ def run_trial(test, seed, timeout):
         # counting them as flaky would report a typo'd node id as 100%.
         # NEGATIVE rc = killed by a signal (segfault/abort in native code)
         # -- the crash-flaky class this tool exists for: count as FAIL.
-        tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        stripped = (proc.stdout or "").strip()
+        tail = stripped.splitlines()[-1] if stripped else ""
         if proc.returncode < 0:
             status = "FAIL"
             tail = "CRASH (signal %d): %s" % (-proc.returncode, tail)

@@ -1,0 +1,129 @@
+"""Kill stray distributed workers from a crashed launch.py run (reference
+tools/kill-mxnet.py, which pdsh-kills python processes on every host in
+the hostfile).
+
+Local mode kills every process whose command line carries the launcher's
+env fingerprint (MX_KV_* variables set by tools/launch.py) or matches the
+worker command substring; ssh mode runs the same pkill on each host in a
+hostfile.  Never kills itself or its ancestors.
+
+Usage:
+  python tools/kill_mxnet.py                      # local, by fingerprint
+  python tools/kill_mxnet.py --pattern train.py   # local, by substring
+  python tools/kill_mxnet.py -H hostfile          # ssh pkill on each host
+  python tools/kill_mxnet.py --dry-run            # list, don't kill
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def list_local(pattern):
+    """[(pid, cmdline)] of candidate worker processes."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    for _ in range(20):  # walk up so we never kill our own shell chain
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().split(")")[-1].split()[1])
+        except Exception:
+            break
+        if pid <= 1:
+            break
+        ancestors.add(pid)
+    out = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if pid == me or pid in ancestors:
+            continue
+        try:
+            with open("/proc/%d/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            with open("/proc/%d/environ" % pid, "rb") as f:
+                environ = f.read().decode(errors="replace")
+        except Exception:
+            continue
+        if pattern is not None:
+            hit = pattern in cmd
+        else:
+            # launch.py stamps every worker with MX_KV_RANK/MX_KV_NWORKER
+            hit = "MX_KV_RANK=" in environ or "DMLC_ROLE=" in environ
+        if hit and "kill_mxnet" not in cmd:
+            out.append((pid, cmd.strip()))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pattern", default=None,
+                    help="kill by cmdline substring instead of the "
+                         "launcher env fingerprint")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="ssh to each host and pkill there")
+    ap.add_argument("--signal", type=int, default=signal.SIGTERM)
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+
+    if args.hostfile:
+        # pkill -f only sees command lines; the launcher fingerprint lives
+        # in the ENVIRONMENT, so fingerprint mode ships a /proc scanner to
+        # the remote python instead (same logic as local mode)
+        if args.pattern:
+            remote = ["pkill", "-%d" % args.signal, "-f", args.pattern]
+        else:
+            scanner = (
+                "import os,signal\n"
+                "n=0\n"
+                "for e in os.listdir('/proc'):\n"
+                "  if not e.isdigit(): continue\n"
+                "  p=int(e)\n"
+                "  try:\n"
+                "    env=open('/proc/%%d/environ'%%p,'rb').read().decode('replace')\n"
+                "    cmd=open('/proc/%%d/cmdline'%%p,'rb').read().decode('replace')\n"
+                "  except Exception: continue\n"
+                "  if ('MX_KV_RANK=' in env or 'DMLC_ROLE=' in env) "
+                "and 'kill_mxnet' not in cmd:\n"
+                "    os.kill(p,%d); n+=1\n"
+                "print('killed',n)\n" % args.signal)
+            remote = ["python3", "-c", scanner]
+        rc = 0
+        for host in open(args.hostfile):
+            host = host.strip()
+            if not host or host.startswith("#"):
+                continue
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host] + remote
+            if args.dry_run:
+                print("would run:", " ".join(cmd))
+                continue
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode in (0, 1):
+                out = (r.stdout or "").strip()
+                print("%s: %s" % (host, out or ("killed" if r.returncode == 0
+                                                else "nothing matched")))
+            else:  # 255 = ssh itself failed: the host was never checked
+                print("%s: SSH ERROR rc=%d: %s"
+                      % (host, r.returncode, (r.stderr or "").strip()[:200]))
+                rc = rc or r.returncode
+        sys.exit(rc)
+
+    victims = list_local(args.pattern)
+    if not victims:
+        print("no stray workers found")
+        return
+    for pid, cmd in victims:
+        print("%s pid %d: %s" % ("would kill" if args.dry_run else "killing",
+                                 pid, cmd[:120]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, args.signal)
+            except ProcessLookupError:
+                pass
+
+
+if __name__ == "__main__":
+    main()

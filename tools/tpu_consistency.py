@@ -167,9 +167,10 @@ def main():
         try:
             r_cpu = fn(mx.cpu(0))
             r_tpu = fn(mx.cpu(0) if args.self_test else mx.context.tpu(0))
+            from mxnet_tpu.test_utils import almost_equal
             diff = np.abs(r_cpu.astype(np.float64) - r_tpu.astype(np.float64))
             denom = np.abs(r_cpu.astype(np.float64)) + atol
-            ok = bool((diff <= atol + rtol * np.abs(r_cpu)).all())
+            ok = bool(almost_equal(r_cpu, r_tpu, rtol=rtol, atol=atol))
             row = {"case": name, "ok": ok,
                    "max_abs_diff": float(diff.max()),
                    "max_rel_diff": float((diff / denom).max()),
