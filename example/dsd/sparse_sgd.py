@@ -1,0 +1,55 @@
+"""Dense-Sparse-Dense SGD (Han et al. 2016) — the reference's
+example/dsd/sparse_sgd.py: an SGD subclass that applies a per-layer
+magnitude mask during the sparse phase of the schedule, then releases it
+for the final dense phase.
+
+Masks are recomputed when the schedule's target sparsity changes
+(layer-wise magnitude pruning, like the reference); biases/1-d params
+are never pruned.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@mx.optimizer.register
+class SparseSGD(mx.optimizer.SGD):
+    """SGD whose update zeroes the currently-masked weights.
+
+    schedule: [(epoch, sparsity)] — at each listed epoch the target
+    sparsity switches; 0.0 means train dense (masks released).
+    """
+
+    def __init__(self, schedule=None, **kwargs):
+        super().__init__(**kwargs)
+        self.schedule = sorted(schedule or [])
+        self.epoch = 0
+        self.masks = {}
+
+    def _target(self, epoch):
+        t = 0.0
+        for ep, sp in self.schedule:
+            if epoch >= ep:
+                t = sp
+        return t
+
+    def set_epoch(self, epoch):
+        if self._target(epoch) != self._target(self.epoch):
+            self.masks = {}  # sparsity level changed: recompute from weights
+        self.epoch = epoch
+
+    def update(self, index, weight, grad, state):
+        super().update(index, weight, grad, state)
+        sparsity = self._target(self.epoch)
+        if sparsity <= 0.0 or len(weight.shape) < 2:
+            return
+        if index not in self.masks:
+            w = np.abs(weight.asnumpy()).ravel()
+            k = int(sparsity * w.size)
+            if k == 0:
+                return
+            thr = np.partition(w, k - 1)[k - 1]
+            mask = (np.abs(weight.asnumpy()) > thr).astype(np.float32)
+            self.masks[index] = nd.array(mask, ctx=weight.context)
+        weight[:] = weight * self.masks[index]

@@ -174,6 +174,11 @@ BATTERY = [
     # the Pallas kernel against the dense reference ON CHIP)
     ("consistency", [sys.executable, "tools/tpu_consistency.py"],
      {}, 600),
+    # remat HBM evidence: XLA's own CompiledMemoryStats for the train
+    # step with/without jax.checkpoint (only meaningful on TPU — see the
+    # example's docstring on XLA:CPU scheduling)
+    ("memcost", [sys.executable, "example/memcost/memcost.py"],
+     {}, 500),
 ]
 
 
