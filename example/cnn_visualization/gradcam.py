@@ -96,6 +96,7 @@ def main():
     xs, ys = make_quadrant_blobs(rng, 2000)
     xt, yt = make_quadrant_blobs(rng, 100)
 
+    np.random.seed(args.seed)  # Xavier init draws from the global RNG
     mx.random.seed(args.seed)
     net = ConvNet()
     net.initialize(mx.init.Xavier())

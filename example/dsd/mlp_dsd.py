@@ -50,6 +50,7 @@ def main():
     args = ap.parse_args()
 
     rng = np.random.RandomState(args.seed)
+    np.random.seed(args.seed)  # Xavier + NDArrayIter shuffle use the global RNG
     protos = rng.randn(10, 128).astype(np.float32) * 1.5
     xs, ys = make_blobs(rng, 3000, protos)
     xt, yt = make_blobs(rng, 600, protos)

@@ -39,8 +39,7 @@ class SparseSGD(mx.optimizer.SGD):
             self.masks = {}  # sparsity level changed: recompute from weights
         self.epoch = epoch
 
-    def update(self, index, weight, grad, state):
-        super().update(index, weight, grad, state)
+    def _apply_mask(self, index, weight):
         sparsity = self._target(self.epoch)
         if sparsity <= 0.0 or len(weight.shape) < 2:
             return
@@ -52,4 +51,14 @@ class SparseSGD(mx.optimizer.SGD):
             thr = np.partition(w, k - 1)[k - 1]
             mask = (np.abs(weight.asnumpy()) > thr).astype(np.float32)
             self.masks[index] = nd.array(mask, ctx=weight.context)
-        weight[:] = weight * self.masks[index]
+        weight[:] = weight * self.masks[index].astype(weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        super().update(index, weight, grad, state)
+        self._apply_mask(index, weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        # the fused fp16/bf16 master-weight path bypasses update(), so the
+        # mask must be applied here too or multi_precision trains dense
+        super().update_multi_precision(index, weight, grad, state)
+        self._apply_mask(index, weight)

@@ -375,3 +375,14 @@ def test_gradcam_example_saliency_is_localized():
     res = _run("example/cnn_visualization/gradcam.py", timeout=800)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "GRADCAM OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_rbm_example_learns_energy_model():
+    """Binary RBM via CD-1 (example/restricted-boltzmann-machine, reference
+    same dir): no-backprop contrastive-divergence training must cut the
+    held-out reconstruction error >3x and open a clear free-energy gap
+    between noise and data."""
+    res = _run("example/restricted-boltzmann-machine/binary_rbm.py",
+               timeout=800)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "RBM OK" in res.stdout, res.stdout[-2000:]
