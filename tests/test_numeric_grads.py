@@ -58,6 +58,14 @@ CASES = [
     ("erf", lambda x: nd.erf(x), [_u(2, 3)], {}),
     ("gammaln", lambda x: nd.gammaln(x), [_u(2, 3, lo=1.5, hi=3.0)], {}),
     ("softsign", lambda x: nd.softsign(x), [_u(2, 3)], {}),
+    # inside the linear band and away from its 0/1 kinks (alpha=.2 beta=.5
+    # saturates at x=±2.5).  Own RandomState: drawing from _R here would
+    # shift every later case's inputs (they consume one shared stream at
+    # module import).
+    ("hard_sigmoid", lambda x: nd.hard_sigmoid(x),
+     [np.random.RandomState(11).uniform(-2.0, 2.0, (2, 3))], {}),
+    ("_square_sum", lambda x: nd._internal._square_sum(x, axis=1),
+     [np.random.RandomState(12).uniform(-1, 1, (3, 4))], {}),
     ("degrees", lambda x: nd.degrees(x), [_u(2, 3)], {"rtol": 2e-2}),
     ("radians", lambda x: nd.radians(x), [_u(2, 3)], {}),
     ("clip", lambda x: nd.clip(x, -2.0, 2.0), [_u(2, 3)], {}),
