@@ -162,3 +162,40 @@ def test_kill_mxnet_finds_and_kills_fingerprinted_workers():
         for p in (victim, bystander):
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
+
+
+def test_relay_watcher_capture_salvage_and_append(tmp_path, monkeypatch):
+    """The capture pipeline that produces BENCH_LIVE.json: _run_capture
+    takes the LAST JSON line of noisy stdout and accepts it only if it
+    carries a value (a trailing value-null line therefore fails the
+    capture — bench.py's contract is that the final line is the verdict),
+    and _append_live must MERGE with existing captures, not overwrite."""
+    import json
+    import relay_watcher as rw
+    monkeypatch.setattr(rw, "LIVE_PATH", str(tmp_path / "live.json"))
+    monkeypatch.setattr(rw, "LOG_PATH", str(tmp_path / "probe.log"))
+
+    noisy = ("import json\n"
+             "print('warmup noise')\n"
+             "print(json.dumps({'metric': 'm', 'value': None,"
+             " 'error': 'warmup'}))\n"
+             "print(json.dumps({'metric': 'm', 'value': 42.0,"
+             " 'unit': 'u', 'vs_baseline': 2.0}))\n")
+    rec = rw._run_capture("t1", [sys.executable, "-c", noisy], {}, 60)
+    assert rec is not None and rec["value"] == 42.0
+    assert "captured_at" in rec and rec["capture"] == "t1"
+
+    failing = ("import json\n"
+               "print(json.dumps({'metric': 'm', 'value': None,"
+               " 'error': 'relay gone'}))\n")
+    assert rw._run_capture("t2", [sys.executable, "-c", failing],
+                           {}, 60) is None
+    assert rw._run_capture("t3", [sys.executable, "-c", "print('no json')"],
+                           {}, 60) is None
+
+    rw._append_live([rec])
+    rec2 = dict(rec, metric="second", value=7.0)
+    rw._append_live([rec2])
+    data = json.load(open(rw.LIVE_PATH))
+    assert [c["value"] for c in data["captures"]] == [42.0, 7.0]
+    assert data["probe_log"] == "probe.log"
