@@ -326,6 +326,254 @@ def _import_lrn(ctx, node, a, sym_mod):
                        name=node.name or node.output[0])
 
 
+
+
+def _const_operand(ctx, node, i, what):
+    """Read optional input i as a graph constant; dynamic tensors are a
+    clean NotImplementedError (the Reshape/Tile convention), not a
+    KeyError on an internal name."""
+    if i >= len(node.input) or not node.input[i]:
+        return None
+    name = node.input[i]
+    arr = ctx.consts.get(name)
+    if arr is None:
+        raise NotImplementedError(
+            "%s with dynamic %s input (must be an initializer)"
+            % (node.op_type, what))
+    ctx.arg_params.pop(name, None)
+    return arr
+
+
+@register_import("Exp", "Log", "Sqrt", "Neg", "Abs", "Reciprocal",
+                 "Floor", "Ceil", "Erf", "Sin", "Cos")
+def _import_unary(ctx, node, a, sym_mod):
+    fn = {"Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Neg": "negative",
+          "Abs": "abs", "Reciprocal": "reciprocal", "Floor": "floor",
+          "Ceil": "ceil", "Erf": "erf", "Sin": "sin",
+          "Cos": "cos"}[node.op_type]
+    return getattr(sym_mod, fn)(ctx.sym(node.input[0]),
+                                name=node.name or node.output[0])
+
+
+@register_import("HardSigmoid")
+def _import_hard_sigmoid(ctx, node, a, sym_mod):
+    return sym_mod.hard_sigmoid(ctx.sym(node.input[0]),
+                                alpha=float(a.get("alpha", 0.2)),
+                                beta=float(a.get("beta", 0.5)),
+                                name=node.name or node.output[0])
+
+
+@register_import("Pow")
+def _import_pow(ctx, node, a, sym_mod):
+    return sym_mod.broadcast_power(ctx.sym(node.input[0]),
+                                   ctx.sym(node.input[1]),
+                                   name=node.name or node.output[0])
+
+
+@register_import("Max", "Min")
+def _import_variadic_minmax(ctx, node, a, sym_mod):
+    fn = getattr(sym_mod, "broadcast_maximum" if node.op_type == "Max"
+                 else "broadcast_minimum")
+    out = ctx.sym(node.input[0])
+    for name in node.input[1:]:
+        out = fn(out, ctx.sym(name))
+    return out
+
+
+@register_import("Mean")
+def _import_variadic_mean(ctx, node, a, sym_mod):
+    total = sym_mod.add_n(*[ctx.sym(i) for i in node.input])
+    return total / float(len(node.input))
+
+
+@register_import("Clip")
+def _import_clip(ctx, node, a, sym_mod):
+    # opset<11 carries min/max as attrs; opset>=11 as optional inputs,
+    # importable when they are initializers
+    lo, hi = a.get("min"), a.get("max")
+    if lo is None:
+        arr = _const_operand(ctx, node, 1, "min")
+        lo = float(arr) if arr is not None else None
+    if hi is None:
+        arr = _const_operand(ctx, node, 2, "max")
+        hi = float(arr) if arr is not None else None
+    return sym_mod.clip(ctx.sym(node.input[0]),
+                        a_min=float(lo if lo is not None else -3.4e38),
+                        a_max=float(hi if hi is not None else 3.4e38),
+                        name=node.name or node.output[0])
+
+
+@register_import("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin",
+                 "ReduceProd")
+def _import_reduce(ctx, node, a, sym_mod):
+    fn = {"ReduceMean": "mean", "ReduceSum": "sum", "ReduceMax": "max",
+          "ReduceMin": "min", "ReduceProd": "prod"}[node.op_type]
+    kwargs = {"keepdims": bool(a.get("keepdims", 1))}
+    if a.get("axes") is not None:
+        kwargs["axis"] = tuple(a["axes"])
+    return getattr(sym_mod, fn)(ctx.sym(node.input[0]),
+                                name=node.name or node.output[0], **kwargs)
+
+
+@register_import("ArgMax")
+def _import_argmax(ctx, node, a, sym_mod):
+    out = sym_mod.argmax(ctx.sym(node.input[0]),
+                         axis=int(a.get("axis", 0)),
+                         keepdims=bool(a.get("keepdims", 1)),
+                         name=node.name or node.output[0])
+    return sym_mod.Cast(out, dtype="int64")  # ONNX ArgMax returns int64
+
+
+@register_import("Squeeze")
+def _import_squeeze(ctx, node, a, sym_mod):
+    axes = a.get("axes")
+    if axes is None:  # opset >= 13 moves axes to input[1]
+        arr = _const_operand(ctx, node, 1, "axes")
+        axes = [int(v) for v in arr] if arr is not None else None
+    kwargs = {"axis": tuple(axes)} if axes is not None else {}
+    return sym_mod.squeeze(ctx.sym(node.input[0]),
+                           name=node.name or node.output[0], **kwargs)
+
+
+@register_import("Unsqueeze")
+def _import_unsqueeze(ctx, node, a, sym_mod):
+    axes = a.get("axes")
+    if axes is None:  # opset >= 13 moves axes to input[1]
+        axes = [int(v) for v in _const_operand(ctx, node, 1, "axes")]
+    out = ctx.sym(node.input[0])
+    for ax in sorted(axes):
+        out = sym_mod.expand_dims(out, axis=int(ax))
+    return out
+
+
+@register_import("Slice")
+def _import_slice(ctx, node, a, sym_mod):
+    if a.get("starts") is not None:  # opset 1-9: attrs
+        starts, ends = a["starts"], a["ends"]
+        axes = a.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    else:  # opset >= 10: initializer inputs
+        def const(i, default=None):
+            arr = _const_operand(ctx, node, i,
+                                 ("starts", "ends", "axes", "steps")[i - 1])
+            return [int(v) for v in arr] if arr is not None else default
+        starts = const(1)
+        ends = const(2)
+        axes = const(3, list(range(len(starts))))
+        steps = const(4, [1] * len(starts))
+    if any(ax < 0 for ax in axes):
+        # the input rank is unknown at import time, so negative axes
+        # cannot be folded; refuse rather than silently not slicing
+        raise NotImplementedError("Slice with negative axes %s" % (axes,))
+    begin, end, step = {}, {}, {}
+    for ax, b, e, st in zip(axes, starts, ends, steps):
+        begin[int(ax)], end[int(ax)], step[int(ax)] = b, e, st
+    ndim = max(begin) + 1
+    b = [begin.get(i) for i in range(ndim)]
+    e = [end.get(i) for i in range(ndim)]
+    st = [step.get(i, 1) for i in range(ndim)]
+    # clamp ONNX's INT_MAX "to the end" sentinel to None
+    e = [None if (v is not None and v >= 2**31 - 1) else v for v in e]
+    return sym_mod.slice(ctx.sym(node.input[0]), begin=tuple(b),
+                         end=tuple(e), step=tuple(st),
+                         name=node.name or node.output[0])
+
+
+@register_import("Split")
+def _import_split(ctx, node, a, sym_mod):
+    axis = int(a.get("axis", 0))
+    sizes = list(a["split"]) if a.get("split") else None
+    if sizes is None:  # opset >= 13 moves sizes to input[1]
+        arr = _const_operand(ctx, node, 1, "split sizes")
+        sizes = [int(v) for v in arr] if arr is not None else None
+    if sizes is not None and len(set(sizes)) != 1:
+        raise NotImplementedError("unequal ONNX Split %s" % (sizes,))
+    outs = sym_mod.split(ctx.sym(node.input[0]),
+                         num_outputs=len(node.output), axis=axis,
+                         name=node.name or node.output[0])
+    return [outs[i] for i in range(len(node.output))]
+
+
+@register_import("Pad")
+def _import_pad(ctx, node, a, sym_mod):
+    mode = a.get("mode", "constant")
+    pads = a.get("pads")
+    if pads is None:
+        pads = [int(v) for v in _const_operand(ctx, node, 1, "pads")]
+    value = a.get("value")
+    if value is None:  # opset >= 11 moves the fill value to input[2]
+        arr = _const_operand(ctx, node, 2, "constant_value")
+        value = float(arr) if arr is not None else 0.0
+    half = len(pads) // 2
+    # ONNX: [x1_b, x2_b, ..., x1_e, x2_e]; mxnet: (x1_b, x1_e, x2_b, x2_e...)
+    pw = []
+    for i in range(half):
+        pw += [int(pads[i]), int(pads[i + half])]
+    return sym_mod.Pad(ctx.sym(node.input[0]), mode=mode,
+                       pad_width=tuple(pw), constant_value=float(value),
+                       name=node.name or node.output[0])
+
+
+@register_import("PRelu")
+def _import_prelu(ctx, node, a, sym_mod):
+    return sym_mod.LeakyReLU(ctx.sym(node.input[0]), ctx.sym(node.input[1]),
+                             act_type="prelu",
+                             name=node.name or node.output[0])
+
+
+@register_import("InstanceNormalization")
+def _import_instance_norm(ctx, node, a, sym_mod):
+    ins = [ctx.sym(i) for i in node.input]
+    return sym_mod.InstanceNorm(*ins, eps=float(a.get("epsilon", 1e-5)),
+                                name=node.name or node.output[0])
+
+
+@register_import("Equal", "Greater", "Less")
+def _import_compare(ctx, node, a, sym_mod):
+    fn = {"Equal": "broadcast_equal", "Greater": "broadcast_greater",
+          "Less": "broadcast_lesser"}[node.op_type]
+    return getattr(sym_mod, fn)(ctx.sym(node.input[0]),
+                                ctx.sym(node.input[1]),
+                                name=node.name or node.output[0])
+
+
+@register_import("Tile")
+def _import_tile(ctx, node, a, sym_mod):
+    reps = ctx.consts.get(node.input[1])
+    if reps is None:
+        raise NotImplementedError("Tile with dynamic repeats")
+    ctx.arg_params.pop(node.input[1], None)
+    return sym_mod.tile(ctx.sym(node.input[0]),
+                        reps=tuple(int(r) for r in reps),
+                        name=node.name or node.output[0])
+
+
+@register_import("DepthToSpace", "SpaceToDepth")
+def _import_depth_space(ctx, node, a, sym_mod):
+    fn = ("depth_to_space" if node.op_type == "DepthToSpace"
+          else "space_to_depth")
+    return getattr(sym_mod, fn)(ctx.sym(node.input[0]),
+                                block_size=int(a["blocksize"]),
+                                name=node.name or node.output[0])
+
+
+@register_import("Upsample")
+def _import_upsample(ctx, node, a, sym_mod):
+    scales = a.get("scales")
+    if scales is None:
+        arr = _const_operand(ctx, node, 1, "scales")
+        if arr is None:
+            raise NotImplementedError("Upsample without scales")
+        scales = [float(v) for v in arr]
+    if a.get("mode", "nearest") != "nearest":
+        raise NotImplementedError("Upsample mode %r" % a.get("mode"))
+    if scales[0] != 1 or scales[1] != 1 or scales[2] != scales[3]:
+        raise NotImplementedError("Upsample scales %s" % (scales,))
+    return sym_mod.UpSampling(ctx.sym(node.input[0]),
+                              scale=int(scales[2]), sample_type="nearest",
+                              name=node.name or node.output[0])
+
+
 # ------------------------------------------------------------------- driver
 
 def _load_model_proto(model_file):

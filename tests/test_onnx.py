@@ -225,3 +225,220 @@ def test_onnx_embedding_and_concat_roundtrip(tmp_path):
     sym2, args2, aux2 = onnx_mxnet.import_model(path)
     got = _forward(sym2, args2, aux2, x)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------- import-only ops
+# Handlers with no exporter counterpart are exercised by building ONNX
+# graphs directly with the bundled proto (the reference's backend tests
+# construct graphs the same way).
+
+from mxnet_tpu.contrib.onnx import onnx_pb2 as _P
+
+
+def _np_tensor(name, arr):
+    t = _P.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = {_np_f32: _P.TensorProto.FLOAT,
+                   _np_i64: _P.TensorProto.INT64}[arr.dtype.type]
+    t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+_np_f32, _np_i64 = np.float32, np.int64
+
+
+def _onnx_attr(name, v):
+    a = _P.AttributeProto()
+    a.name = name
+    if isinstance(v, bool) or isinstance(v, int):
+        a.type = _P.AttributeProto.INT
+        a.i = int(v)
+    elif isinstance(v, float):
+        a.type = _P.AttributeProto.FLOAT
+        a.f = v
+    elif isinstance(v, str):
+        a.type = _P.AttributeProto.STRING
+        a.s = v.encode()
+    elif isinstance(v, (list, tuple)) and all(
+            isinstance(i, int) for i in v):
+        a.type = _P.AttributeProto.INTS
+        a.ints.extend(v)
+    elif isinstance(v, (list, tuple)):
+        a.type = _P.AttributeProto.FLOATS
+        a.floats.extend(v)
+    else:
+        raise TypeError(v)
+    return a
+
+
+def _onnx_node(op, inputs, outputs, **attrs):
+    n = _P.NodeProto()
+    n.op_type = op
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        n.attribute.extend([_onnx_attr(k, v)])
+    return n
+
+
+def _vinfo(name, shape):
+    vi = _P.ValueInfoProto()
+    vi.name = name
+    vi.type.tensor_type.elem_type = _P.TensorProto.FLOAT
+    for d in shape:
+        vi.type.tensor_type.shape.dim.add().dim_value = d
+    return vi
+
+
+def _import_graph(tmp_path, nodes, in_shape, out_name,
+                  initializers=None):
+    m = _P.ModelProto()
+    m.ir_version = 4
+    g = m.graph
+    g.name = "test"
+    g.node.extend(nodes)
+    g.input.extend([_vinfo("data", in_shape)])
+    g.output.extend([_vinfo(out_name, ())])
+    for name, arr in (initializers or {}).items():
+        g.initializer.extend([_np_tensor(name, arr)])
+    path = str(tmp_path / "import_only.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    return onnx_mxnet.import_model(path)
+
+
+@pytest.mark.parametrize("case", [
+    "exp", "hard_sigmoid", "pow", "max3", "mean3", "clip_attr",
+    "clip_init", "reduce_mean", "argmax", "squeeze", "unsqueeze",
+    "slice10", "split", "pad", "prelu", "equal", "tile",
+    "depth_to_space", "upsample",
+])
+def test_onnx_import_only_ops(tmp_path, case, ):
+    rng = np.random.RandomState(3)
+    x = rng.uniform(0.2, 2.0, (2, 4, 4, 4)).astype(np.float32)
+    inits = {}
+    if case == "exp":
+        nodes = [_onnx_node("Exp", ["data"], ["out"])]
+        want = np.exp(x)
+    elif case == "hard_sigmoid":
+        nodes = [_onnx_node("HardSigmoid", ["data"], ["out"], alpha=0.3,
+                            beta=0.4)]
+        want = np.clip(0.3 * x + 0.4, 0, 1)
+    elif case == "pow":
+        inits["e"] = np.full((1,), 2.0, np.float32)
+        nodes = [_onnx_node("Pow", ["data", "e"], ["out"])]
+        want = x ** 2
+    elif case == "max3":
+        inits["b"] = (x + 0.5).astype(np.float32)
+        inits["c"] = (x - 0.5).astype(np.float32)
+        nodes = [_onnx_node("Max", ["data", "b", "c"], ["out"])]
+        want = np.maximum(np.maximum(x, x + 0.5), x - 0.5)
+    elif case == "mean3":
+        inits["b"] = (x * 2).astype(np.float32)
+        inits["c"] = (x * 3).astype(np.float32)
+        nodes = [_onnx_node("Mean", ["data", "b", "c"], ["out"])]
+        want = (x + 2 * x + 3 * x) / 3.0
+    elif case == "clip_attr":
+        nodes = [_onnx_node("Clip", ["data"], ["out"], min=0.5, max=1.5)]
+        want = np.clip(x, 0.5, 1.5)
+    elif case == "clip_init":
+        inits["lo"] = np.full((), 0.5, np.float32)
+        inits["hi"] = np.full((), 1.5, np.float32)
+        nodes = [_onnx_node("Clip", ["data", "lo", "hi"], ["out"])]
+        want = np.clip(x, 0.5, 1.5)
+    elif case == "reduce_mean":
+        nodes = [_onnx_node("ReduceMean", ["data"], ["out"], axes=[2, 3],
+                            keepdims=0)]
+        want = x.mean(axis=(2, 3))
+    elif case == "argmax":
+        nodes = [_onnx_node("ArgMax", ["data"], ["out"], axis=1)]
+        want = x.argmax(axis=1, keepdims=True)
+    elif case == "squeeze":
+        nodes = [_onnx_node("Unsqueeze", ["data"], ["u"], axes=[0]),
+                 _onnx_node("Squeeze", ["u"], ["out"], axes=[0])]
+        want = x
+    elif case == "unsqueeze":
+        nodes = [_onnx_node("Unsqueeze", ["data"], ["out"], axes=[0, 2])]
+        want = x[None][:, :, None]
+    elif case == "slice10":
+        inits["starts"] = np.array([0, 1], np.int64)
+        inits["ends"] = np.array([2**31 - 1, 3], np.int64)
+        inits["axes"] = np.array([0, 1], np.int64)
+        nodes = [_onnx_node("Slice", ["data", "starts", "ends", "axes"],
+                            ["out"])]
+        want = x[:, 1:3]
+    elif case == "split":
+        nodes = [_onnx_node("Split", ["data"], ["s0", "s1"], axis=1),
+                 _onnx_node("Add", ["s0", "s1"], ["out"])]
+        want = x[:, :2] + x[:, 2:]
+    elif case == "pad":
+        nodes = [_onnx_node("Pad", ["data"], ["out"], mode="constant",
+                            pads=[0, 0, 1, 1, 0, 0, 1, 1], value=0.0)]
+        want = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    elif case == "prelu":
+        inits["slope"] = np.full((4,), 0.1, np.float32)
+        nodes = [_onnx_node("Sub", ["data", "data"], ["z"]),
+                 _onnx_node("Sub", ["z", "data"], ["neg"]),
+                 _onnx_node("PRelu", ["neg", "slope"], ["out"])]
+        want = np.where(-x > 0, -x, 0.1 * -x)
+    elif case == "equal":
+        inits["b"] = x.copy()
+        nodes = [_onnx_node("Equal", ["data", "b"], ["out"])]
+        want = np.ones_like(x)
+    elif case == "tile":
+        inits["reps"] = np.array([1, 2, 1, 1], np.int64)
+        nodes = [_onnx_node("Tile", ["data", "reps"], ["out"])]
+        want = np.tile(x, (1, 2, 1, 1))
+    elif case == "depth_to_space":
+        nodes = [_onnx_node("DepthToSpace", ["data"], ["out"],
+                            blocksize=2)]
+        from mxnet_tpu import nd as _nd
+        want = _nd.depth_to_space(_nd.array(x), block_size=2).asnumpy()
+    elif case == "upsample":
+        nodes = [_onnx_node("Upsample", ["data"], ["out"], mode="nearest",
+                            scales=[1.0, 1.0, 2.0, 2.0])]
+        want = x.repeat(2, axis=2).repeat(2, axis=3)
+    else:
+        raise AssertionError(case)
+
+    sym, args, aux = _import_graph(tmp_path, nodes, x.shape, "out",
+                                   initializers=inits)
+    got = _forward(sym, args, aux, x)
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5,
+                               atol=1e-5, err_msg=case)
+
+
+def test_onnx_import_opset13_input_forms(tmp_path):
+    """Opset>=11/13 moved several attrs to inputs: Squeeze axes, Pad
+    constant_value. Both must be honored, and Slice with negative axes
+    must REFUSE (rank unknown at import) instead of silently not
+    slicing."""
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (2, 1, 3)).astype(np.float32)
+
+    nodes = [_onnx_node("Squeeze", ["data", "axes_in"], ["out"])]
+    sym, args, aux = _import_graph(
+        tmp_path, nodes, x.shape, "out",
+        initializers={"axes_in": np.array([1], np.int64)})
+    got = _forward(sym, args, aux, x)
+    assert got.shape == (2, 3)
+
+    x4 = rng.uniform(-1, 1, (1, 1, 2, 2)).astype(np.float32)
+    nodes = [_onnx_node("Pad", ["data", "pads_in", "cval"], ["out"],
+                        mode="constant")]
+    sym, args, aux = _import_graph(
+        tmp_path, nodes, x4.shape, "out",
+        initializers={"pads_in": np.array([0, 0, 1, 1, 0, 0, 1, 1],
+                                          np.int64),
+                      "cval": np.full((), 7.0, np.float32)})
+    got = _forward(sym, args, aux, x4)
+    assert got.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(got[0, 0, 0, 0], 7.0)
+
+    nodes = [_onnx_node("Slice", ["data", "s", "e", "ax"], ["out"])]
+    with pytest.raises(NotImplementedError, match="negative axes"):
+        _import_graph(tmp_path, nodes, x.shape, "out",
+                      initializers={"s": np.array([0], np.int64),
+                                    "e": np.array([2], np.int64),
+                                    "ax": np.array([-1], np.int64)})
