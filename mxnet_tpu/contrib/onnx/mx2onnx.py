@@ -316,6 +316,172 @@ def _export_dot(ctx, node, ins, outs):
     ctx.add_node("MatMul", ins, outs, node.name)
 
 
+
+
+_UNARY_EXPORT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                 "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+                 "negative": "Neg", "reciprocal": "Reciprocal",
+                 "floor": "Floor", "ceil": "Ceil", "erf": "Erf",
+                 "sin": "Sin", "cos": "Cos", "softsign": "Softsign"}
+
+
+@register_export(*_UNARY_EXPORT)
+def _export_unary(ctx, node, ins, outs):
+    ctx.add_node(_UNARY_EXPORT[node.op], ins, outs, node.name)
+
+
+@register_export("hard_sigmoid")
+def _export_hard_sigmoid(ctx, node, ins, outs):
+    ctx.add_node("HardSigmoid", ins, outs, node.name,
+                 alpha=float(node.attrs.get("alpha", 0.2)),
+                 beta=float(node.attrs.get("beta", 0.5)))
+
+
+@register_export("clip")
+def _export_clip(ctx, node, ins, outs):
+    # opset-11 form: min/max ride as initializer inputs
+    lo = ctx.add_initializer(outs[0] + "_min",
+                             _np.float32(node.attrs["a_min"]))
+    hi = ctx.add_initializer(outs[0] + "_max",
+                             _np.float32(node.attrs["a_max"]))
+    ctx.add_node("Clip", [ins[0], lo, hi], outs, node.name)
+
+
+@register_export("broadcast_maximum", "_maximum", "maximum")
+def _export_max(ctx, node, ins, outs):
+    ctx.add_node("Max", ins, outs, node.name)
+
+
+@register_export("broadcast_minimum", "_minimum", "minimum")
+def _export_min(ctx, node, ins, outs):
+    ctx.add_node("Min", ins, outs, node.name)
+
+
+@register_export("broadcast_power", "_power")
+def _export_pow(ctx, node, ins, outs):
+    ctx.add_node("Pow", ins, outs, node.name)
+
+
+@register_export("broadcast_equal", "broadcast_greater", "broadcast_lesser")
+def _export_compare(ctx, node, ins, outs):
+    op = {"broadcast_equal": "Equal", "broadcast_greater": "Greater",
+          "broadcast_lesser": "Less"}[node.op]
+    raw = outs[0] + "_bool"
+    ctx.add_node(op, ins, [raw], node.name + "_cmp")
+    # mxnet comparison ops return the input dtype, ONNX returns bool
+    ctx.add_node("Cast", [raw], outs, node.name,
+                 to=int(op_pb.TensorProto.FLOAT))
+
+
+_REDUCE_EXPORT = {"mean": "ReduceMean", "sum": "ReduceSum",
+                  "max": "ReduceMax", "min": "ReduceMin",
+                  "prod": "ReduceProd", "sum_axis": "ReduceSum",
+                  "max_axis": "ReduceMax", "min_axis": "ReduceMin"}
+
+
+@register_export(*_REDUCE_EXPORT)
+def _export_reduce(ctx, node, ins, outs):
+    if node.attrs.get("exclude"):
+        raise NotImplementedError("reduce with exclude=True has no ONNX "
+                                  "equivalent")
+    kwargs = {"keepdims": int(bool(node.attrs.get("keepdims", False)))}
+    axis = node.attrs.get("axis")
+    if axis is not None:
+        kwargs["axes"] = _ints(axis) if not isinstance(axis, int) \
+            else [int(axis)]
+    ctx.add_node(_REDUCE_EXPORT[node.op], ins, outs, node.name, **kwargs)
+
+
+@register_export("squeeze")
+def _export_squeeze(ctx, node, ins, outs):
+    kwargs = {}
+    axis = node.attrs.get("axis")
+    if axis is not None:
+        kwargs["axes"] = [int(axis)] if isinstance(axis, int) \
+            else _ints(axis)
+    ctx.add_node("Squeeze", ins, outs, node.name, **kwargs)
+
+
+@register_export("expand_dims")
+def _export_expand_dims(ctx, node, ins, outs):
+    ctx.add_node("Unsqueeze", ins, outs, node.name,
+                 axes=[int(node.attrs["axis"])])
+
+
+@register_export("tile")
+def _export_tile(ctx, node, ins, outs):
+    reps = ctx.const_shape(_ints(node.attrs["reps"]))
+    ctx.add_node("Tile", [ins[0], reps], outs, node.name)
+
+
+@register_export("depth_to_space", "space_to_depth")
+def _export_depth_space(ctx, node, ins, outs):
+    op = ("DepthToSpace" if node.op == "depth_to_space"
+          else "SpaceToDepth")
+    ctx.add_node(op, ins, outs, node.name,
+                 blocksize=int(node.attrs["block_size"]))
+
+
+@register_export("argmax")
+def _export_argmax(ctx, node, ins, outs):
+    raw = outs[0] + "_i64"
+    ctx.add_node("ArgMax", ins, [raw], node.name + "_arg",
+                 axis=int(node.attrs.get("axis", 0)),
+                 keepdims=int(bool(node.attrs.get("keepdims", False))))
+    # mxnet argmax returns float (reference semantics); ONNX returns int64
+    ctx.add_node("Cast", [raw], outs, node.name,
+                 to=int(op_pb.TensorProto.FLOAT))
+
+
+@register_export("InstanceNorm")
+def _export_instance_norm(ctx, node, ins, outs):
+    ctx.add_node("InstanceNormalization", ins, outs, node.name,
+                 epsilon=float(node.attrs.get("eps", 1e-5)))
+
+
+@register_export("UpSampling")
+def _export_upsampling(ctx, node, ins, outs):
+    if node.attrs.get("sample_type", "nearest") != "nearest":
+        raise NotImplementedError("only nearest UpSampling exports")
+    scale = float(int(node.attrs["scale"]))
+    ctx.add_node("Upsample", ins, outs, node.name, mode="nearest",
+                 scales=[1.0, 1.0, scale, scale])
+
+
+@register_export("Pad")
+def _export_pad(ctx, node, ins, outs):
+    pw = _ints(node.attrs["pad_width"])
+    half = len(pw) // 2
+    # mxnet (x1_b, x1_e, x2_b, x2_e, ...) -> ONNX [b..., e...]
+    pads = [pw[2 * i] for i in range(half)] \
+        + [pw[2 * i + 1] for i in range(half)]
+    cval = ctx.add_initializer(
+        outs[0] + "_cval",
+        _np.float32(node.attrs.get("constant_value", 0.0)))
+    pads_in = ctx.const_shape(pads)
+    ctx.add_node("Pad", [ins[0], pads_in, cval], outs, node.name,
+                 mode=str(node.attrs.get("mode", "constant")))
+
+
+@register_export("slice")
+def _export_slice(ctx, node, ins, outs):
+    begin = list(node.attrs["begin"])
+    end = list(node.attrs["end"])
+    step = list(node.attrs.get("step", []) or [1] * len(begin))
+    axes = list(range(len(begin)))
+    steps = [1 if st is None else int(st) for st in step]
+    # None defaults depend on direction: reversed slices start at the far
+    # end and run past the beginning (ONNX INT_MAX / INT_MIN sentinels)
+    starts = [(0 if st > 0 else 2 ** 31 - 1) if b is None else int(b)
+              for b, st in zip(begin, steps)]
+    ends = [(2 ** 31 - 1 if st > 0 else -(2 ** 31) + 1) if e is None
+            else int(e) for e, st in zip(end, steps)]
+    ctx.add_node("Slice",
+                 [ins[0], ctx.const_shape(starts), ctx.const_shape(ends),
+                  ctx.const_shape(axes), ctx.const_shape(steps)],
+                 outs, node.name)
+
+
 # ------------------------------------------------------------------- driver
 
 def export_model(sym, params, input_shape, input_type=_np.float32,

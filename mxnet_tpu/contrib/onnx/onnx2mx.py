@@ -355,6 +355,12 @@ def _import_unary(ctx, node, a, sym_mod):
                                 name=node.name or node.output[0])
 
 
+@register_import("Softsign")
+def _import_softsign(ctx, node, a, sym_mod):
+    return sym_mod.softsign(ctx.sym(node.input[0]),
+                            name=node.name or node.output[0])
+
+
 @register_import("HardSigmoid")
 def _import_hard_sigmoid(ctx, node, a, sym_mod):
     return sym_mod.hard_sigmoid(ctx.sym(node.input[0]),
@@ -391,12 +397,14 @@ def _import_clip(ctx, node, a, sym_mod):
     # opset<11 carries min/max as attrs; opset>=11 as optional inputs,
     # importable when they are initializers
     lo, hi = a.get("min"), a.get("max")
+    def _scalar(arr):  # initializers may arrive 0-d or shape-(1,)
+        return float(_np.asarray(arr).reshape(-1)[0])
     if lo is None:
         arr = _const_operand(ctx, node, 1, "min")
-        lo = float(arr) if arr is not None else None
+        lo = _scalar(arr) if arr is not None else None
     if hi is None:
         arr = _const_operand(ctx, node, 2, "max")
-        hi = float(arr) if arr is not None else None
+        hi = _scalar(arr) if arr is not None else None
     return sym_mod.clip(ctx.sym(node.input[0]),
                         a_min=float(lo if lo is not None else -3.4e38),
                         a_max=float(hi if hi is not None else 3.4e38),
@@ -409,8 +417,12 @@ def _import_reduce(ctx, node, a, sym_mod):
     fn = {"ReduceMean": "mean", "ReduceSum": "sum", "ReduceMax": "max",
           "ReduceMin": "min", "ReduceProd": "prod"}[node.op_type]
     kwargs = {"keepdims": bool(a.get("keepdims", 1))}
-    if a.get("axes") is not None:
-        kwargs["axis"] = tuple(a["axes"])
+    axes = a.get("axes")
+    if axes is None:  # opset >= 13 (ReduceSum first) moves axes to input[1]
+        arr = _const_operand(ctx, node, 1, "axes")
+        axes = [int(v) for v in arr] if arr is not None else None
+    if axes is not None:
+        kwargs["axis"] = tuple(axes)
     return getattr(sym_mod, fn)(ctx.sym(node.input[0]),
                                 name=node.name or node.output[0], **kwargs)
 
@@ -472,8 +484,12 @@ def _import_slice(ctx, node, a, sym_mod):
     b = [begin.get(i) for i in range(ndim)]
     e = [end.get(i) for i in range(ndim)]
     st = [step.get(i, 1) for i in range(ndim)]
-    # clamp ONNX's INT_MAX "to the end" sentinel to None
-    e = [None if (v is not None and v >= 2**31 - 1) else v for v in e]
+    # ONNX sentinels: INT_MAX start/end = "from/to the far end" (positive
+    # step), INT_MIN end = "past the beginning" (negative step) — all map
+    # to python-slice None
+    b = [None if (v is not None and v >= 2**31 - 1) else v for v in b]
+    e = [None if (v is not None and (v >= 2**31 - 1 or v <= -(2**31) + 1))
+         else v for v in e]
     return sym_mod.slice(ctx.sym(node.input[0]), begin=tuple(b),
                          end=tuple(e), step=tuple(st),
                          name=node.name or node.output[0])
@@ -503,7 +519,8 @@ def _import_pad(ctx, node, a, sym_mod):
     value = a.get("value")
     if value is None:  # opset >= 11 moves the fill value to input[2]
         arr = _const_operand(ctx, node, 2, "constant_value")
-        value = float(arr) if arr is not None else 0.0
+        value = float(_np.asarray(arr).reshape(-1)[0]) \
+            if arr is not None else 0.0
     half = len(pads) // 2
     # ONNX: [x1_b, x2_b, ..., x1_e, x2_e]; mxnet: (x1_b, x1_e, x2_b, x2_e...)
     pw = []
@@ -539,10 +556,9 @@ def _import_compare(ctx, node, a, sym_mod):
 
 @register_import("Tile")
 def _import_tile(ctx, node, a, sym_mod):
-    reps = ctx.consts.get(node.input[1])
+    reps = _const_operand(ctx, node, 1, "repeats")
     if reps is None:
-        raise NotImplementedError("Tile with dynamic repeats")
-    ctx.arg_params.pop(node.input[1], None)
+        raise NotImplementedError("Tile without repeats")
     return sym_mod.tile(ctx.sym(node.input[0]),
                         reps=tuple(int(r) for r in reps),
                         name=node.name or node.output[0])

@@ -442,3 +442,90 @@ def test_onnx_import_opset13_input_forms(tmp_path):
                       initializers={"s": np.array([0], np.int64),
                                     "e": np.array([2], np.int64),
                                     "ax": np.array([-1], np.int64)})
+
+
+def _elemwise_chain_symbol():
+    d = mx.sym.var("data")
+    out = mx.sym.clip(d, a_min=0.2, a_max=1.5)
+    out = mx.sym.exp(out)
+    out = mx.sym.hard_sigmoid(out, alpha=0.3, beta=0.1)
+    out = mx.sym.broadcast_maximum(out, mx.sym.sqrt(d))
+    return out
+
+
+def _shape_chain_symbol():
+    d = mx.sym.var("data")
+    out = mx.sym.Pad(d, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                     constant_value=0.5)
+    out = mx.sym.slice(out, begin=(None, None, 1, 1), end=(None, None, None,
+                                                           None))
+    out = mx.sym.expand_dims(out, axis=0)
+    out = mx.sym.squeeze(out, axis=0)
+    out = mx.sym.mean(out, axis=(2,), keepdims=True)
+    return out
+
+
+def _spatial_chain_symbol():
+    d = mx.sym.var("data")
+    out = mx.sym.space_to_depth(d, block_size=2)
+    out = mx.sym.depth_to_space(out, block_size=2)
+    out = mx.sym.UpSampling(out, scale=2, sample_type="nearest")
+    out = mx.sym.tile(out, reps=(1, 2, 1, 1))
+    return out
+
+
+@pytest.mark.parametrize("build,shape", [
+    (_elemwise_chain_symbol, (2, 3, 4, 4)),
+    (_shape_chain_symbol, (2, 3, 4, 4)),
+    (_spatial_chain_symbol, (2, 4, 4, 4)),
+])
+def test_onnx_roundtrip_extended_ops(tmp_path, build, shape):
+    """The round-4 exporter additions (clip/unary/hard_sigmoid/max, Pad/
+    slice/expand_dims/squeeze/reduce, space-depth/UpSampling/tile) must
+    export and reimport to the same forward."""
+    symbol = build()
+    rng = np.random.RandomState(2)
+    x = rng.uniform(0.1, 2.0, shape).astype(np.float32)
+    exe = symbol.simple_bind(ctx=mx.cpu(), data=shape)
+    want = exe.forward(data=mx.nd.array(x))[0].asnumpy()
+
+    path = str(tmp_path / "ext.onnx")
+    onnx_mxnet.export_model(symbol, {}, [shape], np.float32, path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    got = _forward(sym2, args2, aux2, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_negative_step_slice_and_softsign_roundtrip(tmp_path):
+    """x[:, ::-1] must survive export->import (None begin/end map to the
+    direction-dependent ONNX sentinels), and softsign has both an exporter
+    and an importer."""
+    d = mx.sym.var("data")
+    out = mx.sym.slice(mx.sym.softsign(d), begin=(None, None),
+                       end=(None, None), step=(1, -1))
+    shape = (2, 5)
+    rng = np.random.RandomState(8)
+    x = rng.uniform(-2, 2, shape).astype(np.float32)
+    exe = out.simple_bind(ctx=mx.cpu(), data=shape)
+    want = exe.forward(data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(want, (x / (1 + np.abs(x)))[:, ::-1],
+                               rtol=1e-6)  # sanity: truly reversed
+
+    path = str(tmp_path / "revslice.onnx")
+    onnx_mxnet.export_model(out, {}, [shape], np.float32, path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    got = _forward(sym2, args2, aux2, x)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_onnx_import_opset13_reducesum_axes_input(tmp_path):
+    """Opset-13 ReduceSum carries axes as input[1]; silently reducing all
+    axes was the failure mode."""
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    nodes = [_onnx_node("ReduceSum", ["data", "ax"], ["out"], keepdims=0)]
+    sym, args, aux = _import_graph(
+        tmp_path, nodes, x.shape, "out",
+        initializers={"ax": np.array([1], np.int64)})
+    got = _forward(sym, args, aux, x)
+    np.testing.assert_allclose(got, x.sum(axis=1), rtol=1e-5, atol=1e-6)
