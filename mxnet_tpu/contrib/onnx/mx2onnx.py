@@ -425,9 +425,19 @@ def _export_depth_space(ctx, node, ins, outs):
 @register_export("argmax")
 def _export_argmax(ctx, node, ins, outs):
     raw = outs[0] + "_i64"
-    ctx.add_node("ArgMax", ins, [raw], node.name + "_arg",
-                 axis=int(node.attrs.get("axis", 0)),
-                 keepdims=int(bool(node.attrs.get("keepdims", False))))
+    axis = node.attrs.get("axis")
+    if axis is None:
+        # runtime default is the GLOBAL argmax of the flattened array
+        # (reduce_ops.py _argmax), shape (1,): flatten, then axis 0
+        flat = outs[0] + "_flat"
+        ctx.add_node("Reshape", [ins[0], ctx.const_shape([-1])], [flat],
+                     node.name + "_flat")
+        ctx.add_node("ArgMax", [flat], [raw], node.name + "_arg",
+                     axis=0, keepdims=1)
+    else:
+        ctx.add_node("ArgMax", ins, [raw], node.name + "_arg",
+                     axis=int(axis),
+                     keepdims=int(bool(node.attrs.get("keepdims", False))))
     # mxnet argmax returns float (reference semantics); ONNX returns int64
     ctx.add_node("Cast", [raw], outs, node.name,
                  to=int(op_pb.TensorProto.FLOAT))
@@ -436,7 +446,7 @@ def _export_argmax(ctx, node, ins, outs):
 @register_export("InstanceNorm")
 def _export_instance_norm(ctx, node, ins, outs):
     ctx.add_node("InstanceNormalization", ins, outs, node.name,
-                 epsilon=float(node.attrs.get("eps", 1e-5)))
+                 epsilon=float(node.attrs.get("eps", 1e-3)))
 
 
 @register_export("UpSampling")
@@ -444,8 +454,15 @@ def _export_upsampling(ctx, node, ins, outs):
     if node.attrs.get("sample_type", "nearest") != "nearest":
         raise NotImplementedError("only nearest UpSampling exports")
     scale = float(int(node.attrs["scale"]))
-    ctx.add_node("Upsample", ins, outs, node.name, mode="nearest",
-                 scales=[1.0, 1.0, scale, scale])
+    # opset 11: Upsample is gone; Resize(X, roi, scales) replaces it (roi
+    # only matters for tf_crop_and_resize but the slot must exist)
+    roi = ctx.add_initializer(outs[0] + "_roi",
+                              _np.zeros((0,), _np.float32))
+    scales = ctx.add_initializer(
+        outs[0] + "_scales",
+        _np.asarray([1.0, 1.0, scale, scale], _np.float32))
+    ctx.add_node("Resize", [ins[0], roi, scales], outs, node.name,
+                 mode="nearest")
 
 
 @register_export("Pad")

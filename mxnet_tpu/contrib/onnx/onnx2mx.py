@@ -345,20 +345,14 @@ def _const_operand(ctx, node, i, what):
 
 
 @register_import("Exp", "Log", "Sqrt", "Neg", "Abs", "Reciprocal",
-                 "Floor", "Ceil", "Erf", "Sin", "Cos")
+                 "Floor", "Ceil", "Erf", "Sin", "Cos", "Softsign")
 def _import_unary(ctx, node, a, sym_mod):
     fn = {"Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Neg": "negative",
           "Abs": "abs", "Reciprocal": "reciprocal", "Floor": "floor",
-          "Ceil": "ceil", "Erf": "erf", "Sin": "sin",
-          "Cos": "cos"}[node.op_type]
+          "Ceil": "ceil", "Erf": "erf", "Sin": "sin", "Cos": "cos",
+          "Softsign": "softsign"}[node.op_type]
     return getattr(sym_mod, fn)(ctx.sym(node.input[0]),
                                 name=node.name or node.output[0])
-
-
-@register_import("Softsign")
-def _import_softsign(ctx, node, a, sym_mod):
-    return sym_mod.softsign(ctx.sym(node.input[0]),
-                            name=node.name or node.output[0])
 
 
 @register_import("HardSigmoid")
@@ -571,6 +565,22 @@ def _import_depth_space(ctx, node, a, sym_mod):
     return getattr(sym_mod, fn)(ctx.sym(node.input[0]),
                                 block_size=int(a["blocksize"]),
                                 name=node.name or node.output[0])
+
+
+@register_import("Resize")
+def _import_resize(ctx, node, a, sym_mod):
+    if a.get("mode", "nearest") != "nearest":
+        raise NotImplementedError("Resize mode %r" % a.get("mode"))
+    arr = _const_operand(ctx, node, 2, "scales")
+    if arr is None or len(arr) != 4:
+        raise NotImplementedError("Resize without static 4-d scales")
+    _const_operand(ctx, node, 1, "roi")  # consume the roi slot if present
+    scales = [float(v) for v in arr]
+    if scales[0] != 1 or scales[1] != 1 or scales[2] != scales[3]:
+        raise NotImplementedError("Resize scales %s" % (scales,))
+    return sym_mod.UpSampling(ctx.sym(node.input[0]),
+                              scale=int(scales[2]), sample_type="nearest",
+                              name=node.name or node.output[0])
 
 
 @register_import("Upsample")

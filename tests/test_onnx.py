@@ -529,3 +529,39 @@ def test_onnx_import_opset13_reducesum_axes_input(tmp_path):
         initializers={"ax": np.array([1], np.int64)})
     got = _forward(sym, args, aux, x)
     np.testing.assert_allclose(got, x.sum(axis=1), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("family", ["squeezenet1_0", "mobilenet0_25",
+                                    "mobilenet_v2_0_25"])
+def test_onnx_zoo_family_roundtrip(tmp_path, family):
+    """More zoo families through export->import: squeezenet exercises
+    concat fire modules, the mobilenets grouped/depthwise convolutions."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = getattr(vision, family)(classes=10)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.uniform(-1, 1, (1, 3, 32, 32)).astype(np.float32))
+    want = net(x).asnumpy()
+    s = net(sym.Variable("data"))
+    params = {name: p.data() for name, p in net.collect_params().items()}
+    path = str(tmp_path / (family + ".onnx"))
+    onnx_mxnet.export_model(s, params, [(1, 3, 32, 32)], np.float32, path)
+    got = _forward(*onnx_mxnet.import_model(path), x.asnumpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_global_argmax_roundtrip(tmp_path):
+    """mx argmax with no axis is the GLOBAL flat argmax (shape (1,));
+    exporting it as ArgMax(axis=0) was silently wrong."""
+    d = mx.sym.var("data")
+    out = mx.sym.argmax(d)
+    shape = (2, 3)
+    x = np.array([[1., 9., 2.], [3., 0., 4.]], np.float32)
+    exe = out.simple_bind(ctx=mx.cpu(), data=shape)
+    want = exe.forward(data=mx.nd.array(x))[0].asnumpy()
+    assert want.shape == (1,) and want[0] == 1.0
+
+    path = str(tmp_path / "gargmax.onnx")
+    onnx_mxnet.export_model(out, {}, [shape], np.float32, path)
+    got = _forward(*onnx_mxnet.import_model(path), x)
+    np.testing.assert_allclose(got, want)
