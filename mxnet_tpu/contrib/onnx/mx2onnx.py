@@ -465,6 +465,22 @@ def _export_upsampling(ctx, node, ins, outs):
                  mode="nearest")
 
 
+@register_export("Deconvolution")
+def _export_deconv(ctx, node, ins, outs):
+    if tuple(_ints(node.attrs.get("target_shape", ()) or ())):
+        raise NotImplementedError("Deconvolution with target_shape")
+    kernel = _ints(node.attrs["kernel"])
+    nd_ = len(kernel)
+    stride = _ints(node.attrs.get("stride", [1] * nd_), nd_)
+    pad = _ints(node.attrs.get("pad", [0] * nd_), nd_)
+    dilate = _ints(node.attrs.get("dilate", [1] * nd_), nd_)
+    adj = _ints(node.attrs.get("adj", [0] * nd_), nd_)
+    ctx.add_node("ConvTranspose", ins, outs, node.name,
+                 kernel_shape=kernel, strides=stride, pads=pad * 2,
+                 dilations=dilate, output_padding=adj,
+                 group=int(node.attrs.get("num_group", 1)))
+
+
 @register_export("Pad")
 def _export_pad(ctx, node, ins, outs):
     pw = _ints(node.attrs["pad_width"])

@@ -105,6 +105,32 @@ def _halve_pads(pads):
     return [int(p) for p in begin]
 
 
+@register_import("ConvTranspose")
+def _import_conv_transpose(ctx, node, a, sym_mod):
+    weight = ctx.consts.get(node.input[1])
+    # ONNX ConvTranspose weight is (C_in, C_out/group, *k): num_filter is
+    # the OUTPUT channel count
+    kwargs = {"kernel": tuple(a["kernel_shape"]),
+              "num_group": int(a.get("group", 1)),
+              "no_bias": len(node.input) < 3}
+    if weight is not None:
+        kwargs["num_filter"] = int(weight.shape[1]) * kwargs["num_group"]
+    if a.get("strides"):
+        kwargs["stride"] = tuple(a["strides"])
+    if a.get("dilations"):
+        kwargs["dilate"] = tuple(a["dilations"])
+    if a.get("output_padding"):
+        kwargs["adj"] = tuple(a["output_padding"])
+    if a.get("output_shape") or a.get("auto_pad", "NOTSET") != "NOTSET":
+        raise NotImplementedError("ConvTranspose output_shape/auto_pad")
+    pad = _halve_pads(a.get("pads"))
+    if pad:
+        kwargs["pad"] = tuple(pad)
+    ins = [ctx.sym(i) for i in node.input]
+    return sym_mod.Deconvolution(*ins, name=node.name or node.output[0],
+                                 **kwargs)
+
+
 @register_import("Conv")
 def _import_conv(ctx, node, a, sym_mod):
     weight = ctx.consts.get(node.input[1])
@@ -127,8 +153,34 @@ def _import_conv(ctx, node, a, sym_mod):
 def _import_gemm(ctx, node, a, sym_mod):
     if a.get("transA", 0):
         raise NotImplementedError("Gemm with transA")
-    if a.get("alpha", 1.0) != 1.0 or a.get("beta", 1.0) != 1.0:
-        raise NotImplementedError("Gemm with alpha/beta != 1")
+    alpha = float(a.get("alpha", 1.0))
+    beta = float(a.get("beta", 1.0))
+    if (alpha != 1.0 or beta != 1.0) and len(node.input) > 2:
+        # general case: fold the scales into the initializers ONCE — a
+        # weight shared by several Gemm nodes must not be scaled twice
+        # (same sharing the transB path guards with ctx.transposed)
+        if not hasattr(ctx, "scaled"):
+            ctx.scaled = {}
+        for name, scale in ((node.input[1], alpha), (node.input[2], beta)):
+            if scale == 1.0:
+                continue
+            prev = ctx.scaled.get(name)
+            if prev == scale:
+                continue
+            if prev is not None:
+                raise NotImplementedError(
+                    "initializer %r shared by Gemm nodes with different "
+                    "scales (%s vs %s)" % (name, prev, scale))
+            if name not in ctx.arg_params:
+                raise NotImplementedError(
+                    "Gemm alpha/beta != 1 with dynamic operands")
+            from ... import ndarray as nd
+            ctx.arg_params[name] = nd.array(
+                ctx.arg_params[name].asnumpy() * scale)
+            ctx.consts[name] = ctx.consts[name] * scale
+            ctx.scaled[name] = scale
+    elif alpha != 1.0:
+        raise NotImplementedError("Gemm alpha != 1 with dynamic A*B")
     weight_name = node.input[1]
     if not a.get("transB", 0):
         # mxnet FC stores (hidden, in): transpose the initializer once —
@@ -576,7 +628,8 @@ def _import_resize(ctx, node, a, sym_mod):
         raise NotImplementedError("Resize without static 4-d scales")
     _const_operand(ctx, node, 1, "roi")  # consume the roi slot if present
     scales = [float(v) for v in arr]
-    if scales[0] != 1 or scales[1] != 1 or scales[2] != scales[3]:
+    if scales[0] != 1 or scales[1] != 1 or scales[2] != scales[3] \
+            or scales[2] != int(scales[2]):
         raise NotImplementedError("Resize scales %s" % (scales,))
     return sym_mod.UpSampling(ctx.sym(node.input[0]),
                               scale=int(scales[2]), sample_type="nearest",

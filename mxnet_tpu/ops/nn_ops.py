@@ -122,9 +122,14 @@ def _deconvolution(attrs, data, weight, bias=None):
     if layout is not None and not layout.startswith("NC"):
         raise ValueError("Deconvolution supports channel-first layouts only; "
                          "got layout=%r" % (layout,))
-    # weight layout (in_c, out_c/g, *kernel) per MXNet deconvolution
+    dilate = _pair(attrs.get("dilate", (1,) * nd), nd)
+    # weight layout (in_c, out_c/g, *kernel) per MXNet deconvolution.
+    # Output size is (i-1)*s + (k-1)*d + 1 - 2p + adj: the effective
+    # (dilated) kernel sets the halo, and adj widens the TRAILING side
+    # only (deconvolution-inl.h — adj recovers sizes conv rounded away).
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
-    pads = [(k - 1 - p + a, k - 1 - p + a) for k, p, a in zip(kernel, pad, adj)]
+    ke = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
+    pads = [(k - 1 - p, k - 1 - p + a) for k, p, a in zip(ke, pad, adj)]
     w = jnp.swapaxes(weight, 0, 1)
     w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
     if num_group > 1:
@@ -136,13 +141,13 @@ def _deconvolution(attrs, data, weight, bias=None):
             wi = jnp.flip(jnp.swapaxes(wi, 0, 1), axis=tuple(range(2, 2 + nd)))
             outs.append(lax.conv_general_dilated(
                 xi, wi, window_strides=(1,) * nd, padding=pads,
-                lhs_dilation=stride, rhs_dilation=(1,) * nd,
+                lhs_dilation=stride, rhs_dilation=dilate,
                 dimension_numbers=dn))
         out = jnp.concatenate(outs, axis=1)
     else:
         out = lax.conv_general_dilated(
             data, w, window_strides=(1,) * nd, padding=pads,
-            lhs_dilation=stride, rhs_dilation=(1,) * nd,
+            lhs_dilation=stride, rhs_dilation=dilate,
             dimension_numbers=dn)
     if not attrs.get("no_bias", True) and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)

@@ -565,3 +565,25 @@ def test_onnx_global_argmax_roundtrip(tmp_path):
     onnx_mxnet.export_model(out, {}, [shape], np.float32, path)
     got = _forward(*onnx_mxnet.import_model(path), x)
     np.testing.assert_allclose(got, want)
+
+
+def test_onnx_deconvolution_roundtrip(tmp_path):
+    """Deconvolution <-> ConvTranspose (the FCN/DCGAN upsampling path),
+    incl. stride/pad/adj attributes."""
+    d = mx.sym.var("data")
+    out = mx.sym.Deconvolution(d, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                               num_filter=6, name="deconv")
+    shape = (2, 3, 5, 5)
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+    exe = out.simple_bind(ctx=mx.cpu(), data=shape)
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.uniform(-0.3, 0.3, arr.shape).astype(np.float32)
+    params = {n: a.copy() for n, a in exe.arg_dict.items() if n != "data"}
+    want = exe.forward(data=mx.nd.array(x))[0].asnumpy()
+
+    path = str(tmp_path / "deconv.onnx")
+    onnx_mxnet.export_model(out, params, [shape], np.float32, path)
+    got = _forward(*onnx_mxnet.import_model(path), x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

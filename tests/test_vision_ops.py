@@ -467,3 +467,41 @@ def test_proposal_batched():
     o = out.asnumpy()
     assert o.shape == (8, 5)
     assert np.all(o[:4, 0] == 0) and np.all(o[4:, 0] == 1)
+
+
+def test_deconvolution_adj_dilate_match_scatter_reference():
+    """Deconvolution with adj/dilate against a first-principles scatter-add
+    (reference deconvolution-inl.h semantics: out = (i-1)s + (k-1)d + 1
+    - 2p + adj, adj widening the trailing side only — applying adj to
+    both sides was a real bug this pins)."""
+    def ref_deconv(x, w, s, p, adj, d):
+        B, Ci, H, W = x.shape
+        _, Co, K, _ = w.shape
+        OH = (H - 1) * s + (K - 1) * d + 1 - 2 * p + adj
+        OW = (W - 1) * s + (K - 1) * d + 1 - 2 * p + adj
+        out = np.zeros((B, Co, OH + 2 * p, OW + 2 * p), np.float64)
+        for b in range(B):
+            for ci in range(Ci):
+                for co in range(Co):
+                    for i in range(H):
+                        for j in range(W):
+                            for ki in range(K):
+                                for kj in range(K):
+                                    out[b, co, i * s + ki * d,
+                                        j * s + kj * d] += \
+                                        x[b, ci, i, j] * w[ci, co, ki, kj]
+        return out[:, :, p:p + OH, p:p + OW]
+
+    rng = np.random.RandomState(0)
+    for (s, p, adj, d) in [(2, 1, 1, 1), (2, 0, 0, 2), (3, 1, 2, 1),
+                           (2, 1, 1, 2)]:
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        w = rng.randn(3, 5, 3, 3).astype(np.float32)
+        want = ref_deconv(x.astype(np.float64), w.astype(np.float64),
+                          s, p, adj, d)
+        got = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                               stride=(s, s), pad=(p, p), adj=(adj, adj),
+                               dilate=(d, d), num_filter=5,
+                               no_bias=True).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=str((s, p, adj, d)))
