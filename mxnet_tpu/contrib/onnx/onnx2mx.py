@@ -149,39 +149,34 @@ def _import_conv(ctx, node, a, sym_mod):
     return sym_mod.Convolution(*ins, name=node.name or node.output[0], **kwargs)
 
 
+def _scaled_clone(ctx, name, scale):
+    """A CLONE of initializer `name` scaled by `scale`, under a derived
+    name — never mutate the original: other consumers (a Gemm with
+    alpha=1, a MatMul, anything) read it too."""
+    if scale == 1.0:
+        return name
+    if name not in ctx.consts:
+        raise NotImplementedError(
+            "Gemm alpha/beta != 1 with dynamic operands")
+    new = "%s__x%g" % (name, scale)
+    if new not in ctx.consts:
+        from ... import ndarray as nd
+        ctx.consts[new] = ctx.consts[name] * scale
+        ctx.arg_params[new] = nd.array(ctx.consts[new])
+    return new
+
+
 @register_import("Gemm")
 def _import_gemm(ctx, node, a, sym_mod):
     if a.get("transA", 0):
         raise NotImplementedError("Gemm with transA")
     alpha = float(a.get("alpha", 1.0))
     beta = float(a.get("beta", 1.0))
-    if (alpha != 1.0 or beta != 1.0) and len(node.input) > 2:
-        # general case: fold the scales into the initializers ONCE — a
-        # weight shared by several Gemm nodes must not be scaled twice
-        # (same sharing the transB path guards with ctx.transposed)
-        if not hasattr(ctx, "scaled"):
-            ctx.scaled = {}
-        for name, scale in ((node.input[1], alpha), (node.input[2], beta)):
-            if scale == 1.0:
-                continue
-            prev = ctx.scaled.get(name)
-            if prev == scale:
-                continue
-            if prev is not None:
-                raise NotImplementedError(
-                    "initializer %r shared by Gemm nodes with different "
-                    "scales (%s vs %s)" % (name, prev, scale))
-            if name not in ctx.arg_params:
-                raise NotImplementedError(
-                    "Gemm alpha/beta != 1 with dynamic operands")
-            from ... import ndarray as nd
-            ctx.arg_params[name] = nd.array(
-                ctx.arg_params[name].asnumpy() * scale)
-            ctx.consts[name] = ctx.consts[name] * scale
-            ctx.scaled[name] = scale
-    elif alpha != 1.0:
-        raise NotImplementedError("Gemm alpha != 1 with dynamic A*B")
-    weight_name = node.input[1]
+    in_names = list(node.input)
+    in_names[1] = _scaled_clone(ctx, in_names[1], alpha)
+    if len(in_names) > 2:
+        in_names[2] = _scaled_clone(ctx, in_names[2], beta)
+    weight_name = in_names[1]
     if not a.get("transB", 0):
         # mxnet FC stores (hidden, in): transpose the initializer once —
         # idempotently, since several Gemm nodes may share the weight
@@ -193,11 +188,11 @@ def _import_gemm(ctx, node, a, sym_mod):
             ctx.consts[weight_name] = ctx.consts[weight_name].T
             ctx.transposed.add(weight_name)
     weight = ctx.consts.get(weight_name)
-    ins = [ctx.sym(i) for i in node.input]
+    ins = [ctx.sym(i) for i in in_names]
     return sym_mod.FullyConnected(
         *ins, name=node.name or node.output[0],
         num_hidden=int(weight.shape[0]) if weight is not None else 0,
-        no_bias=len(node.input) < 3)
+        no_bias=len(in_names) < 3)
 
 
 @register_import("MatMul")

@@ -210,12 +210,31 @@ def _pooling(attrs, data):
         if pool_type == "sum":
             return s
         if bool(attrs.get("count_include_pad", True)):
-            denom = 1.0
-            for k in kernel:
-                denom *= k
-            return s / denom
+            extra = [hi - pad[i] for i, (_, hi) in enumerate(spads)]
+            if not any(extra):
+                denom = 1.0
+                for k in kernel:
+                    denom *= k
+                return s / denom
+            # ceil-mode windows hang past the padded extent; the reference
+            # divisor is the window area clipped to [-p, i+p) — padding
+            # cells count, the ceil-extra region does not (pool.h:273-275)
+            ones = jnp.ones_like(data)
+            sym_pads = [(pad[i], pad[i]) for i in range(nd)]
+            if channel_last:
+                ones_p = jnp.pad(ones, [(0, 0)] + sym_pads + [(0, 0)],
+                                 constant_values=1)
+                extra_pads = [(0, 0)] + [(0, e) for e in extra] + [(0, 0)]
+            else:
+                ones_p = jnp.pad(ones, [(0, 0), (0, 0)] + sym_pads,
+                                 constant_values=1)
+                extra_pads = [(0, 0), (0, 0)] + [(0, e) for e in extra]
+            cnt = lax.reduce_window(ones_p, _np.array(0.0, data.dtype),
+                                    lax.add, window, strides, extra_pads)
+            return s / cnt
         ones = jnp.ones_like(data)
-        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        cnt = lax.reduce_window(ones, _np.array(0.0, data.dtype), lax.add,
+                                window, strides, pads)
         return s / cnt
     raise ValueError("unsupported pool_type %s" % pool_type)
 

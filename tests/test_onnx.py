@@ -587,3 +587,25 @@ def test_onnx_deconvolution_roundtrip(tmp_path):
     onnx_mxnet.export_model(out, params, [shape], np.float32, path)
     got = _forward(*onnx_mxnet.import_model(path), x)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_gemm_alpha_beta_and_shared_weight(tmp_path):
+    """Gemm scale folding must CLONE, not mutate: the same initializer
+    feeds a Gemm with alpha=2 and a Gemm with alpha=1; both must compute
+    with their own scale."""
+    rng = np.random.RandomState(9)
+    x = rng.uniform(-1, 1, (2, 3)).astype(np.float32)
+    W = rng.uniform(-1, 1, (4, 3)).astype(np.float32)  # transB layout
+    b = rng.uniform(-1, 1, (4,)).astype(np.float32)
+    nodes = [
+        _onnx_node("Gemm", ["data", "W", "b"], ["g2"], alpha=2.0,
+                   beta=0.5, transB=1),
+        _onnx_node("Gemm", ["data", "W", "b"], ["g1"], transB=1),
+        _onnx_node("Add", ["g2", "g1"], ["out"]),
+    ]
+    sym, args, aux = _import_graph(
+        tmp_path, nodes, x.shape, "out",
+        initializers={"W": W, "b": b})
+    got = _forward(sym, args, aux, x)
+    want = (2.0 * x @ W.T + 0.5 * b) + (x @ W.T + b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

@@ -505,3 +505,36 @@ def test_deconvolution_adj_dilate_match_scatter_reference():
                                no_bias=True).asnumpy()
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
                                    err_msg=str((s, p, adj, d)))
+
+
+def test_avg_pooling_full_convention_clipped_divisor():
+    """avg Pooling with pooling_convention='full': the divisor is the
+    window area clipped to the padded extent [-p, i+p) — padding cells
+    count, the ceil-extra region does not (reference pool.h:273-286).
+    Dividing ceil-mode edge windows by the full kernel size was a real
+    bug this pins."""
+    def ref_avg_full(x, k, s, p):
+        H = x.shape[2]
+        O = int(np.ceil((H + 2 * p - k) / s)) + 1
+        out = np.zeros((1, 1, O, O), np.float64)
+        for i in range(O):
+            for j in range(O):
+                hs, ws = i * s - p, j * s - p
+                he = min(hs + k, H + p)
+                we = min(ws + k, H + p)
+                size = (he - hs) * (we - ws)  # clipped to padded extent
+                hs_, ws_ = max(hs, 0), max(ws, 0)
+                he_, we_ = min(he, H), min(we, H)
+                out[0, 0, i, j] = x[0, 0, hs_:he_, ws_:we_].sum() / size
+        return out
+
+    rng = np.random.RandomState(1)
+    for (k, s, p) in [(2, 2, 0), (3, 2, 1), (2, 3, 1)]:
+        x = rng.rand(1, 1, 5, 5).astype(np.float32)
+        got = nd.Pooling(nd.array(x), kernel=(k, k), stride=(s, s),
+                         pad=(p, p), pool_type="avg",
+                         pooling_convention="full").asnumpy()
+        want = ref_avg_full(x.astype(np.float64), k, s, p)
+        assert got.shape == want.shape, (k, s, p, got.shape, want.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=str((k, s, p)))
