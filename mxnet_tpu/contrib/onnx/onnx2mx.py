@@ -81,7 +81,6 @@ class _ImportContext:
         self.consts = {}      # initializer name -> numpy (for shape reads)
         self.arg_params = {}
         self.aux_params = {}
-        self.transposed = set()  # weights already re-laid-out for mxnet FC
 
     def sym(self, name):
         from ... import symbol as sym_mod
@@ -178,15 +177,19 @@ def _import_gemm(ctx, node, a, sym_mod):
         in_names[2] = _scaled_clone(ctx, in_names[2], beta)
     weight_name = in_names[1]
     if not a.get("transB", 0):
-        # mxnet FC stores (hidden, in): transpose the initializer once —
-        # idempotently, since several Gemm nodes may share the weight
-        if weight_name in ctx.arg_params and \
-                weight_name not in ctx.transposed:
-            from ... import ndarray as nd
-            ctx.arg_params[weight_name] = nd.array(
-                ctx.arg_params[weight_name].asnumpy().T)
-            ctx.consts[weight_name] = ctx.consts[weight_name].T
-            ctx.transposed.add(weight_name)
+        # mxnet FC stores (hidden, in): the transpose, like the scaling
+        # above, goes into a CLONE under a derived name — mutating the
+        # original corrupts other consumers (a MatMul reading the same
+        # initializer); several Gemm nodes sharing the weight reuse the
+        # one clone
+        if weight_name not in ctx.consts:
+            raise NotImplementedError("Gemm transB=0 with dynamic weight")
+        from ... import ndarray as nd
+        new = weight_name + "__T"
+        if new not in ctx.consts:
+            ctx.consts[new] = ctx.consts[weight_name].T
+            ctx.arg_params[new] = nd.array(ctx.consts[new])
+        weight_name = in_names[1] = new
     weight = ctx.consts.get(weight_name)
     ins = [ctx.sym(i) for i in in_names]
     return sym_mod.FullyConnected(

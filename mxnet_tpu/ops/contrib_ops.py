@@ -223,8 +223,9 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchors):
 
 @register("_contrib_box_nms")
 def _box_nms(attrs, data):
-    """NMS over (..., N, K>=6) [id, score, x1,y1,x2,y2] (bounding_box.cc).
-    Suppressed entries get id=-1."""
+    """NMS over (..., N, K>=6) [id, score, x1,y1,x2,y2] (bounding_box.cc:
+    output sorted by score descending, surviving boxes first, suppressed
+    rows filled entirely with -1 and compacted to the end)."""
     import jax
     jnp = _jnp()
     thresh = float(attrs.get("overlap_thresh", 0.5))
@@ -244,7 +245,6 @@ def _box_nms(attrs, data):
         valid = score > valid_thresh
         order = jnp.argsort(-score)
         s = sample[order]
-        score_s = score[order]
         ids_s = ids[order]
         boxes_s = boxes[order]
         valid_s = valid[order]
@@ -258,7 +258,10 @@ def _box_nms(attrs, data):
             return keep.at[i].set(keep[i] & ~jnp.any(sup[:, i] & tri[:, i] & keep))
 
         keep = jax.lax.fori_loop(0, N, body, valid_s)
-        out = s.at[:, id_index].set(jnp.where(keep, ids_s, -1.0))
+        # survivors first (score order already), suppressed rows all -1 at
+        # the end — argsort of ~keep is stable, preserving score order
+        compact = jnp.argsort(~keep, stable=True)
+        out = jnp.where(keep[compact, None], s[compact], -1.0)
         return out
 
     out = jax.vmap(per)(flat)

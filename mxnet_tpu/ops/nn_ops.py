@@ -998,10 +998,15 @@ def _bilinear_sampler(attrs, data, grid):
     wy = gy - y0
 
     def gather(xi, yi):
-        xi = jnp.clip(xi, 0, w - 1)
-        yi = jnp.clip(yi, 0, h - 1)
+        # out-boundary corners contribute ZERO, not a clamped edge value
+        # (bilinear_sampler.cc:61-67 guards each corner with between();
+        # docstring: "out-boundary points will be padded with zeros")
+        inb = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+        xc = jnp.clip(xi, 0, w - 1)
+        yc = jnp.clip(yi, 0, h - 1)
         bidx = jnp.arange(n).reshape(n, 1, 1)
-        return data[bidx, :, yi, xi]  # (n, Ho, Wo, c)
+        vals = data[bidx, :, yc, xc]  # (n, Ho, Wo, c)
+        return vals * inb[..., None].astype(vals.dtype)
 
     v00 = gather(x0, y0)
     v01 = gather(x1, y0)

@@ -38,10 +38,12 @@ def test_box_nms():
                        [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # overlaps first
                        [0, 0.7, 2.0, 2.0, 3.0, 3.0]]])   # separate
     out = invoke("_contrib_box_nms", [boxes], {"overlap_thresh": 0.5})
-    ids = out.asnumpy()[0, :, 0]
-    assert ids[0] == 0          # best kept
-    assert ids[1] == -1         # suppressed
-    assert ids[2] == 0          # kept (no overlap)
+    o = out.asnumpy()[0]
+    # reference contract (bounding_box.cc:40-43): score-descending,
+    # survivors first, suppressed rows entirely -1 at the end
+    assert o[0, 1] == np.float32(0.9)   # best kept
+    assert o[1, 1] == np.float32(0.7)   # non-overlapping kept, compacted up
+    assert (o[2] == -1).all()           # suppressed row filled with -1
 
 
 def test_multibox_target_detection_roundtrip():

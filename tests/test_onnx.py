@@ -609,3 +609,21 @@ def test_onnx_gemm_alpha_beta_and_shared_weight(tmp_path):
     got = _forward(sym, args, aux, x)
     want = (2.0 * x @ W.T + 0.5 * b) + (x @ W.T + b)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_onnx_gemm_transb0_shares_weight_with_matmul(tmp_path):
+    """Gemm(transB=0) must transpose into a CLONE: the same initializer
+    also feeds a MatMul, which must see the ORIGINAL layout."""
+    rng = np.random.RandomState(10)
+    x = rng.uniform(-1, 1, (2, 3)).astype(np.float32)
+    W = rng.uniform(-1, 1, (3, 4)).astype(np.float32)  # transB=0 layout
+    b = np.zeros((4,), np.float32)
+    nodes = [
+        _onnx_node("Gemm", ["data", "W", "b"], ["g"]),  # transB=0 default
+        _onnx_node("MatMul", ["data", "W"], ["m"]),
+        _onnx_node("Add", ["g", "m"], ["out"]),
+    ]
+    sym, args, aux = _import_graph(tmp_path, nodes, x.shape, "out",
+                                   initializers={"W": W, "b": b})
+    got = _forward(sym, args, aux, x)
+    np.testing.assert_allclose(got, 2 * (x @ W), rtol=1e-5, atol=1e-5)

@@ -538,3 +538,37 @@ def test_avg_pooling_full_convention_clipped_divisor():
         assert got.shape == want.shape, (k, s, p, got.shape, want.shape)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
                                    err_msg=str((k, s, p)))
+
+
+def test_box_nms_matches_reference_docstring_example():
+    """box_nms output contract (bounding_box.cc:70-77's own example):
+    sorted by score descending, survivors first, suppressed rows filled
+    entirely with -1 at the end."""
+    x = np.array([[0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                  [1, 0.4, 0.1, 0.1, 0.2, 0.2],
+                  [0, 0.3, 0.1, 0.1, 0.14, 0.14],
+                  [2, 0.6, 0.5, 0.5, 0.7, 0.8]], np.float32)
+    out = nd._contrib_box_nms(nd.array(x), overlap_thresh=0.1,
+                              coord_start=2, score_index=1, id_index=0,
+                              force_suppress=True).asnumpy()
+    want = np.array([[2, 0.6, 0.5, 0.5, 0.7, 0.8],
+                     [0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                     [-1, -1, -1, -1, -1, -1],
+                     [-1, -1, -1, -1, -1, -1]], np.float32)
+    np.testing.assert_allclose(out, want)
+
+
+def test_bilinear_sampler_zero_pads_out_of_boundary():
+    """Out-boundary sample points are ZERO, and partially-outside lerps
+    keep only the in-bounds corners' shares (bilinear_sampler.cc:61-67;
+    clamping to the edge value was a real divergence this pins)."""
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    grid = np.zeros((1, 2, 2, 2), np.float32)
+    grid[0, 0] = [[-2.0, 0.0], [0.5, 2.0]]
+    grid[0, 1] = [[0.0, 0.0], [0.5, 0.0]]
+    out = nd.BilinearSampler(data, nd.array(grid)).asnumpy().ravel()
+    np.testing.assert_allclose(out, [0.0, 7.5, 11.25, 0.0], atol=1e-6)
+    grid2 = np.zeros((1, 2, 1, 1), np.float32)
+    grid2[0, 0] = [[1.1]]
+    out2 = nd.BilinearSampler(data, nd.array(grid2)).asnumpy().ravel()
+    np.testing.assert_allclose(out2, [7.65], atol=1e-5)
