@@ -347,3 +347,32 @@ def test_container_override_pushes_down_original_dict_only():
     assert "r_w" not in left._params._params
     # while the container itself aggregates everything
     assert {"l_w", "r_w", "stack_shared"} <= set(stack._params._params)
+
+
+def test_fused_bidirectional_matches_unfused():
+    """Bidirectional 2-layer fused LSTM == its unfuse() stack — the
+    weight/state interleave across directions is the classic divergence
+    spot (cudnn_rnn weight packing in the reference)."""
+    T, N, I, H = 4, 2, 3, 5
+    rng = np.random.RandomState(42)
+    x = rng.uniform(-1, 1, (N, T, I)).astype(np.float32)
+    fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode="lstm",
+                                bidirectional=True, prefix="bi_")
+    fo, _ = fused.unroll(T, sym.Variable("data"), merge_outputs=True)
+    fexe = fo.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    for name, arr in fexe.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.uniform(-0.5, 0.5, arr.shape)
+    fexe.arg_dict["data"][:] = x
+    fused_out = fexe.forward()[0].asnumpy()
+
+    stack = fused.unfuse()
+    uo, _ = stack.unroll(T, sym.Variable("data"), merge_outputs=True)
+    uexe = uo.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    unpacked = fused.unpack_weights({k: v for k, v in fexe.arg_dict.items()
+                                     if k != "data"})
+    repacked = stack.pack_weights(unpacked)
+    for name, arr in uexe.arg_dict.items():
+        arr[:] = x if name == "data" else repacked[name]
+    unfused_out = uexe.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, rtol=1e-4, atol=1e-5)
