@@ -407,6 +407,7 @@ def _measure_transformer(device_kind):
         "config": {"batch": B, "seq": T, "dim": dim, "depth": depth,
                    "vocab": vocab},
         "mode": MODE,
+        "data": "synthetic on-device",
     }), flush=True)
 
 
@@ -438,6 +439,11 @@ def _emit(results, device_kind):
         "layouts": {l: round(r["imgs_per_sec"], 2)
                     for l, r in results.items()},
         "mode": MODE,
+        # disclosure (VERDICT r4): the timed step consumes a pre-staged
+        # on-device batch — this measures kernel/step throughput (MFU),
+        # not the host input pipeline
+        "data": "synthetic on-device",
+        "sync": "host-fetch of final data-dependent step inside timed window",
     }), flush=True)
 
 

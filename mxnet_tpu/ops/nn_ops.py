@@ -436,24 +436,46 @@ def _softmax_activation(attrs, data):
 @register("SoftmaxOutput")
 def _softmax_output(attrs, data, label):
     """Softmax forward with implicit cross-entropy backward
-    (src/operator/softmax_output.cc): grad(data) = softmax - one_hot(label).
-    Implemented as a jax.custom_vjp so the tape's jax.vjp picks up the
-    reference's gradient semantics (incl. ignore_label / normalization)."""
+    (src/operator/softmax_output-inl.h).  Implemented as a jax.custom_vjp so
+    the tape's jax.vjp picks up the reference's gradient semantics.
+
+    The reference backward has three branches (softmax_output-inl.h:150-262),
+    all reproduced here:
+      1. label.shape == out.shape (soft/probability label, :150-161):
+         grad = (out - label) * grad_scale, no normalization division.
+      2. multi_output (:162-206): softmax along axis 1 over (n, k, s);
+         grad = (out - one_hot) * grad_scale / divisor where divisor is
+         s (null), s*n (batch), or #non-ignored-labels (valid, clamped >=1
+         and counted regardless of use_ignore, exactly like the reference's
+         workspace loop at :181-196).
+      3. hard label (:207-258): softmax over the flattened class axis;
+         smooth_alpha label smoothing (mshadow SmoothSoftmaxGrad: the
+         smoothed target is (1-alpha) at the gold class and alpha/(k-1)
+         elsewhere), then grad_scale / valid_cnt with valid_cnt = 1 (null),
+         #labels (batch), or #non-ignored (valid).
+    All branches honor out_grad=True (:156,202,253): multiply elementwise by
+    the incoming head gradient.  Forward is shape-preserving — the
+    reference's 2-D/3-D flattening is a TBlob *view*, so out.shape always
+    equals data.shape; preserve_shape softmaxes the LAST axis (:121-124)."""
     import jax
     jnp = _jnp()
     grad_scale = float(attrs.get("grad_scale", 1.0))
-    ignore_label = attrs.get("ignore_label")
+    ignore_label = float(attrs.get("ignore_label", -1.0))
     use_ignore = bool(attrs.get("use_ignore", False))
     multi_output = bool(attrs.get("multi_output", False))
     normalization = attrs.get("normalization", "null")
     preserve_shape = bool(attrs.get("preserve_shape", False))
-    axis = 1 if (multi_output or preserve_shape) else -1
+    use_out_grad = bool(attrs.get("out_grad", False))
+    smooth_alpha = float(attrs.get("smooth_alpha", 0.0))
 
     @jax.custom_vjp
     def f(d, l):
-        if not multi_output and not preserve_shape and d.ndim > 2:
-            d = d.reshape(d.shape[0], -1)
-        return jax.nn.softmax(d, axis=axis)
+        if multi_output:
+            return jax.nn.softmax(d, axis=1)
+        if preserve_shape or d.ndim <= 2:
+            return jax.nn.softmax(d, axis=-1)
+        n = d.shape[0]
+        return jax.nn.softmax(d.reshape(n, -1), axis=-1).reshape(d.shape)
 
     def f_fwd(d, l):
         out = f(d, l)
@@ -461,23 +483,69 @@ def _softmax_output(attrs, data, label):
 
     def f_bwd(res, g):
         out, l = res
-        nclass = out.shape[axis]
-        oh = jax.nn.one_hot(l.astype(jnp.int32), nclass, axis=axis)
-        grad = out - oh
-        scale = grad_scale
-        if use_ignore and ignore_label is not None:
-            mask = (l != ignore_label).astype(out.dtype)
-            mask = jnp.expand_dims(mask, axis) if mask.ndim < out.ndim else mask
-            grad = grad * mask
+        dtype = out.dtype
+
+        # branch 1: probability-shaped label (soft targets)
+        if l.shape == out.shape:
+            grad = (out - l.astype(dtype)) * dtype.type(grad_scale)
+            if use_out_grad:
+                grad = grad * g
+            return grad.astype(dtype), None
+
+        if multi_output:
+            # (n, k, s) view: softmax axis 1, one label per spatial position
+            n, k = out.shape[0], out.shape[1]
+            s = int(_np.prod(out.shape[2:])) if out.ndim > 2 else 1
+            out3 = out.reshape(n, k, s)
+            l2 = l.reshape(n, s)
+            oh = jax.nn.one_hot(l2.astype(jnp.int32), k, axis=1, dtype=dtype)
+            grad = out3 - oh
+            if use_ignore:
+                # reference SoftmaxGrad compares static_cast<int>(label) ==
+                # static_cast<int>(ignore_label) — int-cast so the mask and
+                # the 'valid' divisor below can never disagree
+                keep = (l2.astype(jnp.int32)
+                        != int(ignore_label)).astype(dtype)
+                grad = grad * keep[:, None, :]
+            if normalization == "batch":
+                grad = grad * dtype.type(grad_scale / (s * n))
+            elif normalization == "valid":
+                valid = jnp.maximum(
+                    jnp.sum(l2.astype(jnp.int32) != int(ignore_label)), 1)
+                grad = grad * (grad_scale / valid.astype(dtype))
+            else:  # null
+                grad = grad * dtype.type(grad_scale / s)
+            if use_out_grad:
+                grad = grad * g.reshape(n, k, s)
+            return grad.reshape(out.shape).astype(dtype), None
+
+        # branch 3: hard label over the flattened class axis
+        if preserve_shape:
+            out2 = out.reshape(-1, out.shape[-1])
+        else:
+            out2 = out.reshape(out.shape[0], -1)
+        k = out2.shape[1]
+        lf = l.reshape(-1)
+        oh = jax.nn.one_hot(lf.astype(jnp.int32), k, dtype=dtype)
+        target = oh
+        if smooth_alpha > 0.0:
+            target = (oh * dtype.type(1.0 - smooth_alpha)
+                      + (1.0 - oh) * dtype.type(smooth_alpha / max(k - 1, 1)))
+        grad = out2 - target
+        if use_ignore:
+            keep = (lf.astype(jnp.int32) != int(ignore_label)).astype(dtype)
+            grad = grad * keep[:, None]
         if normalization == "batch":
-            grad = grad / out.shape[0]
+            grad = grad * dtype.type(grad_scale / lf.shape[0])
         elif normalization == "valid":
-            if use_ignore and ignore_label is not None:
-                valid = jnp.maximum(jnp.sum(l != ignore_label), 1)
-            else:
-                valid = l.size
-            grad = grad / valid
-        return (scale * grad).astype(out.dtype), None
+            valid = jnp.maximum(
+                jnp.sum(lf.astype(jnp.int32) != int(ignore_label)), 1)
+            grad = grad * (grad_scale / valid.astype(dtype))
+        else:  # null
+            grad = grad * dtype.type(grad_scale)
+        if use_out_grad:
+            grad = grad * g.reshape(out2.shape)
+        return grad.reshape(out.shape).astype(dtype), None
 
     f.defvjp(f_fwd, f_bwd)
     return f(data, label)

