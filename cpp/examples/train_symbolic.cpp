@@ -1,0 +1,169 @@
+// Symbolic-bind training from C++ — the round-5 slice of the reference's
+// cpp-package Executor flow (reference: cpp-package/example/mlp.cpp binds
+// a Symbol with MXExecutorBind and drives MXExecutorForward/Backward;
+// c_api_symbolic.cc + c_api_executor.cc).
+//
+// Loads a symbol JSON SAVED FROM PYTHON (argv[1]) — the deployment shape:
+// the graph is authored once in the Python frontend, exported, and a
+// C++ host trains it with no Python source at the call site.
+//
+//   ./train_symbolic <path/to/symbol.json>
+//
+// Prints step-0 loss and a step-0 gradient checksum at full precision so
+// the test harness can assert the trajectory against the Python executor
+// on the SAME deterministic init/data (both sides run the identical LCG
+// below), then trains to convergence and exits 0 iff accuracy > 0.9.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu.hpp"
+
+using mxtpu::Executor;
+using mxtpu::NDArray;
+using mxtpu::Symbol;
+
+namespace {
+
+// Cross-language deterministic generator: integer LCG, float division —
+// every operation exact, so Python reproduces the stream bit-for-bit.
+struct LCG {
+  uint64_t s;
+  explicit LCG(uint64_t seed) : s(seed) {}
+  float uniform() {  // [0, 1)
+    s = s * 6364136223846793005ULL + 1442695040888963407ULL;
+    return static_cast<float>((s >> 33) & 0xFFFFFF) /
+           static_cast<float>(0x1000000);
+  }
+};
+
+// In-place w <- sgd_update(w, g): the out handle IS the weight handle, so
+// the executor's bound argument advances (same pattern as mxtpu::SGD).
+void SgdStep(NDArray &w, NDArray &g, float lr, float rescale) {
+  AtomicSymbolCreator creator;
+  mxtpu::Check(NNGetOpHandle("sgd_update", &creator));
+  NDArrayHandle ins[2] = {w.handle(), g.handle()};
+  NDArrayHandle outs[1] = {w.handle()};
+  NDArrayHandle *pout = outs;
+  int n_out = 1;
+  std::string lrs = std::to_string(lr), rs = std::to_string(rescale);
+  const char *keys[3] = {"lr", "wd", "rescale_grad"};
+  const char *vals[3] = {lrs.c_str(), "0", rs.c_str()};
+  mxtpu::Check(
+      MXImperativeInvoke(creator, 2, ins, &n_out, &pout, 3, keys, vals));
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <symbol.json>\n", argv[0]);
+    return 2;
+  }
+  try {
+    const int N = 256, C = 2, EPOCHS = 200;
+    Symbol sym = Symbol::FromFile(argv[1]);
+
+    // synthetic task: label = [x0^2 + x1 > 0.3] — a parabolic boundary a
+    // linear model cannot fit.  Separate statements keep the float math
+    // contraction-free so numpy float32 reproduces it exactly.
+    LCG gen(2026);
+    std::vector<float> xs, ys;
+    for (int i = 0; i < N; ++i) {
+      float x0 = gen.uniform() * 2.f - 1.f;
+      float x1 = gen.uniform() * 2.f - 1.f;
+      float sq = x0 * x0;
+      float b = sq + x1;
+      xs.push_back(x0);
+      xs.push_back(x1);
+      ys.push_back(b > 0.3f ? 1.f : 0.f);
+    }
+
+    std::vector<std::string> args = sym.ListArguments();
+    auto shapes = sym.InferArgShapes(
+        {{"data", {static_cast<mx_uint>(N), 2}},
+         {"softmax_label", {static_cast<mx_uint>(N)}}});
+
+    std::vector<NDArray> in_args, grads;
+    std::vector<mx_uint> reqs;
+    LCG wgen(7);
+    for (size_t i = 0; i < args.size(); ++i) {
+      const std::vector<mx_uint> &shp = shapes[i];
+      if (shp.empty()) throw mxtpu::Error("unresolved shape: " + args[i]);
+      size_t sz = 1;
+      for (mx_uint d : shp) sz *= d;
+      std::vector<float> vals(sz, 0.f);
+      bool trainable = false;
+      if (args[i] == "data") {
+        vals = xs;
+      } else if (args[i] == "softmax_label") {
+        vals = ys;
+      } else {
+        trainable = true;
+        if (args[i].find("bias") == std::string::npos) {
+          for (float &v : vals) v = (wgen.uniform() * 2.f - 1.f) * 0.5f;
+        }
+      }
+      in_args.emplace_back(shp, vals);
+      if (trainable) {
+        grads.emplace_back(shp, mxtpu::kFloat32);
+        reqs.push_back(mxtpu::kWriteTo);
+      } else {
+        grads.emplace_back();  // invalid handle = no grad kept
+        reqs.push_back(mxtpu::kNullOp);
+      }
+    }
+
+    Executor exe(sym, std::move(in_args), std::move(grads), reqs);
+
+    const float lr = 0.5f;
+    for (int e = 0; e < EPOCHS; ++e) {
+      exe.Forward(/*is_train=*/true);
+      exe.Backward();
+      if (e == 0) {
+        // parity probes for the test harness (python reruns this exact
+        // step through its own executor on the same LCG numbers)
+        std::vector<float> p = exe.Outputs()[0].ToVector();
+        double loss = 0;
+        for (int i = 0; i < N; ++i) {
+          loss -= std::log(static_cast<double>(
+              p[i * C + static_cast<int>(ys[i])]) + 1e-12);
+        }
+        double checksum = 0;
+        for (size_t i = 0; i < args.size(); ++i) {
+          if (reqs[i] != mxtpu::kWriteTo) continue;
+          for (float g : exe.Grad(i).ToVector()) {
+            checksum += static_cast<double>(g);
+          }
+        }
+        std::printf("STEP0 loss %.9g gradsum %.9g\n", loss / N, checksum);
+      }
+      for (size_t i = 0; i < args.size(); ++i) {
+        if (reqs[i] != mxtpu::kWriteTo) continue;
+        SgdStep(exe.Arg(i), exe.Grad(i), lr, 1.f / N);
+      }
+    }
+
+    exe.Forward(/*is_train=*/false);
+    std::vector<float> p = exe.Outputs()[0].ToVector();
+    int correct = 0;
+    for (int i = 0; i < N; ++i) {
+      int pred = p[i * C] >= p[i * C + 1] ? 0 : 1;
+      if (pred == static_cast<int>(ys[i])) ++correct;
+    }
+    float acc = static_cast<float>(correct) / N;
+    std::printf("final accuracy %.4f\n", acc);
+    if (acc <= 0.9f) {
+      std::fprintf(stderr, "FAIL: accuracy %.4f <= 0.9\n", acc);
+      return 1;
+    }
+    std::printf("TRAIN_SYMBOLIC OK\n");
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

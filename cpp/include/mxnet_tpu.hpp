@@ -229,6 +229,138 @@ class SGD {
   float lr_, wd_, rescale_;
 };
 
+// Symbolic graph + bound executor (reference: mxnet-cpp/symbol.hpp and
+// executor.hpp over c_api_symbolic.cc / c_api_executor.cc).  Loads a
+// SAVED symbol JSON — the round-5 slice deliberately covers the
+// load-and-run path (the one a deployment frontend needs), not symbol
+// COMPOSITION, which stays a Python-side authoring concern.
+class Symbol {
+ public:
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromFile(const std::string &path) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromFile(path.c_str(), &h));
+    return Symbol(h);
+  }
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+  ~Symbol() {
+    if (h_ != nullptr) MXSymbolFree(h_);
+  }
+  Symbol(const Symbol &) = delete;
+  Symbol &operator=(const Symbol &) = delete;
+  Symbol(Symbol &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+
+  SymbolHandle handle() const { return h_; }
+
+  std::string ToJSON() const {
+    const char *js = nullptr;
+    Check(MXSymbolSaveToJSON(h_, &js));
+    return js;
+  }
+  std::vector<std::string> ListArguments() const {
+    return StrList(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrList(&MXSymbolListAuxiliaryStates);
+  }
+
+  // Full shape inference from named input shapes; returns shapes for every
+  // argument in ListArguments order (empty = unresolved).
+  std::vector<std::vector<mx_uint>> InferArgShapes(
+      const std::vector<std::pair<std::string, std::vector<mx_uint>>>
+          &named_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0}, data;
+    for (const auto &kv : named_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_sz = 0, out_sz = 0, aux_sz = 0;
+    const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+    const mx_uint **in_d = nullptr, **out_d = nullptr, **aux_d = nullptr;
+    int complete = 0;
+    Check(MXSymbolInferShape(h_, static_cast<mx_uint>(keys.size()),
+                             keys.data(), indptr.data(), data.data(), &in_sz,
+                             &in_nd, &in_d, &out_sz, &out_nd, &out_d,
+                             &aux_sz, &aux_nd, &aux_d, &complete));
+    std::vector<std::vector<mx_uint>> out;
+    out.reserve(in_sz);
+    for (mx_uint i = 0; i < in_sz; ++i) {
+      out.emplace_back(in_d[i], in_d[i] + in_nd[i]);
+    }
+    return out;
+  }
+
+ private:
+  using ListFn = int (*)(SymbolHandle, mx_uint *, const char ***);
+  std::vector<std::string> StrList(ListFn fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(fn(h_, &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  SymbolHandle h_ = nullptr;
+};
+
+enum GradReq { kNullOp = 0, kWriteTo = 1, kAddTo = 3 };
+
+class Executor {
+ public:
+  // in_args / arg_grads / grad_reqs are positional over
+  // Symbol::ListArguments order; pass an invalid NDArray in arg_grads for
+  // arguments whose gradient the caller does not keep.
+  Executor(const Symbol &sym, std::vector<NDArray> in_args,
+           std::vector<NDArray> arg_grads, const std::vector<mx_uint> &reqs,
+           std::vector<NDArray> aux = {}, int dev_type = 1, int dev_id = 0)
+      : args_(std::move(in_args)), grads_(std::move(arg_grads)),
+        aux_(std::move(aux)) {
+    std::vector<NDArrayHandle> ah, gh, xh;
+    for (auto &a : args_) ah.push_back(a.handle());
+    for (auto &g : grads_) gh.push_back(g.valid() ? g.handle() : nullptr);
+    for (auto &x : aux_) xh.push_back(x.handle());
+    std::vector<mx_uint> r = reqs;
+    Check(MXExecutorBind(sym.handle(), dev_type, dev_id,
+                         static_cast<mx_uint>(ah.size()), ah.data(),
+                         gh.data(), r.data(),
+                         static_cast<mx_uint>(xh.size()),
+                         xh.empty() ? nullptr : xh.data(), &h_));
+  }
+  ~Executor() {
+    if (h_ != nullptr) MXExecutorFree(h_);
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+
+  void Forward(bool is_train) { Check(MXExecutorForward(h_, is_train)); }
+  void Backward() { Check(MXExecutorBackward(h_, 0, nullptr)); }
+
+  // Outputs as fresh owned handles (safe past the next ABI call).
+  std::vector<NDArray> Outputs() {
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXExecutorOutputs(h_, &n, &outs));
+    std::vector<NDArray> result;
+    result.reserve(n);
+    for (mx_uint i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  NDArray &Arg(size_t i) { return args_[i]; }
+  NDArray &Grad(size_t i) { return grads_[i]; }
+
+ private:
+  std::vector<NDArray> args_, grads_, aux_;
+  ExecutorHandle h_ = nullptr;
+};
+
 // Deployment-side inference over the MXPred* ABI (reference:
 // include/mxnet/c_predict_api.h as used by example/image-classification's
 // predict-cpp).  Float32 IO; one input name per SetInput call.

@@ -91,6 +91,54 @@ MXTPU_DLL int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
                               const char **keys, NDArrayHandle *vals,
                               int priority);
 
+// Symbol + Executor slice (reference src/c_api/c_api_symbolic.cc and
+// c_api_executor.cc subset): load a saved symbol JSON, inspect argument/
+// output/aux lists, infer shapes, bind an executor over caller-owned
+// NDArrays, and drive forward/backward — the path a non-Python frontend
+// needs to run a saved TRAINING graph, not just MXPred inference.
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+MXTPU_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXTPU_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+MXTPU_DLL int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+MXTPU_DLL int MXSymbolFree(SymbolHandle symbol);
+MXTPU_DLL int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                                    const char ***out_str_array);
+MXTPU_DLL int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                                  const char ***out_str_array);
+MXTPU_DLL int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
+                                          mx_uint *out_size,
+                                          const char ***out_str_array);
+// Shapes arrive CSR-style keyed by argument name (same convention as the
+// reference): arg_ind_ptr has num_args+1 entries delimiting each named
+// input's span in arg_shape_data.  Unknown result shapes have ndim 0;
+// *complete is 1 iff every arg/out/aux shape resolved.
+MXTPU_DLL int MXSymbolInferShape(
+    SymbolHandle symbol, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data,
+    mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+    const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+// in_args/arg_grad_store/grad_req_type are positional over
+// MXSymbolListArguments order; aux_states over ListAuxiliaryStates order.
+// grad_req_type uses the reference OpReqType codes: 0 null, 1 write,
+// 2 write-inplace (treated as write), 3 add.  A null arg_grad_store
+// entry means no caller-held gradient buffer for that argument.
+MXTPU_DLL int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
+                             mx_uint len, NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states, ExecutorHandle *out);
+MXTPU_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
+MXTPU_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads);
+MXTPU_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXTPU_DLL int MXExecutorFree(ExecutorHandle handle);
+
 // Predict ABI (reference include/mxnet/c_predict_api.h, implementation
 // src/c_api/c_predict_api.cc): standalone float32 inference from symbol
 // JSON + binary .params blob, no Python source at the call site.  Input

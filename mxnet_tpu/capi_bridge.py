@@ -300,3 +300,97 @@ def pred_get_output(pred, index, addr, n_elems):
         # caller's float32 buffer for wider dtypes
         out = out.astype("float32")
     return copy_to_addr(out, addr, n_elems)
+
+
+# ---------------------------------------------------------------------------
+# Symbol / Executor slice (reference src/c_api/c_api_symbolic.cc and
+# c_api_executor.cc subset): lets a non-Python frontend load a saved
+# symbol JSON, inspect its argument lists, infer shapes, bind a training
+# executor over caller-owned NDArrays, and drive forward/backward.
+# ---------------------------------------------------------------------------
+
+def sym_load_json(json_str):
+    from . import symbol
+    return symbol.load_json(str(json_str))
+
+
+def sym_load_file(path):
+    with open(str(path)) as f:
+        return sym_load_json(f.read())
+
+
+def sym_tojson(sym):
+    return sym.tojson()
+
+
+def sym_list_arguments(sym):
+    return [str(s) for s in sym.list_arguments()]
+
+
+def sym_list_outputs(sym):
+    return [str(s) for s in sym.list_outputs()]
+
+
+def sym_list_aux(sym):
+    return [str(s) for s in sym.list_auxiliary_states()]
+
+
+def sym_infer_shape(sym, keys, shapes):
+    """Returns (complete, arg_shapes, out_shapes, aux_shapes); shapes are
+    tuples (empty tuple = unknown, the reference's 0-dim TShape)."""
+    kwargs = {str(k): tuple(int(d) for d in s)
+              for k, s in zip(keys, shapes)}
+    arg_s, out_s, aux_s = sym.infer_shape_partial(**kwargs)
+
+    def norm(group, names):
+        group = list(group) if group is not None else [None] * len(names)
+        return [tuple(s) if s is not None else () for s in group]
+
+    arg_names = sym.list_arguments()
+    out_names = sym.list_outputs()
+    aux_names = sym.list_auxiliary_states()
+    arg_s = norm(arg_s, arg_names)
+    out_s = norm(out_s, out_names)
+    aux_s = norm(aux_s, aux_names)
+    complete = all(len(s) > 0 for s in arg_s + out_s + aux_s) \
+        or (not arg_s and not out_s)
+    return (bool(complete), arg_s, out_s, aux_s)
+
+
+_GRAD_REQ_CODES = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def exec_bind(sym, dev_type, dev_id, in_args, arg_grads, grad_reqs, aux):
+    """MXExecutorBind analog: positional in_args/arg_grads/grad_reqs match
+    list_arguments() order, aux matches list_auxiliary_states() order.
+    grad_reqs uses the reference OpReqType codes (0 null, 1 write,
+    2 write-inplace -> write, 3 add)."""
+    ctx = _ctx(dev_type, dev_id)
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    if len(in_args) != len(arg_names):
+        raise ValueError("MXExecutorBind: %d in_args for %d arguments %s"
+                         % (len(in_args), len(arg_names), arg_names))
+    if len(aux) != len(aux_names):
+        raise ValueError("MXExecutorBind: %d aux states for %d aux names %s"
+                         % (len(aux), len(aux_names), aux_names))
+    args = dict(zip(arg_names, in_args))
+    req = {n: _GRAD_REQ_CODES.get(int(r), "write")
+           for n, r in zip(arg_names, grad_reqs)}
+    grads = {n: g for n, g in zip(arg_names, arg_grads) if g is not None}
+    return sym.bind(ctx, args=args, args_grad=grads or None,
+                    grad_req=req, aux_states=dict(zip(aux_names, aux)))
+
+
+def exec_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+    return 0
+
+
+def exec_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+    return 0
+
+
+def exec_outputs(exe):
+    return list(exe.outputs)

@@ -81,6 +81,12 @@ class _ImportContext:
         self.consts = {}      # initializer name -> numpy (for shape reads)
         self.arg_params = {}
         self.aux_params = {}
+        # initializer names consumed as STATIC operands (Reshape shape,
+        # Slice starts, ...).  Dropped from arg_params only at the end of
+        # the import, and only if no node also consumed them as a tensor
+        # input — popping eagerly lost the param when it was shared
+        # (round-4 advisor finding).
+        self.static_operands = set()
 
     def sym(self, name):
         from ... import symbol as sym_mod
@@ -310,7 +316,7 @@ def _import_reshape(ctx, node, a, sym_mod):
     shape = ctx.consts.get(node.input[1])
     if shape is None:
         raise NotImplementedError("Reshape with dynamic shape input")
-    ctx.arg_params.pop(node.input[1], None)
+    ctx.static_operands.add(node.input[1])
     return sym_mod.Reshape(ctx.sym(node.input[0]),
                            shape=tuple(int(s) for s in shape),
                            name=node.name or node.output[0])
@@ -390,7 +396,7 @@ def _const_operand(ctx, node, i, what):
         raise NotImplementedError(
             "%s with dynamic %s input (must be an initializer)"
             % (node.op_type, what))
-    ctx.arg_params.pop(name, None)
+    ctx.static_operands.add(name)
     return arr
 
 
@@ -686,7 +692,12 @@ def import_model(model_file):
 
     outputs = [ctx.values[vi.name] for vi in graph.output]
     sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
-    # params that were consumed as attrs (reshape targets) are already popped
+    # drop initializers that were folded into static attrs — UNLESS some
+    # node also consumed the same initializer as a tensor input (then it
+    # is a live Variable in ctx.values and the executor must bind it)
+    for name in ctx.static_operands:
+        if name not in ctx.values:
+            ctx.arg_params.pop(name, None)
     return sym, ctx.arg_params, ctx.aux_params
 
 

@@ -82,7 +82,7 @@ class Executor:
         arg_order = {n: i for i, n in enumerate(self.arg_names)}
         aux_order = {n: i for i, n in enumerate(self.aux_names)}
         rng_nodes = [n for n in nodes
-                     if n.op is not None and get_op(n.op).needs_rng]
+                     if n.op is not None and get_op(n.op).rng_for(n.attrs)]
         rng_index = {id(n): i for i, n in enumerate(rng_nodes)}
 
         group2dev = self._group2dev
@@ -101,9 +101,9 @@ class Executor:
                 op = get_op(n.op)
                 attrs = {k: v for k, v in n.attrs.items()
                          if not k.startswith("__") and k != "ctx_group"}
-                if op.mode_dependent:
+                if op.mode_for(attrs):
                     attrs["_training"] = is_train
-                if op.needs_rng:
+                if op.rng_for(attrs):
                     attrs["_rng_key"] = keys[rng_index[id(n)]]
                 in_vals = [env[(id(inp), idx)] for (inp, idx) in n.inputs]
                 if group2dev:

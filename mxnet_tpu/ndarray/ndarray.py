@@ -535,9 +535,9 @@ def _invoke(op_name, inputs, attrs, out=None):
         return sparse_embedding(inputs[0], inputs[1])
     op = get_op(op_name)
     attrs = dict(attrs)
-    if op.mode_dependent:
+    if op.mode_for(attrs):
         attrs["_training"] = bool(autograd.is_training())
-    if op.needs_rng:
+    if op.rng_for(attrs):
         from .. import random as _random
         attrs["_rng_key"] = _random.next_key()
 
@@ -577,7 +577,7 @@ def _invoke(op_name, inputs, attrs, out=None):
         if len(nd_inputs) == len(inputs):
             # rng ops take the key as a trailing tape input so the cached
             # traceable (and its jitted backward) is shared across calls
-            extra = (attrs["_rng_key"],) if op.needs_rng else ()
+            extra = (attrs["_rng_key"],) if "_rng_key" in attrs else ()
             autograd.record_op(op._traceable(attrs), nd_inputs, outputs,
                                name=op_name, extra_input_vals=extra)
 

@@ -44,24 +44,22 @@ def _multibox_prior(attrs, data):
     cy = (jnp.arange(H) + offsets[0]) * step_y
     cx = (jnp.arange(W) + offsets[1]) * step_x
     cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
-    # anchors: first size with each ratio=1? MXNet: sizes[0] with all ratios +
-    # remaining sizes with ratios[0]
-    whs = []
-    for r in ratios:
-        s = sizes[0]
+    # anchor enumeration matches MultiBoxPriorForward (multibox_prior.cc:
+    # 48-88) exactly — cls/loc prediction channels are keyed to this
+    # order, so it is part of the op contract:
+    #   1) every size at ratio 1:          w = s*H/W/2,          h = s/2
+    #   2) ratios[1:] at size sizes[0]:    w = s0*H/W*sqrt(r)/2, h = s0/(2*sqrt(r))
+    # the H/W factor renormalizes width for non-square feature maps so a
+    # "size" is a fraction of the IMAGE HEIGHT in both dimensions.
+    aspect = float(H) / float(W)
+    whs = [(s * aspect / 2, s / 2) for s in sizes]
+    for r in ratios[1:]:
         sr = _np.sqrt(r)
-        whs.append((s * sr, s / sr))
-    for s in sizes[1:]:
-        r = ratios[0]
-        sr = _np.sqrt(r)
-        whs.append((s * sr, s / sr))
+        whs.append((sizes[0] * aspect * sr / 2, sizes[0] / sr / 2))
     boxes = []
-    for (w, h) in whs:
-        xmin = cxg - w / 2
-        ymin = cyg - h / 2
-        xmax = cxg + w / 2
-        ymax = cyg + h / 2
-        boxes.append(jnp.stack([xmin, ymin, xmax, ymax], axis=-1))
+    for (hw, hh) in whs:
+        boxes.append(jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh],
+                               axis=-1))
     out = jnp.stack(boxes, axis=2)  # (H, W, A, 4)
     return out.reshape(1, -1, 4)
 
@@ -117,18 +115,42 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
     anc = anchors[0]  # (A, 4)
 
     def per_sample(lab, pred):
-        valid = lab[:, 0] >= 0
+        from jax import lax
+        # valid gts are the PREFIX before the first class == -1 row
+        # (multibox_target.cc:86-95 breaks at the first -1)
+        cls_col = lab[:, 0]
+        valid = jnp.cumsum((cls_col < 0).astype(jnp.int32)) == 0
+        num_valid = jnp.sum(valid)
         gt_boxes = lab[:, 1:5]
-        iou = _box_iou_xyxy(jnp, anc[:, None, :], gt_boxes[None, :, :])  # (A, M)
-        iou = jnp.where(valid[None, :], iou, 0.0)
-        best_gt = jnp.argmax(iou, axis=1)
-        best_iou = jnp.max(iou, axis=1)
-        matched = best_iou >= iou_thresh
-        # ensure each valid gt gets its best anchor
-        best_anchor = jnp.argmax(iou, axis=0)   # (M,)
-        forced = jnp.zeros((A,), bool).at[best_anchor].set(valid)
-        matched = matched | forced
-        gt = gt_boxes[best_gt]
+        M = gt_boxes.shape[0]
+        iou = _box_iou_xyxy(jnp, anc[:, None, :], gt_boxes[None, :, :])
+        iou_v = jnp.where(valid[None, :], iou, -1.0)  # (A, M)
+
+        # stage 1 (multibox_target.cc:102-139): greedy BIPARTITE match —
+        # repeatedly take the global-max (anchor, gt) pair with IoU>1e-6,
+        # retiring both, so every gt gets a distinct anchor even when two
+        # gts share the same best anchor
+        def body(_, state):
+            anchor_gt, miou = state
+            flat = jnp.argmax(miou)
+            a, g = flat // M, flat % M
+            ok = miou[a, g] > 1e-6
+            anchor_gt = jnp.where(
+                ok, anchor_gt.at[a].set(g.astype(jnp.int32)), anchor_gt)
+            miou = jnp.where(
+                ok, miou.at[a, :].set(-1.0).at[:, g].set(-1.0), miou)
+            return anchor_gt, miou
+
+        anchor_gt, _ = lax.fori_loop(
+            0, M, body, (jnp.full((A,), -1, jnp.int32), iou_v))
+        forced = anchor_gt >= 0
+        # stage 2 (:141-168): remaining anchors match their best gt if IoU
+        # STRICTLY exceeds the threshold
+        best_gt = jnp.argmax(iou_v, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou_v, axis=1)
+        matched = forced | ((best_iou > iou_thresh) & (num_valid > 0))
+        gt_idx = jnp.where(forced, anchor_gt, best_gt)
+        gt = gt_boxes[jnp.clip(gt_idx, 0, M - 1)]
         # encode: (center offset / variance)
         aw = anc[:, 2] - anc[:, 0]
         ah = anc[:, 3] - anc[:, 1]
@@ -146,9 +168,11 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
         loc = jnp.where(matched[:, None], loc, 0.0)
         mask = jnp.where(matched[:, None], 1.0, 0.0)
         mask = jnp.broadcast_to(mask, (A, 4))
-        background = jnp.zeros((A,))
         if mining_ratio > 0:
             # pred: (C+1, A) logits; hardness = low background probability
+            # (multibox_target.cc:180-230).  NOTE: the reference CPU
+            # kernel never reads minimum_negative_samples; honoring the
+            # documented floor here is a deliberate, documented divergence.
             bg_prob = jax.nn.softmax(pred, axis=0)[0]
             eligible = (~matched) & (best_iou < mining_thresh)
             hardness = jnp.where(eligible, bg_prob, jnp.inf)
@@ -159,9 +183,18 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
                 jnp.maximum((num_pos * mining_ratio).astype(jnp.int32),
                             min_negatives),
                 jnp.sum(eligible))
+            num_neg = jnp.where(num_valid > 0, num_neg, 0)
             keep_neg = eligible & (rank < num_neg)
             background = jnp.where(keep_neg, 0.0, ignore_label)
-        cls_t = jnp.where(matched, lab[best_gt, 0] + 1, background)
+        else:
+            # mining off: every unmatched anchor is a negative — but a
+            # sample with NO valid gt is left entirely at ignore_label
+            # (the kernel never runs for it, multibox_target.cc:97)
+            background = jnp.where(num_valid > 0,
+                                   jnp.zeros((A,)),
+                                   jnp.full((A,), ignore_label))
+        cls_t = jnp.where(matched, cls_col[jnp.clip(gt_idx, 0, M - 1)] + 1,
+                          background)
         return loc.reshape(-1), mask.reshape(-1), cls_t
 
     loc_t, loc_m, cls_t = jax.vmap(per_sample)(labels, cls_preds)

@@ -187,13 +187,28 @@ def _pooling(attrs, data):
     window = ((1,) + kernel + (1,)) if channel_last else ((1, 1) + kernel)
     strides = ((1,) + stride + (1,)) if channel_last else ((1, 1) + stride)
     spatial0 = 1 if channel_last else 2
-    if pooling_convention == "full":
-        # ceil-mode: pad right edge so ceil((x+2p-k)/s)+1 windows fit
+    if pooling_convention == "full" or (pooling_convention == "same"
+                                        and nd > 1):
+        # ceil-mode: pad right edge so ceil((x+2p-k)/s)+1 windows fit.
+        # The reference's 2-D/3-D shape inference routes 'same' through
+        # the SAME ceil formula as 'full' (pooling.cc:163-181 else-branch
+        # covers both kFull and kSame); only the 1-D branch gives 'same'
+        # its own formula.
         extra = []
         for i in range(nd):
             x = data.shape[spatial0 + i] + 2 * pad[i] - kernel[i]
             rem = x % stride[i]
             e = 0 if rem == 0 else stride[i] - rem
+            extra.append(e)
+        spads = [(pad[i], pad[i] + extra[i]) for i in range(nd)]
+    elif pooling_convention == "same":
+        # 1-D 'same' (pooling.cc:142-145): ceil((x+2p)/s) windows — pad
+        # the right edge to (O-1)*s + k total extent
+        extra = []
+        for i in range(nd):
+            x = data.shape[spatial0 + i] + 2 * pad[i]
+            n_win = -(-x // stride[i])  # ceil
+            e = max((n_win - 1) * stride[i] + kernel[i] - x, 0)
             extra.append(e)
         spads = [(pad[i], pad[i] + extra[i]) for i in range(nd)]
     else:
@@ -241,18 +256,47 @@ def _pooling(attrs, data):
 
 @register("UpSampling")
 def _upsampling(attrs, *inputs):
+    """src/operator/nn/upsampling-inl.h.  nearest accepts num_args inputs:
+    each is nearest-upsampled to the FIRST input's scaled extent, then
+    channel-concatenated (multi_input_mode='concat', default) or summed
+    (:99-115).  bilinear is NOT an interpolation op — it is a grouped
+    Deconvolution over a real weight input (kernel 2s - s%2, stride s,
+    pad ceil((s-1)/2), num_group = num_filter, no bias; GetDeconvolution-
+    Param :170-188), so the kernel is learnable and is only bilinear
+    interpolation when initialized with init.Bilinear."""
     jnp = _jnp()
     scale = int(attrs["scale"])
     sample_type = attrs.get("sample_type", "nearest")
-    x = inputs[0]
     if sample_type == "nearest":
-        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
-        return out
+        x0 = inputs[0]
+        out_h = x0.shape[2] * scale
+        ups = []
+        for x in inputs:
+            s_i = out_h // x.shape[2]
+            ups.append(jnp.repeat(jnp.repeat(x, s_i, axis=2), s_i, axis=3))
+        if len(ups) == 1:
+            return ups[0]
+        if attrs.get("multi_input_mode") == "sum":
+            out = ups[0]
+            for u in ups[1:]:
+                out = out + u
+            return out
+        return jnp.concatenate(ups, axis=1)
     if sample_type == "bilinear":
-        import jax
-        n, c, h, w = x.shape
-        out = jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
-        return out
+        if len(inputs) < 2:
+            raise ValueError(
+                "UpSampling(sample_type='bilinear') takes (data, weight) — "
+                "the reference implements it as a grouped Deconvolution "
+                "over a learnable kernel (upsampling-inl.h:200-206)")
+        data, weight = inputs[0], inputs[1]
+        kernel = 2 * scale - scale % 2
+        pad = int(_np.ceil((scale - 1) / 2.0))
+        num_filter = int(attrs.get("num_filter", data.shape[1]))
+        return _deconvolution(
+            {"kernel": (kernel, kernel), "stride": (scale, scale),
+             "pad": (pad, pad), "num_group": num_filter,
+             "num_filter": num_filter, "no_bias": True},
+            data, weight)
     raise ValueError(sample_type)
 
 
@@ -372,8 +416,20 @@ def _activation(attrs, data):
     raise ValueError("unknown act_type %s" % act)
 
 
-@register("LeakyReLU")
+def _is_rrelu(attrs):
+    return attrs.get("act_type", "leaky") == "rrelu"
+
+
+# flags are attr predicates: only rrelu draws randomness / depends on the
+# train-predict mode, so leaky/prelu/elu/selu/gelu keep the zero-overhead
+# dispatch (no per-call key split, no train/predict jit-cache doubling)
+@register("LeakyReLU", mode_dependent=_is_rrelu, needs_rng=_is_rrelu)
 def _leaky_relu(attrs, data, gamma=None):
+    """src/operator/leaky_relu-inl.h.  rrelu (:145-176) samples the
+    negative-side slope per ELEMENT from U(lower_bound, upper_bound) in
+    train mode (the randomized-relu of Xu et al.); eval mode uses the
+    deterministic midpoint.  The sampled slope doubles as the backward
+    mask, which jax.vjp reproduces for free through the where()."""
     import jax
     jnp = _jnp()
     act = attrs.get("act_type", "leaky")
@@ -390,9 +446,14 @@ def _leaky_relu(attrs, data, gamma=None):
         return jnp.where(data >= 0, data, g * data)
     if act == "gelu":
         return jax.nn.gelu(data)
-    if act == "rrelu":  # eval-mode deterministic
+    if act == "rrelu":
         lower = float(attrs.get("lower_bound", 0.125))
         upper = float(attrs.get("upper_bound", 0.334))
+        if bool(attrs.get("_training", False)):
+            key = attrs["_rng_key"]
+            sl = jax.random.uniform(key, data.shape, data.dtype,
+                                    minval=lower, maxval=upper)
+            return jnp.where(data >= 0, data, sl * data)
         return jnp.where(data >= 0, data, (lower + upper) / 2 * data)
     raise ValueError("unknown act_type %s" % act)
 

@@ -40,9 +40,13 @@ class Op:
     num_outputs : int or callable(attrs)->int.
     needs_rng : if True, dispatch threads a fresh jax PRNG key through
         ``attrs['_rng_key']`` (the analog of the reference's kRandom resource
-        request, include/mxnet/resource.h:38-66).
+        request, include/mxnet/resource.h:38-66).  May be a callable
+        ``attrs -> bool`` for ops where only some act modes draw randomness
+        (LeakyReLU rrelu) — the common modes then keep zero-overhead
+        dispatch.
     mode_dependent : if True, ``attrs['_training']`` is injected from the
-        autograd train/predict scope (used by dropout/batchnorm).
+        autograd train/predict scope (used by dropout/batchnorm).  May be a
+        callable ``attrs -> bool`` like needs_rng.
     no_jit : skip jit for this op (e.g. ops that return python values).
     """
 
@@ -83,6 +87,16 @@ class Op:
         # (src/common/exec_utils.h SetupDefaultBlobsInOut analog).
         self.fcompute_ex = None
 
+    def rng_for(self, attrs):
+        """Whether THIS call (given its attrs) threads a PRNG key."""
+        f = self.needs_rng
+        return bool(f(attrs)) if callable(f) else bool(f)
+
+    def mode_for(self, attrs):
+        """Whether THIS call (given its attrs) receives ``_training``."""
+        f = self.mode_dependent
+        return bool(f(attrs)) if callable(f) else bool(f)
+
     def input_names(self, attrs):
         spec = self.arg_spec
         if callable(spec):
@@ -115,7 +129,7 @@ class Op:
             from ..autograd import _BWD_JIT_CACHE
             for k in list(self._traceable_cache)[:256]:
                 _BWD_JIT_CACHE.pop(self._traceable_cache.pop(k), None)
-        if self.needs_rng:
+        if self.rng_for(attrs):
             static_attrs = {k: v for k, v in attrs.items() if k != "_rng_key"}
 
             def fn(*arrays_and_key):
@@ -159,7 +173,7 @@ class Op:
             fcompute = self.fcompute
             skip = set(dyn) | {"_rng_key"}
             static_attrs = {k: v for k, v in attrs.items() if k not in skip}
-            if self.needs_rng:
+            if self.rng_for(attrs):
                 def traced(key_arr, *arrs):
                     a = dict(static_attrs)
                     a["_rng_key"] = key_arr
@@ -180,7 +194,7 @@ class Op:
         dyn_vals = tuple(float(attrs[k])
                          if isinstance(attrs[k], (str, bytes)) else attrs[k]
                          for k in dyn)
-        if self.needs_rng:
+        if self.rng_for(attrs):
             return fn(rng_key, *arrays, *dyn_vals)
         return fn(*arrays, *dyn_vals)
 

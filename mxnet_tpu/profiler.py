@@ -49,8 +49,11 @@ def _set_state(state_, fresh):
                 # process that profiles periodically re-emits every prior
                 # session's spans on dump() and grows the buffer unboundedly.
                 # resume() passes fresh=False so a pause/resume cycle keeps
-                # the pre-pause spans.
+                # the pre-pause spans.  The per-op aggregate table resets
+                # with the trace — otherwise dumps() mixes op stats across
+                # sessions unless the caller remembered dumps(reset=True).
                 _events.clear()
+                _agg.clear()
         trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
         try:
             jax.profiler.start_trace(trace_dir)

@@ -413,10 +413,10 @@ def _infer_shapes(sym, specs, partial):
                 shape_env[(id(n), i)] = None
             continue
         attrs = dict(n.attrs)
-        if op.mode_dependent:
+        if op.mode_for(attrs):
             attrs["_training"] = False
         eval_args = list(in_specs)
-        if op.needs_rng:
+        if op.rng_for(attrs):
             # rng traceables take the key as a trailing argument
             eval_args.append(jax.ShapeDtypeStruct((2,), _np.uint32))
         try:

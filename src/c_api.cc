@@ -54,6 +54,10 @@ struct RetStore {
   std::vector<NDArrayHandle> handles;
   std::vector<std::string> strings;
   std::vector<const char *> cstrs;
+  // nested shape groups for MXSymbolInferShape (arg / out / aux)
+  std::vector<std::vector<mx_uint>> group_shapes[3];
+  std::vector<mx_uint> group_ndim[3];
+  std::vector<const mx_uint *> group_ptrs[3];
 };
 thread_local RetStore tls_ret;
 
@@ -741,6 +745,252 @@ MXTPU_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
 }
 
 MXTPU_DLL int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Symbol + Executor slice (reference src/c_api/c_api_symbolic.cc,
+// c_api_executor.cc subset).  A SymbolHandle / ExecutorHandle is an owned
+// PyObject* reference to an mxnet_tpu Symbol / Executor, same lifecycle
+// contract as NDArrayHandle.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Marshal a bridge call returning a list[str] into the thread-local
+// return store (valid until this thread's next API call).
+int return_str_list(PyObject *r, mx_uint *out_size,
+                    const char ***out_array) {
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyList_Size(r);
+  tls_ret.strings.clear();
+  tls_ret.cstrs.clear();
+  tls_ret.strings.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    tls_ret.strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+  }
+  Py_DECREF(r);
+  for (auto &s : tls_ret.strings) tls_ret.cstrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = tls_ret.cstrs.data();
+  return 0;
+}
+
+int sym_str_list(const char *fn, SymbolHandle symbol, mx_uint *out_size,
+                 const char ***out_array) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = bcall(fn, args);
+  Py_DECREF(args);
+  return return_str_list(r, out_size, out_array);
+}
+
+// Unpack one list[tuple[int]] group into slot g of the return store.
+void store_shape_group(PyObject *lst, int g, mx_uint *size,
+                       const mx_uint **ndim, const mx_uint ***data) {
+  Py_ssize_t n = PyList_Size(lst);
+  auto &shapes = tls_ret.group_shapes[g];
+  auto &ndims = tls_ret.group_ndim[g];
+  auto &ptrs = tls_ret.group_ptrs[g];
+  shapes.clear();
+  ndims.clear();
+  ptrs.clear();
+  shapes.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *tup = PyList_GET_ITEM(lst, i);
+    Py_ssize_t nd = PyTuple_Size(tup);
+    for (Py_ssize_t d = 0; d < nd; ++d) {
+      shapes[i].push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(tup, d))));
+    }
+    ndims.push_back(static_cast<mx_uint>(nd));
+  }
+  for (auto &s : shapes) ptrs.push_back(s.data());
+  *size = static_cast<mx_uint>(n);
+  *ndim = ndims.data();
+  *data = ptrs.data();
+}
+
+}  // namespace
+
+MXTPU_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(s)", json);
+  PyObject *r = bcall("sym_load_json", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(s)", fname);
+  PyObject *r = bcall("sym_load_file", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = bcall("sym_tojson", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  tls_ret.strings.clear();
+  tls_ret.strings.emplace_back(PyUnicode_AsUTF8(r));
+  Py_DECREF(r);
+  *out_json = tls_ret.strings.back().c_str();
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolFree(SymbolHandle symbol) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject *>(symbol));
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                                    const char ***out_str_array) {
+  return sym_str_list("sym_list_arguments", symbol, out_size, out_str_array);
+}
+
+MXTPU_DLL int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                                  const char ***out_str_array) {
+  return sym_str_list("sym_list_outputs", symbol, out_size, out_str_array);
+}
+
+MXTPU_DLL int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
+                                          mx_uint *out_size,
+                                          const char ***out_str_array) {
+  return sym_str_list("sym_list_aux", symbol, out_size, out_str_array);
+}
+
+MXTPU_DLL int MXSymbolInferShape(
+    SymbolHandle symbol, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data,
+    mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+    const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  Gil gil;
+  PyObject *pykeys = str_list(static_cast<int>(num_args), keys);
+  PyObject *pyshapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *tup = PyTuple_New(hi - lo);
+    for (mx_uint d = lo; d < hi; ++d) {
+      PyTuple_SET_ITEM(tup, d - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[d]));
+    }
+    PyList_SET_ITEM(pyshapes, i, tup);
+  }
+  PyObject *args =
+      Py_BuildValue("(OOO)", reinterpret_cast<PyObject *>(symbol), pykeys,
+                    pyshapes);
+  Py_DECREF(pykeys);
+  Py_DECREF(pyshapes);
+  PyObject *r = bcall("sym_infer_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  // r = (complete, arg_shapes, out_shapes, aux_shapes)
+  *complete = PyObject_IsTrue(PyTuple_GET_ITEM(r, 0));
+  store_shape_group(PyTuple_GET_ITEM(r, 1), 0, in_shape_size, in_shape_ndim,
+                    in_shape_data);
+  store_shape_group(PyTuple_GET_ITEM(r, 2), 1, out_shape_size,
+                    out_shape_ndim, out_shape_data);
+  store_shape_group(PyTuple_GET_ITEM(r, 3), 2, aux_shape_size,
+                    aux_shape_ndim, aux_shape_data);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
+                             mx_uint len, NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states,
+                             ExecutorHandle *out) {
+  Gil gil;
+  PyObject *pyargs = handle_list(static_cast<int>(len), in_args);
+  PyObject *pygrads = handle_list(static_cast<int>(len), arg_grad_store);
+  PyObject *pyreqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyList_SET_ITEM(pyreqs, i,
+                    PyLong_FromUnsignedLong(
+                        grad_req_type != nullptr ? grad_req_type[i] : 1u));
+  }
+  PyObject *pyaux =
+      handle_list(static_cast<int>(aux_states_len), aux_states);
+  PyObject *args =
+      Py_BuildValue("(OiiOOOO)", reinterpret_cast<PyObject *>(symbol),
+                    dev_type, dev_id, pyargs, pygrads, pyreqs, pyaux);
+  Py_DECREF(pyargs);
+  Py_DECREF(pygrads);
+  Py_DECREF(pyreqs);
+  Py_DECREF(pyaux);
+  PyObject *r = bcall("exec_bind", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  Gil gil;
+  PyObject *args = Py_BuildValue(
+      "(Oi)", reinterpret_cast<PyObject *>(handle), is_train);
+  PyObject *r = bcall("exec_forward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads) {
+  Gil gil;
+  PyObject *pygrads = handle_list(static_cast<int>(len), head_grads);
+  PyObject *args = Py_BuildValue(
+      "(OO)", reinterpret_cast<PyObject *>(handle), pygrads);
+  Py_DECREF(pygrads);
+  PyObject *r = bcall("exec_backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("exec_outputs", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyList_Size(r);
+  tls_ret.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);  // each returned handle owns a reference
+    tls_ret.handles.push_back(o);
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out = tls_ret.handles.data();
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorFree(ExecutorHandle handle) {
   Gil gil;
   Py_XDECREF(reinterpret_cast<PyObject *>(handle));
   return 0;

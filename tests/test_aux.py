@@ -215,3 +215,22 @@ def test_profiler_pause_resume_keeps_prepause_spans(tmp_path):
     names = {e["name"] for e in json.load(open(fname))["traceEvents"]}
     assert "relu" in names and "tanh" in names
     assert "sigmoid" not in names
+
+
+def test_profiler_fresh_run_clears_aggregate_table(tmp_path):
+    """A fresh set_state('run') starts a NEW session: the per-op aggregate
+    table must reset along with the span buffer, or dumps() mixes op
+    stats across sessions unless the caller remembers dumps(reset=True)
+    (round-4 advisor finding)."""
+    fname = str(tmp_path / "agg_profile.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.set_state("run")
+    nd.relu(nd.ones((4,))).wait_to_read()
+    mx.profiler.set_state("stop")
+    # no dumps(reset=True) here — the stale-aggregate trap
+    mx.profiler.set_state("run")
+    nd.tanh(nd.ones((4,))).wait_to_read()
+    mx.profiler.set_state("stop")
+    table = mx.profiler.dumps(reset=True)
+    assert "tanh" in table
+    assert "relu" not in table, "aggregate stats leaked across sessions"

@@ -419,3 +419,210 @@ def test_cpp_predictor_binary_matches_python(tmp_path):
     for b in range(3):
         assert ("row %d argmax %d" % (b, int(want[b].argmax()))) \
             in proc.stdout, (proc.stdout, want.argmax(axis=1))
+
+
+def test_symbol_executor_abi_trains_like_python(lib):
+    """The round-5 symbol/executor slice (reference c_api_symbolic.cc /
+    c_api_executor.cc subset): load symbol JSON through the ABI, list its
+    arguments, infer shapes, MXExecutorBind over ABI-owned NDArrays, run
+    forward + backward, and assert outputs AND gradients are bitwise
+    identical to the python executor on the same numbers."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, nd
+
+    x = sym.Variable("data")
+    out = sym.FullyConnected(x, num_hidden=4, no_bias=False, name="fc")
+    out = sym.Activation(out, act_type="tanh")
+    out = sym.LinearRegressionOutput(out, sym.Variable("label"),
+                                     name="lro")
+    js = out.tojson()
+
+    rng = np.random.RandomState(5)
+    B, D = 3, 6
+    feeds = {
+        "data": rng.uniform(-1, 1, (B, D)).astype(np.float32),
+        "fc_weight": rng.uniform(-0.5, 0.5, (4, D)).astype(np.float32),
+        "fc_bias": np.zeros(4, np.float32),
+        "label": rng.uniform(-1, 1, (B, 4)).astype(np.float32),
+    }
+
+    # --- python side -----------------------------------------------------
+    py_args = {k: nd.array(v) for k, v in feeds.items()}
+    py_grads = {k: nd.zeros(v.shape) for k, v in feeds.items()}
+    exe_py = out.bind(mx.cpu(), args=py_args, args_grad=py_grads,
+                      grad_req="write")
+    exe_py.forward(is_train=True)
+    exe_py.backward()
+    want_out = exe_py.outputs[0].asnumpy()
+    want_gw = exe_py.grad_dict["fc_weight"].asnumpy()
+
+    # --- ABI side --------------------------------------------------------
+    h = ctypes.c_void_p()
+    rc = lib.MXSymbolCreateFromJSON(js.encode(), ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError()
+
+    n = ctypes.c_uint32()
+    names_p = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListArguments(h, ctypes.byref(n),
+                                     ctypes.byref(names_p)) == 0
+    arg_names = [names_p[i].decode() for i in range(n.value)]
+    assert set(arg_names) == set(feeds)
+
+    assert lib.MXSymbolListOutputs(h, ctypes.byref(n),
+                                   ctypes.byref(names_p)) == 0
+    assert n.value == 1
+
+    # infer shapes from data+label and check fc_weight resolved
+    keys = (ctypes.c_char_p * 2)(b"data", b"label")
+    indptr = (ctypes.c_uint32 * 3)(0, 2, 4)
+    sdata = (ctypes.c_uint32 * 4)(B, D, B, 4)
+    u32 = ctypes.c_uint32
+    PP = ctypes.POINTER(ctypes.POINTER(u32))
+    in_sz, out_sz, aux_sz = u32(), u32(), u32()
+    in_nd, out_nd, aux_nd = (ctypes.POINTER(u32)() for _ in range(3))
+    in_d, out_d, aux_d = PP(), PP(), PP()
+    comp = ctypes.c_int()
+    rc = lib.MXSymbolInferShape(
+        h, 2, keys, indptr, sdata,
+        ctypes.byref(in_sz), ctypes.byref(in_nd), ctypes.byref(in_d),
+        ctypes.byref(out_sz), ctypes.byref(out_nd), ctypes.byref(out_d),
+        ctypes.byref(aux_sz), ctypes.byref(aux_nd), ctypes.byref(aux_d),
+        ctypes.byref(comp))
+    assert rc == 0, lib.MXGetLastError()
+    assert comp.value == 1
+    inferred = {name: tuple(in_d[i][d] for d in range(in_nd[i]))
+                for i, name in enumerate(arg_names)}
+    assert inferred["fc_weight"] == (4, D)
+    out_shape = tuple(out_d[0][d] for d in range(out_nd[0]))
+    assert out_shape == (B, 4)
+
+    in_args, grad_store = [], []
+    for name in arg_names:
+        a = _create(lib, feeds[name].shape)
+        _copy_in(lib, a, feeds[name])
+        in_args.append(a)
+        grad_store.append(_create(lib, feeds[name].shape))
+    HandleArr = ctypes.c_void_p * len(arg_names)
+    reqs = (ctypes.c_uint32 * len(arg_names))(*([1] * len(arg_names)))
+    exe = ctypes.c_void_p()
+    rc = lib.MXExecutorBind(h, 1, 0, len(arg_names), HandleArr(*[a.value for a in in_args]),
+                            HandleArr(*[g.value for g in grad_store]), reqs,
+                            0, None, ctypes.byref(exe))
+    assert rc == 0, lib.MXGetLastError()
+
+    assert lib.MXExecutorForward(exe, 1) == 0, lib.MXGetLastError()
+    assert lib.MXExecutorBackward(exe, 0, None) == 0, lib.MXGetLastError()
+
+    n_out = ctypes.c_uint32()
+    outs_p = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXExecutorOutputs(exe, ctypes.byref(n_out),
+                                 ctypes.byref(outs_p)) == 0
+    assert n_out.value == 1
+    got_out = _copy_out(lib, ctypes.c_void_p(outs_p[0]), want_out.shape)
+    np.testing.assert_array_equal(got_out, want_out)
+
+    gw = grad_store[arg_names.index("fc_weight")]
+    got_gw = _copy_out(lib, gw, want_gw.shape)
+    np.testing.assert_array_equal(got_gw, want_gw)
+
+    # round-trip the JSON through the ABI too
+    js_out = ctypes.c_char_p()
+    assert lib.MXSymbolSaveToJSON(h, ctypes.byref(js_out)) == 0
+    assert b"FullyConnected" in js_out.value
+
+    assert lib.MXExecutorFree(exe) == 0
+    assert lib.MXSymbolFree(h) == 0
+    for a in in_args + grad_store:
+        lib.MXNDArrayFree(a)
+
+
+def test_cpp_symbolic_executor_trains_and_matches_python(tmp_path):
+    """cpp/examples/train_symbolic.cpp: a symbol JSON authored in Python is
+    trained from a standalone C++ binary through MXSymbolCreateFromFile +
+    MXExecutorBind/Forward/Backward.  The binary prints its step-0 loss and
+    gradient checksum; the same step rerun through the PYTHON executor on
+    the identical LCG-generated init/data must agree (shared runtime, same
+    XLA kernels), and the binary must train the parabolic-boundary task to
+    >0.9 accuracy."""
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, nd
+
+    x = sym.Variable("data")
+    net = sym.FullyConnected(x, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    json_path = str(tmp_path / "mlp-symbol.json")
+    with open(json_path, "w") as f:
+        f.write(net.tojson())
+
+    binary = _build_example("train_symbolic")
+    proc = subprocess.run([binary, json_path], env=_embedded_env(),
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "TRAIN_SYMBOLIC OK" in proc.stdout
+    step0 = [l for l in proc.stdout.splitlines()
+             if l.startswith("STEP0")][0].split()
+    cpp_loss, cpp_gradsum = float(step0[2]), float(step0[4])
+
+    # --- python rerun of step 0 on the same LCG numbers ------------------
+    class LCG:
+        def __init__(self, seed):
+            self.s = seed
+
+        def uniform(self):
+            self.s = (self.s * 6364136223846793005
+                      + 1442695040888963407) % (1 << 64)
+            return np.float32((self.s >> 33) & 0xFFFFFF) / np.float32(
+                0x1000000)
+
+    N = 256
+    gen = LCG(2026)
+    xs, ys = [], []
+    for _ in range(N):
+        x0 = np.float32(gen.uniform() * np.float32(2.0) - np.float32(1.0))
+        x1 = np.float32(gen.uniform() * np.float32(2.0) - np.float32(1.0))
+        sq = np.float32(x0 * x0)
+        b = np.float32(sq + x1)
+        xs.append((x0, x1))
+        ys.append(1.0 if b > np.float32(0.3) else 0.0)
+    xs = np.array(xs, np.float32)
+    ys = np.array(ys, np.float32)
+
+    arg_names = net.list_arguments()
+    arg_shapes, _, _ = net.infer_shape(data=(N, 2), softmax_label=(N,))
+    shapes = dict(zip(arg_names, arg_shapes))
+    wgen = LCG(7)
+    feeds, grads, req = {}, {}, {}
+    for name in arg_names:
+        if name == "data":
+            feeds[name] = nd.array(xs)
+            req[name] = "null"
+        elif name == "softmax_label":
+            feeds[name] = nd.array(ys)
+            req[name] = "null"
+        else:
+            vals = np.zeros(shapes[name], np.float32)
+            if "bias" not in name:
+                flat = vals.reshape(-1)
+                for i in range(flat.size):
+                    flat[i] = np.float32(
+                        (wgen.uniform() * np.float32(2.0)
+                         - np.float32(1.0)) * np.float32(0.5))
+            feeds[name] = nd.array(vals)
+            grads[name] = nd.zeros(shapes[name])
+            req[name] = "write"
+    exe = net.bind(mx.cpu(), args=feeds, args_grad=grads, grad_req=req)
+    exe.forward(is_train=True)
+    exe.backward()
+    p = exe.outputs[0].asnumpy()
+    py_loss = float(np.mean(-np.log(
+        p[np.arange(N), ys.astype(int)] + 1e-12)))
+    py_gradsum = float(sum(np.sum(grads[n].asnumpy(), dtype=np.float64)
+                           for n in arg_names if req[n] == "write"))
+    np.testing.assert_allclose(cpp_loss, py_loss, rtol=1e-6)
+    np.testing.assert_allclose(cpp_gradsum, py_gradsum, rtol=1e-5,
+                               atol=1e-6)

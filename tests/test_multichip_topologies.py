@@ -1,0 +1,39 @@
+"""dryrun_multichip at non-default topologies (VERDICT r4 item 8).
+
+The driver validates the multi-chip path at n=8; these tests guard the
+dp×tp factorization (tp=2 whenever n is even -> dp = n/2), the ring/
+pipeline schedules, and the expert/checkpoint paths against axis-size
+assumptions by exercising n=4 and n=16 virtual-CPU meshes in fresh
+subprocesses (device count must be fixed before backend init).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_dryrun(n, timeout):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d" % n)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    res = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(%d); "
+         "print('DRYRUN_OK %d')" % (n, n)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert ("DRYRUN_OK %d" % n) in res.stdout
+
+
+def test_dryrun_multichip_4_devices():
+    _run_dryrun(4, timeout=900)
+
+
+@pytest.mark.skipif(os.environ.get("MXNET_TEST_FAST") == "1",
+                    reason="16-device CPU dryrun is the slow variant")
+def test_dryrun_multichip_16_devices():
+    _run_dryrun(16, timeout=1500)

@@ -627,3 +627,24 @@ def test_onnx_gemm_transb0_shares_weight_with_matmul(tmp_path):
                                    initializers={"W": W, "b": b})
     got = _forward(sym, args, aux, x)
     np.testing.assert_allclose(got, 2 * (x @ W), rtol=1e-5, atol=1e-5)
+
+
+def test_onnx_shared_initializer_static_and_tensor_use(tmp_path):
+    """An initializer consumed BOTH as a static operand (opset-13
+    ReduceSum axes) and as a tensor input of another node (Cast) must
+    survive in arg_params — the round-4 advisor found the eager
+    _const_operand pop lost it, leaving the imported model unbindable."""
+    rng = np.random.RandomState(13)
+    x = rng.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    nodes = [
+        _onnx_node("ReduceSum", ["data", "ax"], ["red"], keepdims=0),
+        _onnx_node("Cast", ["ax"], ["axf"], to=int(_P.TensorProto.FLOAT)),
+        _onnx_node("Add", ["red", "axf"], ["out"]),
+    ]
+    sym, args, aux = _import_graph(
+        tmp_path, nodes, x.shape, "out",
+        initializers={"ax": np.array([1], np.int64)})
+    assert "ax" in args, "shared initializer dropped from arg_params"
+    got = _forward(sym, args, aux, x)
+    np.testing.assert_allclose(got, x.sum(axis=1) + 1.0,
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,270 @@
+"""Round-5 semantic-audit regression tests (VERDICT r4 item 5).
+
+Each test pins a divergence found (or a contract re-verified) by auditing
+the repo op against the reference C++ source with a first-principles
+numpy loop — the technique that has caught 6 real bugs across rounds 4-5
+that the green suite missed.  Expected values are computed from the
+reference's exact index math, never by calling the op twice.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------- Pooling
+
+def test_pooling_same_convention_1d():
+    """1-D 'same' (pooling.cc:142-145): ceil((x+2p)/s) output positions —
+    NOT the 'valid' floor formula the repo used before round 5."""
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 8)
+    out = nd.Pooling(nd.array(x), kernel=(3,), stride=(2,),
+                     pool_type="max", pooling_convention="same")
+    assert out.shape == (1, 1, 4), out.shape  # ceil(8/2) = 4, not 3
+    # windows start at 0,2,4,6; last covers [6,7,(pad)] -> max 7
+    assert_almost_equal(out.asnumpy().ravel(), [2, 4, 6, 7])
+
+
+def test_pooling_same_convention_2d_matches_full():
+    """2-D shape inference routes 'same' through the same ceil formula as
+    'full' (pooling.cc:163-181: the else-branch covers kFull AND kSame)."""
+    x = np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32)
+    full = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                      pool_type="max", pooling_convention="full")
+    same = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                      pool_type="max", pooling_convention="same")
+    # valid would be floor((8-3)/2)+1 = 3; full/same = ceil((8-3)/2)+1 = 4
+    assert same.shape == full.shape == (1, 2, 4, 4)
+    assert_almost_equal(same.asnumpy(), full.asnumpy())
+
+
+def test_pooling_full_shape_and_last_window():
+    """'full' = ceil((x+2p-k)/s)+1 (pooling.cc:163-181); ceil-extra cells
+    beyond the padded extent contribute nothing to max."""
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    out = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type="max", pooling_convention="full")
+    assert out.shape == (1, 1, 5, 5)
+    # last window starts at 4*2-1=7: only image row/col 7 contribute
+    assert out.asnumpy()[0, 0, 4, 4] == 63.0
+
+
+# -------------------------------------------------------------- UpSampling
+
+def _bilinear_kernel(k, scale):
+    """init.Bilinear's kernel: w[i] = 1 - |i/f - c| with f=ceil(k/2),
+    c = (2f - 1 - f%2) / (2f)."""
+    f = int(np.ceil(k / 2.0))
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    w1 = np.array([1 - abs(i / f - c) for i in range(k)], np.float32)
+    return np.outer(w1, w1)
+
+
+def _np_deconv_grouped(x, w, stride, pad, k):
+    """Transposed conv, one group per channel: out[., c] accumulates
+    x[., c, i, j] * w[c, 0] stamped at (i*s - pad ... )."""
+    n, c, h, wdt = x.shape
+    oh = (h - 1) * stride + k - 2 * pad
+    ow = (wdt - 1) * stride + k - 2 * pad
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for b in range(n):
+        for ch in range(c):
+            for i in range(h):
+                for j in range(wdt):
+                    for ki in range(k):
+                        for kj in range(k):
+                            oi = i * stride - pad + ki
+                            oj = j * stride - pad + kj
+                            if 0 <= oi < oh and 0 <= oj < ow:
+                                out[b, ch, oi, oj] += \
+                                    x[b, ch, i, j] * w[ch, 0, ki, kj]
+    return out
+
+
+def test_upsampling_bilinear_is_grouped_deconvolution():
+    """sample_type='bilinear' is a grouped Deconvolution over a WEIGHT
+    input (upsampling-inl.h:170-188,200-206: kernel 2s - s%2, stride s,
+    pad ceil((s-1)/2), num_group=num_filter) — not jax.image.resize."""
+    scale, c = 2, 3
+    k = 2 * scale - scale % 2          # 4
+    pad = int(np.ceil((scale - 1) / 2.0))  # 1
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (2, c, 5, 5)).astype(np.float32)
+    w = np.broadcast_to(_bilinear_kernel(k, scale),
+                        (c, 1, k, k)).astype(np.float32).copy()
+    out = nd.UpSampling(nd.array(x), nd.array(w), scale=scale,
+                        sample_type="bilinear", num_filter=c, num_args=2)
+    expected = _np_deconv_grouped(x, w, scale, pad, k)
+    assert out.shape == expected.shape == (2, c, 10, 10)
+    assert_almost_equal(out.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_upsampling_bilinear_weight_matches_bilinear_init():
+    """With an init.Bilinear weight, the deconv reproduces a constant
+    input exactly in the interior (the defining bilinear property)."""
+    scale, c = 2, 2
+    k = 2 * scale - scale % 2
+    w = nd.zeros((c, 1, k, k))
+    mx.init.Bilinear()._init_weight(None, w)
+    x = np.full((1, c, 4, 4), 2.5, np.float32)
+    out = nd.UpSampling(nd.array(x), w, scale=scale,
+                        sample_type="bilinear", num_filter=c, num_args=2)
+    interior = out.asnumpy()[:, :, 1:-1, 1:-1]
+    assert_almost_equal(interior, np.full_like(interior, 2.5),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_upsampling_nearest_multi_input_concat_and_sum():
+    """num_args>1 (upsampling-inl.h:99-115): every input is upsampled to
+    the FIRST input's scaled extent (per-input integer scale), then
+    channel-concat (default) or summed."""
+    a = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2)   # -> x2
+    b = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)  # -> x1
+    cat = nd.UpSampling(nd.array(a), nd.array(b), scale=2,
+                        sample_type="nearest", num_args=2)
+    assert cat.shape == (1, 3, 4, 4)
+    exp_a = a.repeat(2, axis=2).repeat(2, axis=3)
+    assert_almost_equal(cat.asnumpy()[:, :2], exp_a)
+    assert_almost_equal(cat.asnumpy()[:, 2:], b)
+
+    sm = nd.UpSampling(nd.array(a[:, :1]), nd.array(b), scale=2,
+                       sample_type="nearest", num_args=2,
+                       multi_input_mode="sum")
+    assert sm.shape == (1, 1, 4, 4)
+    assert_almost_equal(sm.asnumpy(), exp_a[:, :1] + b)
+
+
+def test_upsampling_bilinear_weight_gradient_flows():
+    """The bilinear path's weight is a real parameter: gradients must
+    flow to it (it is trainable in the reference)."""
+    scale, c = 2, 1
+    k = 2 * scale - scale % 2
+    x = nd.array(np.random.RandomState(3).rand(1, c, 3, 3)
+                 .astype(np.float32))
+    w = nd.array(_bilinear_kernel(k, scale).reshape(c, 1, k, k))
+    w.attach_grad()
+    with autograd.record():
+        y = nd.UpSampling(x, w, scale=scale, sample_type="bilinear",
+                          num_filter=c, num_args=2)
+    y.backward()
+    assert float(np.abs(w.grad.asnumpy()).sum()) > 0
+
+
+# -------------------------------------------------------------- LeakyReLU
+
+def test_rrelu_train_samples_per_element_slope():
+    """rrelu (leaky_relu-inl.h:145-176): train mode samples slope ~
+    U(lower, upper) per ELEMENT; eval mode uses the midpoint.  Backward
+    reuses the sampled slope, so grad(x<0) == y/x elementwise."""
+    lower, upper = 0.1, 0.4
+    x_np = -np.ones((64, 64), np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.LeakyReLU(x, act_type="rrelu", lower_bound=lower,
+                         upper_bound=upper)
+    y.backward()
+    slopes = y.asnumpy() / x_np  # x == -1 -> slope = y / x
+    assert slopes.min() >= lower - 1e-6 and slopes.max() <= upper + 1e-6
+    assert slopes.std() > 0.01, "train-mode rrelu slope is not random"
+    # backward mask IS the sampled slope
+    assert_almost_equal(x.grad.asnumpy(), slopes, rtol=1e-5, atol=1e-6)
+
+    # eval mode: deterministic midpoint
+    y_eval = nd.LeakyReLU(nd.array(x_np), act_type="rrelu",
+                          lower_bound=lower, upper_bound=upper)
+    assert_almost_equal(y_eval.asnumpy(),
+                        x_np * (lower + upper) / 2, rtol=1e-6)
+    # positive side is identity in both modes
+    pos = nd.LeakyReLU(nd.array(np.abs(x_np)), act_type="rrelu",
+                       lower_bound=lower, upper_bound=upper)
+    assert_almost_equal(pos.asnumpy(), np.abs(x_np), rtol=1e-6)
+
+
+# ------------------------------------------------------- MultiBox (SSD)
+
+def test_multibox_prior_order_and_aspect():
+    """MultiBoxPriorForward (multibox_prior.cc:48-88): anchors are emitted
+    sizes-first (all sizes at ratio 1, then ratios[1:] at sizes[0]) with
+    half-width = s*H/W/2 (H/W aspect renormalization) — the order IS the
+    contract because cls/loc channels are keyed to it."""
+    from mxnet_tpu import nd
+    H, W = 2, 4   # non-square on purpose
+    sizes, ratios = (0.4, 0.2), (1.0, 2.0)
+    data = nd.zeros((1, 3, H, W))
+    out = nd.invoke("_contrib_MultiBoxPrior", [data],
+                    {"sizes": sizes, "ratios": ratios})
+    a = out.asnumpy().reshape(H, W, 3, 4)
+    # expected, straight from the C++ loop
+    exp = np.zeros((H, W, 3, 4), np.float32)
+    for r in range(H):
+        cy = (r + 0.5) / H
+        for c in range(W):
+            cx = (c + 0.5) / W
+            k = 0
+            for s in sizes:                     # all sizes, ratio 1
+                w, h = s * H / W / 2, s / 2
+                exp[r, c, k] = [cx - w, cy - h, cx + w, cy + h]
+                k += 1
+            for rt in ratios[1:]:               # ratios[1:], size=sizes[0]
+                sr = np.sqrt(rt)
+                w = sizes[0] * H / W * sr / 2
+                h = sizes[0] / sr / 2
+                exp[r, c, k] = [cx - w, cy - h, cx + w, cy + h]
+                k += 1
+    assert_almost_equal(a, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_target_bipartite_shared_best_anchor():
+    """Greedy bipartite stage (multibox_target.cc:102-139): when two gts
+    share the same best anchor, the second gt must still receive its own
+    (next-best) anchor — the per-gt-argmax shortcut loses it."""
+    from mxnet_tpu import nd
+    # anchor 0 overlaps both gts most; anchor 1 overlaps gt1 a bit less
+    anchors = nd.array(np.array(
+        [[[0.0, 0.0, 0.5, 0.5],
+          [0.05, 0.0, 0.55, 0.5],
+          [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    labels = nd.array(np.array(
+        [[[0, 0.0, 0.0, 0.5, 0.5],      # gt0 == anchor0
+          [1, 0.02, 0.0, 0.52, 0.5]]], np.float32))  # gt1 ~ anchor0 too
+    cls_preds = nd.zeros((1, 3, 3))
+    loc_t, loc_m, cls_t = nd.invoke(
+        "_contrib_MultiBoxTarget", [anchors, labels, cls_preds],
+        {"overlap_threshold": 0.95})
+    ct = cls_t.asnumpy()[0]
+    # bipartite: gt0 -> anchor0 (IoU 1.0), gt1 -> anchor1 (next best)
+    assert ct[0] == 1.0, ct          # class 0 + 1
+    assert ct[1] == 2.0, ct          # class 1 + 1  (lost pre-fix)
+    assert ct[2] == 0.0, ct          # unmatched -> background
+    assert loc_m.asnumpy()[0, :8].all() and not loc_m.asnumpy()[0, 8:].any()
+
+
+def test_multibox_target_empty_sample_is_ignored_not_background():
+    """A sample with no valid gt is left at ignore_label everywhere — the
+    reference kernel never runs for it (multibox_target.cc:97)."""
+    from mxnet_tpu import nd
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.5, 0.5],
+                                  [0.5, 0.5, 1.0, 1.0]]], np.float32))
+    labels = nd.array(np.full((1, 2, 5), -1.0, np.float32))
+    cls_preds = nd.zeros((1, 3, 2))
+    _, loc_m, cls_t = nd.invoke(
+        "_contrib_MultiBoxTarget", [anchors, labels, cls_preds], {})
+    assert (cls_t.asnumpy() == -1.0).all(), cls_t.asnumpy()
+    assert not loc_m.asnumpy().any()
+
+
+def test_multibox_target_prefix_valid_labels():
+    """Label rows AFTER the first class==-1 terminator are dead even if
+    they look valid (the reference scan breaks at the first -1)."""
+    from mxnet_tpu import nd
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.5, 0.5],
+                                  [0.5, 0.5, 1.0, 1.0]]], np.float32))
+    labels = nd.array(np.array(
+        [[[-1, -1, -1, -1, -1],
+          [0, 0.5, 0.5, 1.0, 1.0]]], np.float32))   # after terminator
+    cls_preds = nd.zeros((1, 3, 2))
+    _, _, cls_t = nd.invoke(
+        "_contrib_MultiBoxTarget", [anchors, labels, cls_preds], {})
+    assert (cls_t.asnumpy() == -1.0).all(), cls_t.asnumpy()

@@ -199,3 +199,55 @@ def test_relay_watcher_capture_salvage_and_append(tmp_path, monkeypatch):
     data = json.load(open(rw.LIVE_PATH))
     assert [c["value"] for c in data["captures"]] == [42.0, 7.0]
     assert data["probe_log"] == "probe.log"
+
+
+def test_kill_mxnet_remote_scanner_runs_locally():
+    """The '-H hostfile' fingerprint mode ships a /proc scanner string to
+    remote pythons; run that EXACT string locally against a decoy worker.
+    Pins the round-4 advisor bug: .decode('replace') passed 'replace' as
+    the encoding, so every /proc read raised LookupError and the scanner
+    always printed 'killed 0'."""
+    import signal
+    import time
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import kill_mxnet
+    finally:
+        sys.path.pop(0)
+    sentinel = "MX_KV_TEST_TOKEN=decoy%d" % os.getpid()
+    env = dict(os.environ, MX_KV_RANK="7", MX_KV_NUM_WORKERS="1",
+               MX_KV_TEST_TOKEN="decoy%d" % os.getpid())
+    victim = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(300)"], env=env)
+    try:
+        # dry-run variant runs the EXACT production string: must COUNT the
+        # fingerprinted decoy (>= 1; real launch.py workers on the box may
+        # add to the count, but nothing is killed)
+        res = subprocess.run(
+            [sys.executable, "-c", kill_mxnet.scanner_src(
+                signal.SIGTERM, dry_run=True)],
+            capture_output=True, text=True, timeout=60)
+        assert res.returncode == 0, res.stderr
+        n = int(res.stdout.split()[-1])
+        assert n >= 1, "scanner found no fingerprinted workers: %r" % \
+            res.stdout
+
+        # kill variant: same scanner with a per-run sentinel ANDed into
+        # the fingerprint so the os.kill path is exercised WITHOUT
+        # touching unrelated fingerprinted workers (e.g. a concurrent
+        # suite run or a live launch.py job on this host)
+        res = subprocess.run(
+            [sys.executable, "-c", kill_mxnet.scanner_src(
+                signal.SIGTERM, extra_env_token=sentinel)],
+            capture_output=True, text=True, timeout=60)
+        assert res.returncode == 0, res.stderr
+        assert int(res.stdout.split()[-1]) == 1, res.stdout
+        for _ in range(50):
+            if victim.poll() is not None:
+                break
+            time.sleep(0.1)
+        assert victim.poll() is not None, "remote scanner did not kill " \
+            "the fingerprinted decoy"
+    finally:
+        if victim.poll() is None:
+            victim.send_signal(signal.SIGKILL)

@@ -58,6 +58,43 @@ def list_local(pattern):
     return out
 
 
+def scanner_src(sig, dry_run=False, extra_env_token=None):
+    """Source of the /proc fingerprint scanner shipped to remote hosts.
+
+    Module-level (not inlined in main) so the test suite can run the exact
+    string locally — the round-4 advisor found the shipped scanner called
+    .decode('replace'), i.e. passed 'replace' as the ENCODING, so every
+    /proc read raised LookupError and '-H' fingerprint mode always
+    reported 'killed 0'.
+
+    ``extra_env_token`` ANDs an additional required environ substring.
+    Production ('-H' mode) passes None; the suite's KILL-variant test
+    passes a per-run sentinel so it can exercise the real os.kill path
+    without terminating unrelated fingerprinted workers on the host."""
+    kill_stmt = "n+=1" if dry_run else "os.kill(p,%d); n+=1" % sig
+    extra = ""
+    if extra_env_token is not None:
+        extra = "and %r in env " % str(extra_env_token)
+    return (
+        "import os,signal\n"
+        "n=0\n"
+        "for e in os.listdir('/proc'):\n"
+        "  if not e.isdigit(): continue\n"
+        "  p=int(e)\n"
+        "  if p==os.getpid(): continue\n"
+        "  try:\n"
+        "    env=open('/proc/%d/environ'%p,'rb').read()"
+        ".decode(errors='replace')\n"
+        "    cmd=open('/proc/%d/cmdline'%p,'rb').read()"
+        ".decode(errors='replace')\n"
+        "  except Exception: continue\n"
+        "  if ('MX_KV_RANK=' in env or 'DMLC_ROLE=' in env) "
+        + extra +
+        "and 'kill_mxnet' not in cmd:\n"
+        "    " + kill_stmt + "\n"
+        "print('killed',n)\n")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pattern", default=None,
@@ -76,21 +113,7 @@ def main():
         if args.pattern:
             remote = ["pkill", "-%d" % args.signal, "-f", args.pattern]
         else:
-            scanner = (
-                "import os,signal\n"
-                "n=0\n"
-                "for e in os.listdir('/proc'):\n"
-                "  if not e.isdigit(): continue\n"
-                "  p=int(e)\n"
-                "  try:\n"
-                "    env=open('/proc/%%d/environ'%%p,'rb').read().decode('replace')\n"
-                "    cmd=open('/proc/%%d/cmdline'%%p,'rb').read().decode('replace')\n"
-                "  except Exception: continue\n"
-                "  if ('MX_KV_RANK=' in env or 'DMLC_ROLE=' in env) "
-                "and 'kill_mxnet' not in cmd:\n"
-                "    os.kill(p,%d); n+=1\n"
-                "print('killed',n)\n" % args.signal)
-            remote = ["python3", "-c", scanner]
+            remote = ["python3", "-c", scanner_src(args.signal)]
         rc = 0
         for host in open(args.hostfile):
             host = host.strip()
