@@ -139,6 +139,34 @@ MXTPU_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
                                 NDArrayHandle **out);
 MXTPU_DLL int MXExecutorFree(ExecutorHandle handle);
 
+// DataIter slice (reference MXDataIter* in include/mxnet/c_api.h): the
+// C-creatable iterators are the file-driven ones (MNISTIter, CSVIter,
+// LibSVMIter, ImageRecordIter) — a non-Python frontend names files and
+// shapes as string key/values and streams batches back as NDArray
+// handles.  GetData/GetLabel handles are OWNED by the caller (free with
+// MXNDArrayFree) and stay valid after the iterator advances.
+typedef void *DataIterCreator;  // an interned iterator-name handle
+typedef void *DataIterHandle;
+MXTPU_DLL int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+MXTPU_DLL int MXDataIterGetIterInfo(DataIterCreator creator,
+                                    const char **name,
+                                    const char **description,
+                                    mx_uint *num_args,
+                                    const char ***arg_names,
+                                    const char ***arg_type_infos,
+                                    const char ***arg_descriptions);
+MXTPU_DLL int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   DataIterHandle *out);
+MXTPU_DLL int MXDataIterFree(DataIterHandle handle);
+MXTPU_DLL int MXDataIterNext(DataIterHandle handle, int *out);
+MXTPU_DLL int MXDataIterBeforeFirst(DataIterHandle handle);
+MXTPU_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+MXTPU_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+MXTPU_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                                 uint64_t *out_size);
+MXTPU_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
 // Predict ABI (reference include/mxnet/c_predict_api.h, implementation
 // src/c_api/c_predict_api.cc): standalone float32 inference from symbol
 // JSON + binary .params blob, no Python source at the call site.  Input

@@ -84,14 +84,24 @@ class DECHead(gluon.HybridBlock):
         return q / q.sum(axis=1, keepdims=True)
 
 
-def lloyd_init(z, rng, iters=20):
-    c = z[rng.choice(len(z), K, replace=False)].copy()
-    for _ in range(iters):
-        assign = ((z[:, None] - c[None]) ** 2).sum(-1).argmin(1)
-        for k in range(K):
-            if (assign == k).any():
-                c[k] = z[assign == k].mean(0)
-    return c
+def lloyd_init(z, rng, iters=20, restarts=8):
+    """k-means centroids, best of ``restarts`` random initializations by
+    within-cluster SSE.  A single Lloyd run from one random draw regularly
+    sticks in a merged-cluster optimum (purity ~0.75 on this data); the
+    reference DEC recipe relies on a well-initialized k-means too."""
+    best_c, best_sse = None, np.inf
+    for _ in range(restarts):
+        c = z[rng.choice(len(z), K, replace=False)].copy()
+        for _ in range(iters):
+            assign = ((z[:, None] - c[None]) ** 2).sum(-1).argmin(1)
+            for k in range(K):
+                if (assign == k).any():
+                    c[k] = z[assign == k].mean(0)
+        d2 = ((z[:, None] - c[None]) ** 2).sum(-1)
+        sse = float(d2.min(1).sum())
+        if sse < best_sse:
+            best_sse, best_c = sse, c
+    return best_c
 
 
 def purity(assign, labels):
@@ -112,6 +122,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     np.random.seed(0)
+    mx.random.seed(0)  # deterministic init (framework stream, r5)
     rng = np.random.RandomState(0)
     x_all, labels = synthetic_data(rng, args.n)
 

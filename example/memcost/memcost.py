@@ -35,7 +35,7 @@ def make_net(depth, width, remat, seed=0):
         for _ in range(depth):
             net.add(nn.Dense(width, activation="relu"))
         net.add(nn.Dense(8))
-    np.random.seed(seed)
+    mx.random.seed(seed)  # init draws from the framework stream (r5)
     net.initialize(mx.init.Xavier(), force_reinit=True)
     # explicit remat=False, not an omitted flag: omission falls back to
     # the MXNET_BACKWARD_DO_MIRROR env knob (cached_op.py:98), which

@@ -71,7 +71,7 @@ def evaluate(run_fp, run_q, batches):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--epochs", type=int, default=8)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--calib-mode", default="entropy",
                     choices=["minmax", "entropy", "none"])
@@ -79,6 +79,7 @@ def main():
     args = ap.parse_args()
 
     np.random.seed(0)
+    mx.random.seed(0)  # deterministic init (framework stream, r5)
     rng = np.random.RandomState(0)
     net = build_net()
     xs, ys = zip(*(synthetic_batch(rng, args.batch_size) for _ in range(24)))

@@ -394,3 +394,96 @@ def exec_backward(exe, head_grads):
 
 def exec_outputs(exe):
     return list(exe.outputs)
+
+
+# ---------------------------------------------------------------------------
+# DataIter slice (reference src/c_api/c_api.cc MXDataIter*): the C-creatable
+# iterators are the file-driven ones — a C frontend names files and shapes,
+# the runtime streams batches back as NDArray handles.
+# ---------------------------------------------------------------------------
+
+_DATAITER_NAMES = ("MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter")
+
+
+def list_data_iters():
+    from . import io as _io
+    return [n for n in _DATAITER_NAMES if hasattr(_io, n)]
+
+
+# parameters that are file paths / names: NEVER type-coerced — a numeric-
+# looking filename like "2020" must not become int 2020 (np.loadtxt would
+# read from file descriptor 2020).  The reference parses values through
+# per-parameter dmlc typed fields; this set is the same information.
+_STRING_ITER_PARAMS = frozenset((
+    "data_csv", "label_csv", "data_libsvm", "label_libsvm", "image",
+    "label", "path_imgrec", "path_imgidx", "path_imglist", "path_root",
+    "data_name", "label_name",
+))
+
+
+def _parse_iter_val(key, v):
+    import ast
+    import json as _json
+    if not isinstance(v, str) or key in _STRING_ITER_PARAMS:
+        return v
+    try:
+        out = _json.loads(v)
+    except (ValueError, TypeError):
+        try:
+            out = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    if isinstance(out, list):
+        out = tuple(out)
+    return out
+
+
+def dataiter_create(name, keys, vals):
+    from . import io as _io
+    name = str(name)
+    if name not in list_data_iters():
+        raise ValueError("unknown data iterator %r (available: %s)"
+                         % (name, list_data_iters()))
+    kwargs = {str(k): _parse_iter_val(str(k), v)
+              for k, v in zip(keys, vals)}
+    return getattr(_io, name)(**kwargs)
+
+
+def dataiter_next(it):
+    return 1 if it.iter_next() else 0
+
+
+def dataiter_before_first(it):
+    # cache invalidation lives in DataIter.__init_subclass__'s reset wrap,
+    # so a plain rewind is stale-safe for C and Python callers alike
+    it.reset()
+    return 0
+
+
+def _first_array(x):
+    if isinstance(x, (list, tuple)):
+        x = x[0] if x else None
+    if x is None:
+        raise ValueError("iterator has no current array (call "
+                         "MXDataIterNext first / no label stream)")
+    return x
+
+
+def dataiter_getdata(it):
+    return _first_array(it.getdata())
+
+
+def dataiter_getlabel(it):
+    return _first_array(it.getlabel())
+
+
+def dataiter_getindex(it):
+    import numpy as np
+    idx = it.getindex()
+    if idx is None:
+        return []
+    return [int(i) for i in np.asarray(idx).ravel()]
+
+
+def dataiter_getpad(it):
+    return int(it.getpad() or 0)

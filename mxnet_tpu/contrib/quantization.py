@@ -44,49 +44,84 @@ def dequantize(data, min_range, max_range, out_type="float32"):
     return _wrap(out, ctx=data.context)
 
 
-def _collect_thresholds(arr, mode="minmax", num_bins=2048, num_quantized=128):
-    """Symmetric calibration range for a tensor.
+def _smooth_distribution(p, eps=1e-4):
+    """Replace zero bins with eps mass taken off the non-zero bins
+    (reference quantization.py _smooth_distribution)."""
+    is_zeros = (p == 0).astype(_np.float64)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        return None
+    eps1 = eps * n_zeros / n_nonzeros
+    if eps1 >= 1.0:
+        return None
+    return p + eps * is_zeros - eps1 * (1.0 - is_zeros)
 
-    minmax: the observed extrema.  entropy: the KL-optimal clip threshold
-    (reference quantization.py _get_optimal_threshold — the TensorRT-style
-    search: for every candidate clip point, compare the clipped reference
-    distribution with its int8-downsampled reconstruction).
+
+def _collect_thresholds(arr, mode="minmax", num_bins=2001,
+                        num_quantized=255, stride=4):
+    """Calibration range for a tensor.
+
+    minmax: the observed extrema.  entropy: the reference's
+    _get_optimal_threshold (quantization.py:267-351, the TensorRT KL
+    search) — a SIGNED zero-centered histogram, candidate clip windows
+    grown symmetrically around zero, reference/candidate distributions
+    eps-smoothed, and — crucially — a one-sided (0, t) range when the
+    tensor is non-negative, so ReLU-fed layers keep the full int8
+    resolution instead of wasting half the code points on values that
+    never occur (th_dict handling at :371-375).
+
+    Deviations from the reference, both documented speed trades with the
+    same search structure: num_bins 2001 vs 8001, and candidates every
+    ``stride`` bins instead of every bin.
     """
     a = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
     if mode == "minmax":
         return float(a.min()), float(a.max())
-    amax = float(_np.abs(a).max())
-    if amax == 0.0:
+    a = a.ravel()
+    min_val = float(a.min())
+    max_val = float(a.max())
+    th = max(abs(min_val), abs(max_val))
+    if th == 0.0:
         return 0.0, 0.0
-    hist, edges = _np.histogram(_np.abs(a).ravel(), bins=num_bins,
-                                range=(0, amax))
-    best_t, best_kl = amax, _np.inf
-    for i in range(num_quantized, num_bins + 1, num_quantized // 2):
-        sliced = hist[:i].astype(_np.float64)
-        # reference distribution: everything past the clip collapses into
-        # the last kept bin
+    hist, edges = _np.histogram(a, bins=num_bins, range=(-th, th))
+    zero = num_bins // 2
+    best_t, best_kl = th, _np.inf
+    for i in range(num_quantized // 2, num_bins // 2 + 1, stride):
+        lo, hi = zero - i, zero + i + 1
+        sliced = hist[lo:hi].astype(_np.float64)
         p = sliced.copy()
-        p[-1] += hist[i:].sum()
-        # candidate distribution: the kept bins squeezed into int8 levels,
-        # then re-expanded uniformly over the nonzero positions
-        q = _np.zeros(i)
-        chunks = _np.array_split(sliced, num_quantized)
-        pos = 0
-        for chunk in chunks:
-            nonzero = _np.count_nonzero(chunk)
-            if nonzero:
-                q[pos:pos + len(chunk)] = _np.where(
-                    chunk > 0, chunk.sum() / nonzero, 0.0)
-            pos += len(chunk)
-        keep = p > 0
-        if not q[keep].all():
-            # smooth zero candidate bins so KL stays finite
-            q = q + 1e-9
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        nonzero = (sliced != 0)
+        # merge the window into num_quantized int8 levels, then re-expand
+        # each level's mass uniformly over its nonzero source bins
+        merged = p.size // num_quantized
+        q = _np.zeros(p.size)
+        body = sliced[:num_quantized * merged].reshape(num_quantized, merged)
+        sums = body.sum(axis=1)
+        sums[-1] += sliced[num_quantized * merged:].sum()
+        counts = nonzero[:num_quantized * merged].reshape(
+            num_quantized, merged).sum(axis=1)
+        counts[-1] += nonzero[num_quantized * merged:].sum()
+        tail = nonzero[(num_quantized - 1) * merged:].sum()
+        with _np.errstate(divide="ignore", invalid="ignore"):
+            fill = _np.where(counts > 0, sums / _np.maximum(counts, 1), 0.0)
+        q[:num_quantized * merged] = _np.repeat(fill, merged)
+        if tail:
+            q[(num_quantized - 1) * merged:] = sums[-1] / tail
+        q[~nonzero] = 0.0
+        p = _smooth_distribution(p)
+        q = _smooth_distribution(q)
+        if p is None or q is None:
+            continue
         p_n = p / p.sum()
         q_n = q / q.sum()
-        kl = float(_np.sum(p_n[keep] * _np.log(p_n[keep] / q_n[keep])))
+        kl = float(_np.sum(p_n * _np.log(p_n / q_n)))
         if kl < best_kl:
-            best_kl, best_t = kl, float(edges[i])
+            best_kl, best_t = kl, float(edges[hi])
+    if min_val >= 0:
+        return 0.0, best_t
     return -best_t, best_t
 
 

@@ -10,6 +10,8 @@ import json
 import re
 import numpy as _np
 
+from . import random as _rand
+
 from .base import string_types
 
 _INITIALIZER_REGISTRY = {}
@@ -83,7 +85,7 @@ class Initializer:
         if isinstance(self, FusedRNN):
             self._init_weight(name, arr)
         else:
-            self._set(arr, _np.random.uniform(-0.07, 0.07, arr.shape))
+            self._set(arr, _rand.derived_numpy_rng().uniform(-0.07, 0.07, arr.shape))
 
     def _set(self, arr, np_value):
         arr[:] = np_value.astype(_np.float32) if np_value.dtype == _np.float64 else np_value
@@ -146,7 +148,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        self._set(arr, _np.random.uniform(-self.scale, self.scale, arr.shape))
+        self._set(arr, _rand.derived_numpy_rng().uniform(-self.scale, self.scale, arr.shape))
 
 
 @register
@@ -156,7 +158,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        self._set(arr, _np.random.normal(0, self.sigma, arr.shape))
+        self._set(arr, _rand.derived_numpy_rng().normal(0, self.sigma, arr.shape))
 
 
 @register
@@ -170,9 +172,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _rand.derived_numpy_rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _rand.derived_numpy_rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         res = u if u.shape == tmp.shape else v
         self._set(arr, (self.scale * res).reshape(arr.shape))
@@ -203,9 +205,9 @@ class Xavier(Initializer):
             raise ValueError("Incorrect factor type %r" % (self.factor_type,))
         scale = _np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            self._set(arr, _np.random.uniform(-scale, scale, shape))
+            self._set(arr, _rand.derived_numpy_rng().uniform(-scale, scale, shape))
         elif self.rnd_type == "gaussian":
-            self._set(arr, _np.random.normal(0, scale, shape))
+            self._set(arr, _rand.derived_numpy_rng().normal(0, scale, shape))
         else:
             raise ValueError("Unknown random type")
 

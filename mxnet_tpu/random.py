@@ -64,3 +64,26 @@ class key_override:
 def __getattr__(name):
     from .ndarray import random as _ndrandom
     return getattr(_ndrandom, name)
+
+
+def derived_numpy_rng():
+    """A numpy RandomState seeded from a fresh split of the framework key.
+
+    The reference's initializers draw through mx random ops, so
+    ``mx.random.seed(n)`` makes INITIALIZATION reproducible too
+    (python/mxnet/initializer.py over src/resource.cc seeding).  Here the
+    initializers fill with numpy for convenience; sourcing their
+    RandomState from the framework stream restores that contract — before
+    round 5 they used numpy's GLOBAL entropy-seeded state, so two runs
+    with identical mx.random.seed produced different networks."""
+    import jax
+    import numpy as _np
+    sub = next_key()
+    data = jax.random.key_data(sub) if hasattr(jax.random, "key_data") \
+        else sub
+    # seed with EVERY key word (RandomState accepts array seeds): folding
+    # to one 31-bit word would give ~2^-32 per-pair collision odds between
+    # independently-initialized parameters — silent perfectly-correlated
+    # weight tensors on a collision
+    words = _np.asarray(data).ravel().astype(_np.uint32)
+    return _np.random.RandomState(words)

@@ -995,3 +995,164 @@ MXTPU_DLL int MXExecutorFree(ExecutorHandle handle) {
   Py_XDECREF(reinterpret_cast<PyObject *>(handle));
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// DataIter slice (reference src/c_api/c_api.cc MXDataIter*).  A
+// DataIterCreator is an interned iterator-name handle (same scheme as
+// NNGetOpHandle); a DataIterHandle is an owned PyObject* to the python
+// iterator object.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+std::mutex g_iters_mu;
+std::vector<std::unique_ptr<std::string>> g_iter_creators;
+thread_local std::vector<DataIterCreator> tls_iter_creators;
+thread_local std::vector<uint64_t> tls_index;
+
+}  // namespace
+
+MXTPU_DLL int MXListDataIters(mx_uint *out_size,
+                              DataIterCreator **out_array) {
+  Gil gil;
+  PyObject *r = bcall("list_data_iters", nullptr);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyList_Size(r);
+  std::lock_guard<std::mutex> lk(g_iters_mu);
+  tls_iter_creators.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *name = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    std::string *slot = nullptr;
+    for (auto &c : g_iter_creators) {
+      if (*c == name) slot = c.get();
+    }
+    if (slot == nullptr) {
+      g_iter_creators.push_back(std::make_unique<std::string>(name));
+      slot = g_iter_creators.back().get();
+    }
+    tls_iter_creators.push_back(slot);
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = tls_iter_creators.data();
+  return 0;
+}
+
+MXTPU_DLL int MXDataIterGetIterInfo(DataIterCreator creator,
+                                    const char **name,
+                                    const char **description,
+                                    mx_uint *num_args,
+                                    const char ***arg_names,
+                                    const char ***arg_type_infos,
+                                    const char ***arg_descriptions) {
+  const std::string *s = reinterpret_cast<const std::string *>(creator);
+  if (s == nullptr) return fail_msg("null DataIterCreator");
+  if (name != nullptr) *name = s->c_str();
+  // parameters are python-documented; the C info surface reports the name
+  // and an empty arg table (the reference fills these from dmlc params)
+  if (description != nullptr) *description = "";
+  if (num_args != nullptr) *num_args = 0;
+  if (arg_names != nullptr) *arg_names = nullptr;
+  if (arg_type_infos != nullptr) *arg_type_infos = nullptr;
+  if (arg_descriptions != nullptr) *arg_descriptions = nullptr;
+  return 0;
+}
+
+MXTPU_DLL int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   DataIterHandle *out) {
+  Gil gil;
+  const std::string *name = reinterpret_cast<const std::string *>(creator);
+  if (name == nullptr) return fail_msg("null DataIterCreator");
+  PyObject *pykeys = str_list(static_cast<int>(num_param), keys);
+  PyObject *pyvals = str_list(static_cast<int>(num_param), vals);
+  PyObject *args = Py_BuildValue("(sOO)", name->c_str(), pykeys, pyvals);
+  Py_DECREF(pykeys);
+  Py_DECREF(pyvals);
+  PyObject *r = bcall("dataiter_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;
+  return 0;
+}
+
+MXTPU_DLL int MXDataIterFree(DataIterHandle handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXTPU_DLL int MXDataIterNext(DataIterHandle handle, int *out) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("dataiter_next", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXDataIterBeforeFirst(DataIterHandle handle) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("dataiter_before_first", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+static int dataiter_fetch(const char *fn, DataIterHandle handle,
+                          NDArrayHandle *out) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *out = r;  // ownership transferred to the caller's handle
+  return 0;
+}
+
+MXTPU_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return dataiter_fetch("dataiter_getdata", handle, out);
+}
+
+MXTPU_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return dataiter_fetch("dataiter_getlabel", handle, out);
+}
+
+MXTPU_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                                 uint64_t *out_size) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("dataiter_getindex", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PyList_Size(r);
+  tls_index.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    tls_index.push_back(static_cast<uint64_t>(
+        PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i))));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<uint64_t>(n);
+  *out_index = tls_index.data();
+  return 0;
+}
+
+MXTPU_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  Gil gil;
+  PyObject *args =
+      Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = bcall("dataiter_getpad", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail();
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
