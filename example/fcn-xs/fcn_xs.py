@@ -116,6 +116,7 @@ def main():
     args = parser.parse_args()
 
     rng = np.random.RandomState(7)
+    mx.random.seed(1)  # deterministic init from the framework stream (r5)
     net = get_fcn16s()
     mod = mx.mod.Module(net, context=mx.tpu() if mx.num_tpus() else mx.cpu(),
                         label_names=("softmax_label",))

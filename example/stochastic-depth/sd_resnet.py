@@ -115,6 +115,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     np.random.seed(0)
+    mx.random.seed(1)  # deterministic init from the framework stream (r5)
     net = SDNet(args.blocks, death_rate=args.death_rate)
     net.initialize(mx.init.Xavier())
     trainer = gluon.Trainer(net.collect_params(), "adam",

@@ -40,6 +40,8 @@ class Executor:
             aux_states = dict(zip(self.aux_names, aux_states))
         self.arg_dict = dict(args)
         self.aux_dict = dict(aux_states or {})
+        self._aux_update_names = []  # set by _build_fn(is_train=True)
+        self._aux_tail = ()
         if isinstance(args_grad, (list, tuple)):
             args_grad = dict(zip(self.arg_names, args_grad))
         self.grad_dict = dict(args_grad) if args_grad else {}
@@ -75,8 +77,21 @@ class Executor:
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
     # ------------------------------------------------------------------
+    # ops whose (out, mean, var) training outputs fold into the moving-stat
+    # aux inputs [3]=moving_mean, [4]=moving_var (batch_norm.cc:118-140:
+    # moving = moving * momentum + batch * (1 - momentum))
+    _BN_AUX_OPS = frozenset(("BatchNorm", "_contrib_SyncBatchNorm"))
+
     def _build_fn(self, is_train):
-        """Trace the DAG into fn(arg_vals_list, aux_vals_list, keys) -> outs."""
+        """Trace the DAG into fn(arg_vals_list, aux_vals_list, keys) ->
+        outs + updated-aux tail.
+
+        The reference's BatchNorm MUTATES its moving_mean/moving_var aux
+        states during every training forward; this pure trace instead
+        APPENDS each touched aux's updated value after the graph outputs,
+        and forward() writes the tail back into aux_dict — without this,
+        Module-trained BN nets kept their init (0, 1) running stats and
+        normalized garbage at inference (round-5 audit find)."""
         sym = self._symbol
         nodes = sym._topo_nodes()
         arg_order = {n: i for i, n in enumerate(self.arg_names)}
@@ -84,6 +99,20 @@ class Executor:
         rng_nodes = [n for n in nodes
                      if n.op is not None and get_op(n.op).rng_for(n.attrs)]
         rng_index = {id(n): i for i, n in enumerate(rng_nodes)}
+
+        bn_nodes = []
+        if is_train:
+            aux_update_names = []
+            for n in nodes:
+                if (n.op in self._BN_AUX_OPS and len(n.inputs) >= 5
+                        and not n.attrs.get("use_global_stats", False)):
+                    mm, mv = n.inputs[3][0], n.inputs[4][0]
+                    if mm.name in aux_order and mv.name in aux_order:
+                        bn_nodes.append(n)
+                        aux_update_names += [mm.name, mv.name]
+            # train-only state: the infer build must not clobber it (the
+            # two traced fns are cached independently per mode)
+            self._aux_update_names = aux_update_names
 
         group2dev = self._group2dev
         default_dev = self._ctx.jax_device() if group2dev else None
@@ -116,7 +145,20 @@ class Executor:
                 outs = out if isinstance(out, (tuple, list)) else [out]
                 for i, o in enumerate(outs):
                     env[(id(n), i)] = o
-            return [env[(id(n), idx)] for (n, idx) in sym._entries]
+            result = [env[(id(n), idx)] for (n, idx) in sym._entries]
+            from .ops.nn_ops import BN_EPS_DEFAULT, bn_invstd_to_var
+            for n in bn_nodes:
+                m = float(n.attrs.get("momentum", 0.9))
+                eps = float(n.attrs.get("eps", BN_EPS_DEFAULT))
+                mean, invstd = env[(id(n), 1)], env[(id(n), 2)]
+                # the op's third output is invstd (reference contract);
+                # the running average tracks the raw variance
+                var = bn_invstd_to_var(invstd, eps)
+                old_mm = env[(id(n.inputs[3][0]), n.inputs[3][1])]
+                old_mv = env[(id(n.inputs[4][0]), n.inputs[4][1])]
+                result.append(old_mm * m + mean * (1 - m))
+                result.append(old_mv * m + var * (1 - m))
+            return result
 
         self._n_rng = len(rng_nodes)
         return fn
@@ -183,6 +225,14 @@ class Executor:
                 saved = (tuple(arg_vals), tuple(aux_vals), keys)
                 bwd_fn = self._jit_train_bwd
                 self._vjp = ((lambda cts: bwd_fn(*saved, cts)), wrt_names)
+            # split off the appended BN moving-stat updates and fold them
+            # into aux_dict (the pure-trace analog of the reference op's
+            # in-place running-stat mutation)
+            n_graph = len(outs) - len(self._aux_update_names)
+            self._aux_tail = tuple(outs[n_graph:])
+            for name, val in zip(self._aux_update_names, outs[n_graph:]):
+                self.aux_dict[name]._set_data(val)
+            outs = outs[:n_graph]
             self.outputs = [_wrap(o, ctx=self._ctx) for o in outs]
         else:
             if self._fwd_infer is None:
@@ -217,6 +267,10 @@ class Executor:
             import jax
             cts = tuple(jax.device_put(g, list(o._data.devices())[0])
                         for g, o in zip(cts, self.outputs))
+        # the traced function also returned BN moving-stat updates; their
+        # cotangents are zero (running stats are autograd.pause state)
+        if getattr(self, "_aux_tail", ()):
+            cts = cts + tuple(jnp.zeros_like(t) for t in self._aux_tail)
         grads = vjp(cts)
         for name, g in zip(wrt_names, grads):
             req = self.grad_req.get(name, "write")

@@ -165,7 +165,9 @@ class BatchNorm(HybridBlock):
     """Batch normalization with functional running-stat updates.
 
     Reference: gluon/nn/basic_layers.py BatchNorm over src/operator/nn/
-    batch_norm.cc.  The op returns (out, batch_mean, batch_var); this layer
+    batch_norm.cc.  The op returns (out, batch_mean, batch_invstd) — the
+    third output is the reference's INVERSE STD, recovered to a variance
+    here via bn_invstd_to_var; this layer
     folds them into running stats — a pure-value update that the CachedOp
     captures as aux outputs when hybridized."""
 
@@ -222,11 +224,17 @@ class BatchNorm(HybridBlock):
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         outs = F.BatchNorm(x, gamma, beta, running_mean, running_var,
                            name="fwd", **self._kwargs)
-        out, batch_mean, batch_var = outs
+        # the op's third output is the reference's INVERSE STD
+        # (batch_norm.cc:140-154); recover the raw batch variance for the
+        # running average
+        out, batch_mean, batch_invstd = outs
         if autograd.is_training() and not self._use_global_stats \
                 and isinstance(out, NDArray):
+            from ...ops.nn_ops import bn_invstd_to_var
             m = self._momentum
+            eps = float(self._kwargs["eps"])
             with autograd.pause():
+                batch_var = bn_invstd_to_var(batch_invstd, eps)
                 running_mean._set_data((running_mean * m + batch_mean * (1 - m))._data)
                 running_var._set_data((running_var * m + batch_var * (1 - m))._data)
         return out

@@ -304,10 +304,19 @@ def _upsampling(attrs, *inputs):
 # Normalization
 # ---------------------------------------------------------------------------
 
+BN_EPS_DEFAULT = 1e-3  # reference batch_norm-inl.h eps default
+
+
+def bn_invstd_to_var(invstd, eps):
+    """Invert the reference's VARIANCE_TO_INVSTD: the op's third output
+    is 1/sqrt(var + eps); running averages track the raw variance."""
+    return 1.0 / (invstd * invstd) - eps
+
+
 def _bn_apply(attrs, data, gamma, beta, mean, var):
     """Shared affine-normalize step of BatchNorm/SyncBatchNorm."""
     jnp = _jnp()
-    eps = float(attrs.get("eps", 1e-3))
+    eps = float(attrs.get("eps", BN_EPS_DEFAULT))
     axis = int(attrs.get("axis", 1)) % data.ndim  # -1 = channel-last
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
@@ -321,11 +330,16 @@ def _bn_apply(attrs, data, gamma, beta, mean, var):
 def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     """Batch normalization (src/operator/nn/batch_norm.cc).
 
-    Returns (out, mean, var).  In training (and not use_global_stats) the
-    returned mean/var are the batch statistics; the caller folds them into the
-    running averages (functional aux-state update — see gluon/nn BatchNorm)."""
+    Returns (out, mean, invstd) — the reference's second saved output is
+    the INVERSE STD 1/sqrt(var + eps), not the variance, in train AND
+    use_global modes alike (batch_norm.cc:140-154 VARIANCE_TO_INVSTD;
+    the output_mean_var doc promises "data_mean and the inverse of
+    data_var").  Consumers that fold running averages (gluon BatchNorm,
+    the executor's functional aux update) recover the raw variance as
+    1/invstd^2 - eps."""
     jnp = _jnp()
     axis = int(attrs.get("axis", 1)) % data.ndim  # -1 = channel-last
+    eps = float(attrs.get("eps", BN_EPS_DEFAULT))
     use_global = bool(attrs.get("use_global_stats", False)) or not attrs.get("_training", False)
     if use_global:
         mean, var = moving_mean, moving_var
@@ -333,7 +347,8 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
         axes = tuple(i for i in range(data.ndim) if i != axis)
         mean = jnp.mean(data, axis=axes)
         var = jnp.var(data, axis=axes)
-    return _bn_apply(attrs, data, gamma, beta, mean, var), mean, var
+    invstd = 1.0 / jnp.sqrt(var + eps)
+    return _bn_apply(attrs, data, gamma, beta, mean, var), mean, invstd
 
 
 @register("LayerNorm")
@@ -1065,7 +1080,9 @@ def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     when traced inside pjit/shard_map with a mesh axis named ``axis_name``
     (default 'dp'), the batch mean and mean-of-squares ride one
     ``lax.pmean`` over ICI; outside a mesh it degrades to plain BatchNorm.
-    Returns (out, mean, var) like BatchNorm; caller folds running stats.
+    Returns (out, mean, invstd) like BatchNorm — the third output is the
+    reference's inverse std (batch_norm.cc:140-154); running-stat folding
+    recovers the variance via bn_invstd_to_var.
     """
     jnp = _jnp()
     lax = _lax()
@@ -1085,7 +1102,10 @@ def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
         except NameError:  # axis not bound: single-device semantics
             pass
         var = sq - jnp.square(mean)
-    return _bn_apply(attrs, data, gamma, beta, mean, var), mean, var
+    # invstd third output, matching BatchNorm (batch_norm.cc:140-154)
+    eps = float(attrs.get("eps", BN_EPS_DEFAULT))
+    invstd = 1.0 / jnp.sqrt(var + eps)
+    return _bn_apply(attrs, data, gamma, beta, mean, var), mean, invstd
 
 
 @register("GridGenerator")

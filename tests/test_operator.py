@@ -87,7 +87,10 @@ def test_batchnorm_training_stats():
         out, m, v = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
     xn = x.asnumpy()
     assert_almost_equal(m.asnumpy(), xn.mean(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
-    assert_almost_equal(v.asnumpy(), xn.var(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
+    # third output is the reference's INVERSE STD (batch_norm.cc:140-154)
+    assert_almost_equal(v.asnumpy(),
+                        1.0 / np.sqrt(xn.var(axis=(0, 2, 3)) + 1e-3),
+                        rtol=1e-4, atol=1e-5)
 
 
 def test_activation_ops():

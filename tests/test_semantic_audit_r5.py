@@ -268,3 +268,73 @@ def test_multibox_target_prefix_valid_labels():
     _, _, cls_t = nd.invoke(
         "_contrib_MultiBoxTarget", [anchors, labels, cls_preds], {})
     assert (cls_t.asnumpy() == -1.0).all(), cls_t.asnumpy()
+
+
+# -------------------------------------------------------------- BatchNorm
+
+def test_module_batchnorm_updates_moving_stats():
+    """The reference BatchNorm mutates moving_mean/moving_var during every
+    training forward (batch_norm.cc:118-140).  The symbolic executor's
+    pure trace must fold the same updates into aux state — before round 5
+    Module-trained BN nets kept their init (0, 1) running stats and
+    normalized garbage at inference."""
+    from mxnet_tpu import sym
+    x = sym.Variable("data")
+    net = sym.BatchNorm(x, fix_gamma=False, momentum=0.9, name="bn")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    rng = np.random.RandomState(0)
+    data = rng.normal(5.0, 2.0, (200, 4)).astype(np.float32)
+    label = (data.sum(1) > 20).astype(np.float32)
+    mod.fit(mx.io.NDArrayIter(data, label, 20), num_epoch=3,
+            optimizer_params={"learning_rate": 0.1})
+    _, auxs = mod.get_params()
+    mm = auxs["bn_moving_mean"].asnumpy()
+    mv = auxs["bn_moving_var"].asnumpy()
+    # stats must have moved toward the true data moments (mean 5, var 4)
+    assert (np.abs(mm - 5.0) < 1.5).all(), mm
+    assert (np.abs(mv - 4.0) < 2.0).all(), mv
+    # and use_global_stats must NOT update
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(sym.BatchNorm(
+        sym.Variable("data"), use_global_stats=True, name="bn"),
+        num_hidden=2, name="fc"), name="softmax")
+    mod2 = mx.mod.Module(net2, context=mx.cpu())
+    mod2.fit(mx.io.NDArrayIter(data, label, 20), num_epoch=1,
+             optimizer_params={"learning_rate": 0.1})
+    _, auxs2 = mod2.get_params()
+    assert (auxs2["bn_moving_mean"].asnumpy() == 0).all()
+    assert (auxs2["bn_moving_var"].asnumpy() == 1).all()
+
+
+def test_batchnorm_third_output_is_inverse_std():
+    """The op's saved third output is 1/sqrt(var + eps) in train AND
+    use_global modes (batch_norm.cc:140-154 VARIANCE_TO_INVSTD) — the
+    output_mean_var contract is 'data_mean and the inverse of data_var'."""
+    from mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(4)
+    x = rng.normal(2.0, 3.0, (8, 3, 4, 4)).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.full(3, 0.5, np.float32)
+    mv = np.full(3, 2.0, np.float32)
+    eps = 1e-3
+    op = get_op("BatchNorm")
+
+    # train mode: batch stats
+    out, mean, invstd = op.apply(
+        {"eps": eps, "fix_gamma": False, "_training": True},
+        x, gamma, beta, mm, mv)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    assert_almost_equal(np.asarray(mean), bm, rtol=1e-5, atol=1e-5)
+    assert_almost_equal(np.asarray(invstd), 1.0 / np.sqrt(bv + eps),
+                        rtol=1e-5, atol=1e-6)
+
+    # use_global mode: moving stats, still inverse std
+    _, mean_g, invstd_g = op.apply(
+        {"eps": eps, "fix_gamma": False, "_training": False},
+        x, gamma, beta, mm, mv)
+    assert_almost_equal(np.asarray(mean_g), mm, rtol=1e-6)
+    assert_almost_equal(np.asarray(invstd_g), 1.0 / np.sqrt(mv + eps),
+                        rtol=1e-6)

@@ -190,18 +190,20 @@ def test_sync_batch_norm_cross_device_stats():
     attrs = {"_training": True, "fix_gamma": False}
 
     def shard_fn(xs):
-        out, mean, var = op.fcompute(attrs, xs, gamma, beta, mm, mv)
-        return out, mean, var
+        out, mean, invstd = op.fcompute(attrs, xs, gamma, beta, mm, mv)
+        return out, mean, invstd
 
-    out, mean, var = shard_map(
+    out, mean, invstd = shard_map(
         shard_fn, mesh=mesh, in_specs=(P("dp"),),
         out_specs=(P("dp"), P(), P()))(x)
-    # the synchronized stats must equal the GLOBAL batch stats
+    # the synchronized stats must equal the GLOBAL batch stats; the third
+    # output is the reference's inverse std (batch_norm.cc:140-154)
     want_mean = x.mean(axis=(0, 2, 3))
     want_var = x.var(axis=(0, 2, 3))
     np.testing.assert_allclose(np.asarray(mean), np.asarray(want_mean),
                                rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(np.asarray(var), np.asarray(want_var),
+    np.testing.assert_allclose(np.asarray(invstd),
+                               1.0 / np.sqrt(np.asarray(want_var) + 1e-3),
                                rtol=1e-4, atol=1e-5)
     ref_out, _, _ = op.fcompute(attrs, x, gamma, beta, mm, mv)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
