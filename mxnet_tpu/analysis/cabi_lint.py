@@ -1,0 +1,167 @@
+"""C-ABI defensiveness checker: pattern pass over ``src/c_api.cc``.
+
+The C ABI unpacks values returned by the Python bridge
+(``mxnet_tpu/capi_bridge.py``).  The bridge is Python — monkey-patchable,
+miswirable — so a wrong-typed return must surface through
+``tls_last_error``, never as a null/garbage dereference.  Two rules (the
+class the round-5 advisor flagged at ``src/c_api.cc:772``):
+
+* ABI001 — ``PyUnicode_AsUTF8`` result used without a null check.
+  ``PyUnicode_AsUTF8`` returns ``nullptr`` for non-``str`` objects and on
+  encoding failure; constructing a ``std::string`` from that is UB.  A use
+  counts as guarded when a ``nullptr`` comparison appears in the same
+  statement or within the next two lines (which is also what keeps the
+  repo's ``utf8_or_fail`` helper — whose body checks on the next line —
+  quiet).
+* ABI002 — ``PyTuple_GET_ITEM`` / ``PyList_GET_ITEM`` on an object never
+  type-checked in the enclosing function.  The ``GET_ITEM`` macros do no
+  checking at all; the guard is a ``PyTuple_Check(x)`` /
+  ``PyList_Check(x)`` (or a call to the repo's ``expect_tuple(x, ...)`` /
+  ``expect_list(x, ...)`` helpers) anywhere in the same function body.
+
+This is a line-pattern pass, not a parse: C++ parsing is overkill for two
+rules over one file, and the function segmentation (brace depth from
+column 0) is exact for the repo's clang-format style.  Suppression:
+``// mxlint: disable=ABI001`` on the offending line.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+from .common import Finding, apply_line_suppressions, relpath
+
+__all__ = ["run", "lint_file", "lint_source"]
+
+_UTF8_RE = re.compile(r"PyUnicode_AsUTF8\s*\(")
+_GET_ITEM_RE = re.compile(r"Py(Tuple|List)_GET_ITEM\s*\(\s*([A-Za-z_]\w*)")
+
+
+def _strip_comments(line):
+    """Remove // comments (good enough: the file has no /* */ bodies)."""
+    i = line.find("//")
+    return line if i < 0 else line[:i]
+
+
+def _functions(lines):
+    """Yield (name, start_idx, end_idx) for top-level brace blocks.
+
+    Depth is tracked from column 0; a block opening at depth 0 is a
+    function (or namespace — harmless: a namespace "function" just widens
+    the guard-search window for the helpers defined in it, and helper
+    bodies are re-segmented because nested depth-1 blocks inside a
+    namespace are also yielded).
+    """
+    depth = 0
+    spans = []
+    start = None
+    for idx, raw in enumerate(lines):
+        line = _strip_comments(raw)
+        for ch in line:
+            if ch == "{":
+                if depth == 0 and start is None:
+                    start = idx
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0 and start is not None:
+                    spans.append((start, idx))
+                    start = None
+    # name each span from the identifier before the signature's first "(",
+    # scanning back across the (possibly many-line) parameter list but not
+    # past the previous definition's "}" or ";"
+    out = []
+    for s, e in spans:
+        name = _name_before(lines, s)
+        # namespace blocks: re-segment their interior one level down
+        head = _strip_comments(lines[s])
+        if re.search(r"\bnamespace\b", head):
+            out.extend(_functions_at(lines, s + 1, e))
+        else:
+            out.append((name, s, e))
+    return out
+
+
+def _name_before(lines, s, lo=0):
+    for idx in range(s, max(lo - 1, s - 20), -1):
+        text = _strip_comments(lines[idx])
+        m = re.search(r"([A-Za-z_]\w*)\s*\(", text)
+        if m:
+            return m.group(1)
+        if idx != s and text.rstrip().endswith(("}", ";")):
+            break
+    return "<block>"
+
+
+def _functions_at(lines, lo, hi):
+    """Segment nested function bodies inside [lo, hi) at depth 1."""
+    depth = 0
+    out = []
+    start = None
+    for idx in range(lo, hi):
+        line = _strip_comments(lines[idx])
+        for ch in line:
+            if ch == "{":
+                if depth == 0:
+                    start = idx
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0 and start is not None:
+                    out.append((_name_before(lines, start, lo), start, idx))
+                    start = None
+    return out
+
+
+def lint_source(source, path):
+    lines = source.splitlines()
+    findings = []
+    for name, s, e in _functions(lines):
+        body = lines[s:e + 1]
+        stripped = [_strip_comments(l) for l in body]
+        text = "\n".join(stripped)
+        # ABI001 -------------------------------------------------------
+        for off, line in enumerate(stripped):
+            for m in _UTF8_RE.finditer(line):
+                window = "\n".join(stripped[off:off + 3])
+                if "nullptr" in window or "NULL" in window:
+                    continue
+                findings.append(Finding(
+                    "ABI001", path, s + off + 1, name,
+                    "PyUnicode_AsUTF8 result used without a null check "
+                    "(returns nullptr for non-str bridge returns)",
+                    detail="PyUnicode_AsUTF8"))
+        # ABI002 -------------------------------------------------------
+        flagged = set()
+        for off, line in enumerate(stripped):
+            for m in _GET_ITEM_RE.finditer(line):
+                kind, var = m.group(1), m.group(2)
+                if (kind, var) in flagged:
+                    continue
+                guards = (r"Py%s_Check\s*\(\s*%s\b" % (kind, var),
+                          r"expect_%s\s*\(\s*%s\b"
+                          % ("tuple" if kind == "Tuple" else "list", var))
+                if any(re.search(g, text) for g in guards):
+                    continue
+                flagged.add((kind, var))
+                findings.append(Finding(
+                    "ABI002", path, s + off + 1, name,
+                    "Py%s_GET_ITEM(%s, ...) without a Py%s_Check guard in "
+                    "this function (GET_ITEM does no type checking)"
+                    % (kind, var, kind), detail="%s:%s" % (kind, var)))
+    return apply_line_suppressions(findings, lines)
+
+
+def lint_file(filename, root):
+    with open(filename) as f:
+        source = f.read()
+    return lint_source(source, relpath(filename, root))
+
+
+def run(root, targets=("src/c_api.cc",)):
+    findings = []
+    for t in targets:
+        p = os.path.join(root, t)
+        if os.path.exists(p):
+            findings.extend(lint_file(p, root))
+    return findings

@@ -1,0 +1,191 @@
+"""Shared finding / baseline / suppression machinery for the mxlint passes.
+
+Design notes
+------------
+A finding's **key** is ``rule|path|scope|detail`` — deliberately line-free,
+so baselined findings stay suppressed while unrelated edits move code
+around.  Two identical violations in the same scope share a key (and are
+suppressed together); that trade keeps the baseline stable, and is called
+out in docs/LINT.md.
+
+Inline suppressions are ``# mxlint: disable=RULE1,RULE2`` (or ``//`` for
+C++) on the offending physical line; a bare ``mxlint: disable`` silences
+every rule on that line.  They are for *sanctioned* exceptions with an
+adjacent justification; everything else belongs in the baseline file where
+the burn-down is visible.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+__all__ = ["Finding", "Baseline", "load_baseline", "relpath",
+           "line_suppressions", "render_text", "render_json",
+           "DEFAULT_BASELINE"]
+
+DEFAULT_BASELINE = ".mxlint-baseline.json"
+
+# rule-family prefix -> owning pass (used to scope partial-pass baseline
+# updates so `--passes tracing --update-baseline` cannot drop the other
+# passes' suppressions)
+RULE_FAMILY_PASS = {"TRC": "tracing", "HSY": "tracing", "RNG": "tracing",
+                    "REG": "registry", "ABI": "cabi"}
+
+
+def pass_of_key(key):
+    """Owning pass of a finding/baseline key (None if unrecognized)."""
+    return RULE_FAMILY_PASS.get(key[:3])
+
+_SUPPRESS_RE = re.compile(
+    r"(?:#|//)\s*mxlint:\s*disable(?:=([A-Za-z0-9_,\s]+))?")
+
+
+class Finding(object):
+    """One rule violation at one site.
+
+    Parameters
+    ----------
+    rule : str, e.g. ``RNG001``.
+    path : repo-relative posix path of the offending file.
+    line : 1-based line number (display only; not part of the key).
+    scope : enclosing function / op / C function name ("<module>" at
+        top level).
+    message : human-readable description.
+    detail : short stable discriminator within the scope (e.g. the called
+        attribute); defaults to "".
+    """
+
+    __slots__ = ("rule", "path", "line", "scope", "message", "detail")
+
+    def __init__(self, rule, path, line, scope, message, detail=""):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = int(line)
+        self.scope = scope
+        self.message = message
+        self.detail = detail
+
+    @property
+    def key(self):
+        return "|".join((self.rule, self.path, self.scope, self.detail))
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "scope": self.scope, "detail": self.detail,
+                "message": self.message, "key": self.key}
+
+    def __repr__(self):
+        return "%s:%d: %s [%s] %s" % (self.path, self.line, self.rule,
+                                      self.scope, self.message)
+
+
+class Baseline(object):
+    """Checked-in suppression set: a list of finding keys with reasons."""
+
+    def __init__(self, entries=None, path=None):
+        self.path = path
+        # key -> reason
+        self.entries = dict(entries or {})
+
+    def is_suppressed(self, finding):
+        return finding.key in self.entries
+
+    def partition(self, findings):
+        """-> (new_findings, baselined_findings, stale_keys)."""
+        new, old = [], []
+        seen = set()
+        for f in findings:
+            if self.is_suppressed(f):
+                old.append(f)
+                seen.add(f.key)
+            else:
+                new.append(f)
+        stale = sorted(set(self.entries) - seen)
+        return new, old, stale
+
+    @staticmethod
+    def from_findings(findings, reason="baselined at introduction"):
+        entries = {}
+        for f in findings:
+            entries.setdefault(f.key, reason)
+        return Baseline(entries)
+
+    def save(self, path):
+        data = {
+            "version": 1,
+            "comment": ("mxlint suppression baseline: keys are "
+                        "rule|path|scope|detail (line-free; see "
+                        "docs/LINT.md).  Remove entries as sites are "
+                        "fixed; tools/mxlint.py --update-baseline "
+                        "regenerates."),
+            "suppressions": [
+                {"key": k, "reason": self.entries[k]}
+                for k in sorted(self.entries)],
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+        self.path = path
+
+
+def load_baseline(path):
+    """Load a baseline file; a missing file is an empty baseline."""
+    if path is None or not os.path.exists(path):
+        return Baseline(path=path)
+    with open(path) as f:
+        data = json.load(f)
+    entries = {e["key"]: e.get("reason", "")
+               for e in data.get("suppressions", [])}
+    return Baseline(entries, path=path)
+
+
+def relpath(path, root):
+    return os.path.relpath(os.path.abspath(path),
+                           os.path.abspath(root)).replace(os.sep, "/")
+
+
+def line_suppressions(source_line):
+    """Rules disabled on this physical line; None means 'all rules'."""
+    m = _SUPPRESS_RE.search(source_line)
+    if not m:
+        return ()
+    if m.group(1) is None:
+        return None
+    return tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+
+
+def apply_line_suppressions(findings, source_lines):
+    """Drop findings whose source line carries a matching inline disable."""
+    out = []
+    for f in findings:
+        if 1 <= f.line <= len(source_lines):
+            sup = line_suppressions(source_lines[f.line - 1])
+            if sup is None or (sup and f.rule in sup):
+                continue
+        out.append(f)
+    return out
+
+
+def render_text(findings, stale_keys=(), baselined_count=0):
+    lines = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        lines.append("%s:%d: %s [%s] %s"
+                     % (f.path, f.line, f.rule, f.scope, f.message))
+    lines.append("%d finding(s), %d baselined, %d stale baseline key(s)"
+                 % (len(findings), baselined_count, len(stale_keys)))
+    for k in stale_keys:
+        lines.append("stale baseline entry (fixed? remove it): %s" % k)
+    return "\n".join(lines)
+
+
+def render_json(findings, stale_keys=(), baselined=(), report=None):
+    doc = {
+        "version": 1,
+        "findings": [f.to_dict() for f in findings],
+        "baselined": [f.to_dict() for f in baselined],
+        "stale_baseline_keys": list(stale_keys),
+    }
+    if report is not None:
+        doc["registry_report"] = report
+    return json.dumps(doc, indent=2)

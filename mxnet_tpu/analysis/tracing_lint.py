@@ -1,0 +1,436 @@
+"""Tracing-safety linter: AST pass over ``mxnet_tpu/``.
+
+Three rule families, one per statically-detectable way eager-looking Python
+breaks (or silently de-optimizes) a traced JAX/XLA program:
+
+``TRC`` — tracer concretization inside traced scopes.  An fcompute body (or
+anything under ``jax.jit``) runs under abstract tracing; ``float(x)`` /
+``x.item()`` / ``np.asarray(x)`` on a traced array raises
+``ConcretizationTypeError`` on the paths the tests happen not to cover, or
+forces a silent host round-trip on the ones they do.
+
+  * TRC001 — ``.item()`` / ``.tolist()`` / ``.asnumpy()`` on a traced value.
+  * TRC002 — ``float()`` / ``int()`` / ``bool()`` / ``complex()`` on a
+    traced value.  (``int(x.shape[0])`` is fine: shapes are static under
+    tracing and the taint tracker knows it.)
+  * TRC003 — ``np.asarray`` / ``np.array`` on a traced value.
+
+``HSY`` — implicit host syncs inside traced scopes.
+
+  * HSY001 — ``jax.device_get`` / ``.block_until_ready()`` inside an
+    fcompute body.
+  * HSY002 — a ``numpy`` function applied to a traced value (host
+    materialization mid-kernel).  numpy on *static* values (attrs, shapes)
+    is idiomatic and not flagged.
+
+``RNG`` — numpy global-RNG discipline.  The round-5 FGSM flakiness came
+from initializers drawing from numpy's process-global RNG, which
+``mx.random.seed`` does not control.  Library code must draw from the
+framework stream (``mxnet_tpu.random.derived_numpy_rng()``) or an explicit
+``Generator`` / ``RandomState``.
+
+  * RNG001 — ``np.random.<draw>()`` (global state) outside the sanctioned
+    seeding module ``mxnet_tpu/random.py``.
+  * RNG002 — ``np.random.seed()`` anywhere in library code: reseeding the
+    process-global stream stomps user/test seeding.
+
+Traced scopes are found syntactically: functions decorated with
+``@register(...)`` (without ``no_jit=True``), functions in ``ops/*.py``
+whose first parameter is ``attrs`` (the fcompute convention), functions
+decorated with ``jax.jit`` / ``partial(jax.jit, ...)``, and every function
+nested inside one of those.  Taint starts at the array parameters (the
+positionals after ``attrs``, or all parameters for jit-decorated and
+nested functions) and propagates through assignments; ``.shape`` /
+``.ndim`` / ``.size`` / ``.dtype`` / ``len()`` off-ramps end it, which is
+what keeps ``int(np.prod(x.shape))`` quiet.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .common import Finding, apply_line_suppressions, relpath
+
+__all__ = ["run", "lint_file", "lint_source"]
+
+# attribute reads that yield STATIC (trace-time) python values
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "aval", "sharding",
+                 "itemsize", "nbytes"}
+# builtins that concretize their argument
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+# method calls that concretize their receiver
+_CONCRETIZE_METHODS = {"item", "tolist", "asnumpy"}
+# builtins whose result is static regardless of argument taint
+_STATIC_FUNCS = {"len", "isinstance", "type", "getattr", "hasattr", "id",
+                 "repr", "str"}
+# np.random attributes that are NOT draws from the global state
+_RNG_SANCTIONED = {"Generator", "RandomState", "default_rng", "SeedSequence",
+                   "PCG64", "PCG64DXSM", "MT19937", "Philox", "SFC64",
+                   "BitGenerator", "bit_generator"}
+_SANCTIONED_MODULES = ("random.py",)  # relative to the mxnet_tpu package
+
+
+def _numpy_aliases(tree):
+    """Names bound to the numpy module / numpy.random in this module."""
+    np_names, rng_names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    np_names.add(a.asname or "numpy")
+                elif a.name == "numpy.random":
+                    rng_names.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "numpy":
+                for a in node.names:
+                    if a.name == "random":
+                        rng_names.add(a.asname or "random")
+    return np_names, rng_names
+
+
+def _is_np_attr(node, np_names):
+    """node is ``<np-alias>.<attr>`` -> attr name, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id in np_names):
+        return node.attr
+    return None
+
+
+def _rng_call_name(func, np_names, rng_names):
+    """``np.random.X`` / ``<random-alias>.X`` call -> X, else None."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    base = func.value
+    if (isinstance(base, ast.Attribute) and base.attr == "random"
+            and isinstance(base.value, ast.Name)
+            and base.value.id in np_names):
+        return func.attr
+    if isinstance(base, ast.Name) and base.id in rng_names:
+        return func.attr
+    return None
+
+
+def _decorator_info(fn):
+    """-> (is_register, skip, is_jit) from the decorator list.
+
+    ``skip`` covers declared-eager handlers: ``no_jit=True`` registrations
+    and ``@register_sparse`` fcompute_ex handlers (the FComputeEx analog
+    runs at the NDArray level and legitimately touches numpy).
+    """
+    is_register = skip = is_jit = False
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = None
+        if isinstance(target, ast.Attribute):
+            name = target.attr
+        elif isinstance(target, ast.Name):
+            name = target.id
+        if name == "register":
+            is_register = True
+            if isinstance(dec, ast.Call):
+                for kw in dec.keywords:
+                    if (kw.arg == "no_jit"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value):
+                        skip = True
+        if name == "register_sparse":
+            skip = True
+        if name == "jit":
+            is_jit = True
+        if (isinstance(dec, ast.Call) and name == "partial" and dec.args
+                and isinstance(dec.args[0], ast.Attribute)
+                and dec.args[0].attr == "jit"):
+            is_jit = True
+    return is_register, skip, is_jit
+
+
+class _Taint(object):
+    """Expression classifier over a set of tainted (traced-array) names."""
+
+    def __init__(self, names):
+        self.names = set(names)
+
+    def traced(self, node):
+        """Does evaluating ``node`` depend on a traced array value?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.traced(node.value)
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _STATIC_FUNCS):
+                return False
+            parts = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Attribute):
+                parts.append(node.func.value)
+            return any(self.traced(p) for p in parts)
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return False
+        return any(self.traced(c) for c in ast.iter_child_nodes(node))
+
+    def assign(self, target, is_traced):
+        if not is_traced:
+            return
+        if isinstance(target, ast.Name):
+            self.names.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self.assign(el, True)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, True)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path, source, in_ops_dir, sanctioned_rng):
+        self.path = path
+        self.in_ops_dir = in_ops_dir
+        self.sanctioned_rng = sanctioned_rng
+        self.findings = []
+        tree = ast.parse(source, filename=path)
+        self.np_names, self.rng_names = _numpy_aliases(tree)
+        self._rng_scan(tree)
+        self._find_traced_scopes(tree)
+
+    # -- RNG rules apply module-wide -------------------------------------
+    def _rng_scan(self, tree):
+        if self.sanctioned_rng:
+            return
+        # enclosing (outermost) function name per node — ast.walk is BFS,
+        # so the first setdefault wins; outermost keeps finding keys stable
+        scopes = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for child in ast.walk(node):
+                    scopes.setdefault(child, node.name)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _rng_call_name(node.func, self.np_names, self.rng_names)
+            if fn is None or fn in _RNG_SANCTIONED:
+                continue
+            scope = scopes.get(node, "<module>")
+            if fn == "seed":
+                self._add("RNG002", node, scope,
+                          "np.random.seed() reseeds numpy's process-global "
+                          "stream; library code must not stomp user/test "
+                          "seeding", detail=fn)
+            else:
+                self._add("RNG001", node, scope,
+                          "np.random.%s() draws from numpy's GLOBAL RNG, "
+                          "which mx.random.seed does not control; use "
+                          "mxnet_tpu.random.derived_numpy_rng() or an "
+                          "explicit Generator" % fn, detail=fn)
+
+    # -- traced-scope discovery ------------------------------------------
+    def _find_traced_scopes(self, tree, parents=()):
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                is_reg, skip, is_jit = _decorator_info(node)
+                args = node.args.posonlyargs + node.args.args
+                is_fcompute = (self.in_ops_dir and args
+                               and args[0].arg == "attrs")
+                if skip:
+                    continue  # runs eagerly by contract
+                if is_reg or is_fcompute or is_jit:
+                    if is_jit:
+                        tainted = {a.arg for a in args}
+                    else:
+                        # fcompute: positionals after attrs are arrays;
+                        # defaulted trailing params are static helpers
+                        # EXCEPT a None default (optional array input,
+                        # e.g. Convolution's bias under no_bias)
+                        n_static = 0
+                        for a, d in zip(reversed(args),
+                                        reversed(node.args.defaults)):
+                            if not (isinstance(d, ast.Constant)
+                                    and d.value is None):
+                                n_static += 1
+                        keep = args[1:len(args) - n_static or None]
+                        tainted = {a.arg for a in keep}
+                        if node.args.vararg:
+                            tainted.add(node.args.vararg.arg)
+                    self._lint_traced(node, tainted)
+                else:
+                    self._find_traced_scopes(node, parents + (node,))
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                                   ast.While, ast.ClassDef)):
+                self._find_traced_scopes(node, parents)
+
+    # -- the traced-scope walk -------------------------------------------
+    def _lint_traced(self, fn, tainted):
+        taint = _Taint(tainted)
+        self._walk_traced(fn.body, fn.name, taint, root=fn)
+
+    def _walk_traced(self, body, scope, taint, root):
+        nested = []
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # deferred so every call site (hence the final taint state)
+                # is known before deciding which params are traced
+                nested.append(stmt)
+                continue
+            if isinstance(stmt, ast.Assign):
+                t = taint.traced(stmt.value)
+                for target in stmt.targets:
+                    taint.assign(target, t)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None and taint.traced(stmt.value):
+                    taint.assign(stmt.target, True)
+            elif isinstance(stmt, ast.For):
+                taint.assign(stmt.target, taint.traced(stmt.iter))
+            # check only this statement's own (header) expressions; nested
+            # statement bodies are recursed below so they are seen exactly
+            # once, with the taint state current at that point
+            for expr in self._own_exprs(stmt):
+                self._check_expr_calls(expr, scope, taint)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._walk_traced(sub, scope, taint, root)
+            for h in getattr(stmt, "handlers", ()):
+                self._walk_traced(h.body, scope, taint, root)
+        for stmt in nested:
+            inner = _Taint(taint.names)
+            inner.names.update(self._nested_param_taint(stmt, taint, root))
+            self._walk_traced(stmt.body, scope + "." + stmt.name, inner,
+                              root)
+
+    @staticmethod
+    def _nested_param_taint(fn, taint, root):
+        """Which of a nested def's params carry traced values.
+
+        Direct call sites in the enclosing function decide per-position;
+        a function referenced as a bare name (a ``fori_loop`` / ``vmap`` /
+        ``scan`` callback) gets every param tainted — the transform feeds
+        it tracers.
+        """
+        params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+        all_params = set(params)
+        if fn.args.vararg:
+            all_params.add(fn.args.vararg.arg)
+        calls = [node for node in ast.walk(root)
+                 if isinstance(node, ast.Call)
+                 and isinstance(node.func, ast.Name)
+                 and node.func.id == fn.name]
+        # a reference outside a direct-call func position means the
+        # function is handed to a transform as a callback
+        func_names = {id(c.func) for c in calls}
+        as_callback = any(
+            isinstance(n, ast.Name) and n.id == fn.name
+            and id(n) not in func_names
+            for n in ast.walk(root))
+        if as_callback or not calls:
+            return all_params
+        tainted = set()
+        for call in calls:
+            for pos, arg in enumerate(call.args):
+                if pos < len(params) and taint.traced(arg):
+                    tainted.add(params[pos])
+            for kw in call.keywords:
+                if kw.arg in all_params and taint.traced(kw.value):
+                    tainted.add(kw.arg)
+        return tainted
+
+    @staticmethod
+    def _own_exprs(stmt):
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, ast.For):
+            return [stmt.iter]
+        if isinstance(stmt, ast.With):
+            return [it.context_expr for it in stmt.items]
+        if isinstance(stmt, ast.Try):
+            return []
+        # simple statements have no nested statement bodies
+        return [stmt]
+
+    def _check_expr_calls(self, node, scope, taint):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub, scope, taint)
+
+    def _check_call(self, node, scope, taint):
+        func = node.func
+        # TRC002: float/int/bool/complex on traced value
+        if (isinstance(func, ast.Name) and func.id in _CONCRETIZERS
+                and node.args and taint.traced(node.args[0])):
+            self._add("TRC002", node, scope,
+                      "%s() on a traced array concretizes the tracer "
+                      "(ConcretizationTypeError under jit; host sync in "
+                      "eager)" % func.id, detail=func.id)
+            return
+        if isinstance(func, ast.Attribute):
+            # TRC001: .item()/.tolist()/.asnumpy() on traced value
+            if (func.attr in _CONCRETIZE_METHODS
+                    and taint.traced(func.value)):
+                self._add("TRC001", node, scope,
+                          ".%s() on a traced array concretizes the tracer"
+                          % func.attr, detail=func.attr)
+                return
+            # HSY001: explicit host syncs
+            if func.attr == "block_until_ready":
+                self._add("HSY001", node, scope,
+                          ".block_until_ready() inside a traced scope is "
+                          "a host sync", detail=func.attr)
+                return
+            if (func.attr == "device_get"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "jax"):
+                self._add("HSY001", node, scope,
+                          "jax.device_get inside a traced scope is a host "
+                          "sync", detail=func.attr)
+                return
+            np_attr = _is_np_attr(func, self.np_names)
+            if np_attr is not None:
+                parts = list(node.args) + [kw.value for kw in node.keywords]
+                if any(taint.traced(p) for p in parts):
+                    rule = ("TRC003" if np_attr in ("asarray", "array")
+                            else "HSY002")
+                    msg = ("np.%s on a traced array %s" %
+                           (np_attr,
+                            "concretizes the tracer" if rule == "TRC003"
+                            else "materializes it on the host mid-kernel"))
+                    self._add(rule, node, scope, msg, detail=np_attr)
+
+    def _add(self, rule, node, scope, message, detail=""):
+        self.findings.append(Finding(
+            rule, self.path, getattr(node, "lineno", 0), scope, message,
+            detail=detail))
+
+
+def lint_source(source, path, in_ops_dir=False, sanctioned_rng=False):
+    """Lint one python source string; returns a list of Findings."""
+    try:
+        linter = _Linter(path, source, in_ops_dir, sanctioned_rng)
+    except SyntaxError as e:
+        return [Finding("TRC000", path, e.lineno or 0, "<module>",
+                        "syntax error: %s" % e.msg)]
+    return apply_line_suppressions(linter.findings, source.splitlines())
+
+
+def lint_file(filename, root):
+    with open(filename) as f:
+        source = f.read()
+    rel = relpath(filename, root)
+    in_ops_dir = "/ops/" in "/" + rel
+    sanctioned = any(rel.endswith("mxnet_tpu/" + m)
+                     for m in _SANCTIONED_MODULES)
+    return lint_source(source, rel, in_ops_dir=in_ops_dir,
+                       sanctioned_rng=sanctioned)
+
+
+def run(root, package_dir=None):
+    """Lint every .py under ``package_dir`` (default ``<root>/mxnet_tpu``)."""
+    package_dir = package_dir or os.path.join(root, "mxnet_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fn), root))
+    return findings

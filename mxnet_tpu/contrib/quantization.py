@@ -104,12 +104,15 @@ def _collect_thresholds(arr, mode="minmax", num_bins=2001,
         counts = nonzero[:num_quantized * merged].reshape(
             num_quantized, merged).sum(axis=1)
         counts[-1] += nonzero[num_quantized * merged:].sum()
-        tail = nonzero[(num_quantized - 1) * merged:].sum()
         with _np.errstate(divide="ignore", invalid="ignore"):
             fill = _np.where(counts > 0, sums / _np.maximum(counts, 1), 0.0)
         q[:num_quantized * merged] = _np.repeat(fill, merged)
-        if tail:
-            q[(num_quantized - 1) * merged:] = sums[-1] / tail
+        # the last level spans [(num_quantized-1)*merged, len): counts[-1]
+        # already includes the overflow bins, so fill[-1] is exactly the
+        # reference's sums[-1]/nonzero-count expansion for that whole span
+        # (and 0 when the span has no nonzero source bins — the mask below
+        # zeroes those positions either way)
+        q[(num_quantized - 1) * merged:] = fill[-1]
         q[~nonzero] = 0.0
         p = _smooth_distribution(p)
         q = _smooth_distribution(q)

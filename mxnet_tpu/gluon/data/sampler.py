@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import numpy as _np
 
+from ... import random as _mxrand
+
 
 class Sampler:
     def __iter__(self):
@@ -29,7 +31,9 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         indices = _np.arange(self._length)
-        _np.random.shuffle(indices)
+        # draw from the framework stream so mx.random.seed controls epoch
+        # order (numpy GLOBAL state is invisible to it — the FGSM bug class)
+        _mxrand.derived_numpy_rng().shuffle(indices)
         return iter(indices.tolist())
 
     def __len__(self):

@@ -17,6 +17,7 @@ import random as _pyrandom
 import numpy as _np
 
 from ..base import MXNetError
+from .. import random as _mxrand
 from ..ndarray import NDArray, array
 from .. import recordio
 from ..io.io import DataIter, DataBatch, DataDesc
@@ -317,9 +318,15 @@ class LightingAug(Augmenter):
         self.alphastd = alphastd
         self.eigval = _np.asarray(eigval)
         self.eigvec = _np.asarray(eigvec)
+        # one framework-derived stream, captured at CONSTRUCTION time (on
+        # the builder's thread): seed before building the pipeline.  Doing
+        # this per __call__ would re-split a jax key per image, and under
+        # threaded DataLoader workers would read a fresh thread-local
+        # framework key that mx.random.seed never touched.
+        self._rng = _mxrand.derived_numpy_rng()
 
     def __call__(self, src):
-        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        alpha = self._rng.normal(0, self.alphastd, size=(3,))
         rgb = _np.dot(self.eigvec * alpha, self.eigval)
         return array(src.asnumpy().astype(_np.float32) + rgb)
 

@@ -341,8 +341,12 @@ def retain(arr, indices):
 
 
 def rand_sparse_ndarray(shape, stype, density=0.05, dtype=None):
-    dense = _np.random.uniform(-1, 1, shape)
-    mask = _np.random.uniform(0, 1, shape) < density
+    # test-support entropy, like test_utils.rand_*: deliberately numpy's
+    # global RNG (the suite's conftest seeds np.random per test), so the
+    # framework stream's draw sequence stays undisturbed for
+    # mx.random.seed reproducibility tests
+    dense = _np.random.uniform(-1, 1, shape)      # mxlint: disable=RNG001
+    mask = _np.random.uniform(0, 1, shape) < density  # mxlint: disable=RNG001
     dense = (dense * mask).astype(dtype or _np.float32)
     if stype == "row_sparse":
         return row_sparse_array(dense, shape=shape), dense

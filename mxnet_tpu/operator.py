@@ -300,7 +300,9 @@ def _install():
         return len(prop.list_outputs())
 
     op_registry.register("Custom", num_outputs=_n_outputs,
-                         mode_dependent=True, no_jit=True)(_custom_fcompute)
+                         mode_dependent=True, no_jit=True,
+                         shape_rule="CustomOpProp.infer_shape",
+                         dtype_rule="CustomOpProp.infer_type")(_custom_fcompute)
 
     from . import ndarray as nd_mod
     nd_mod.Custom = _imperative_custom

@@ -145,10 +145,15 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
             0, M, body, (jnp.full((A,), -1, jnp.int32), iou_v))
         forced = anchor_gt >= 0
         # stage 2 (:141-168): remaining anchors match their best gt if IoU
-        # STRICTLY exceeds the threshold
+        # STRICTLY exceeds the threshold — and the whole stage only runs
+        # `if (overlap_threshold > 0)` (multibox_target.cc guard; a static
+        # Python check here since the attr is compile-time)
         best_gt = jnp.argmax(iou_v, axis=1).astype(jnp.int32)
         best_iou = jnp.max(iou_v, axis=1)
-        matched = forced | ((best_iou > iou_thresh) & (num_valid > 0))
+        if iou_thresh > 0:
+            matched = forced | ((best_iou > iou_thresh) & (num_valid > 0))
+        else:
+            matched = forced
         gt_idx = jnp.where(forced, anchor_gt, best_gt)
         gt = gt_boxes[jnp.clip(gt_idx, 0, M - 1)]
         # encode: (center offset / variance)
@@ -415,7 +420,8 @@ def _generate_anchors(feature_stride, ratios, scales):
 
 
 @register("_contrib_Proposal",
-          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1)
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+          no_grad="index-selected rois (outputs pass stop_gradient)")
 def _proposal(attrs, cls_prob, bbox_pred, im_info):
     """RPN proposal generation (src/operator/contrib/proposal.cc).
 

@@ -112,7 +112,7 @@ alias("_copy", "identity")
 alias("stop_gradient", "BlockGrad_impl") if False else None
 
 
-@register("BlockGrad")
+@register("BlockGrad", no_grad="blocks-gradient")
 def _block_grad(attrs, x):
     import jax
     return jax.lax.stop_gradient(x)

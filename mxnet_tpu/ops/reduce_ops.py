@@ -81,7 +81,7 @@ def _norm(attrs, x):
     return out
 
 
-@register("argmax")
+@register("argmax", no_grad=True)
 def _argmax(attrs, x):
     jnp = _jnp()
     axis = attrs.get("axis")
@@ -95,7 +95,7 @@ def _argmax(attrs, x):
     return out.astype(jnp.float32)
 
 
-@register("argmin")
+@register("argmin", no_grad=True)
 def _argmin(attrs, x):
     jnp = _jnp()
     axis = attrs.get("axis")
@@ -109,7 +109,7 @@ def _argmin(attrs, x):
     return out.astype(jnp.float32)
 
 
-@register("argmax_channel")
+@register("argmax_channel", no_grad=True)
 def _argmax_channel(attrs, x):
     jnp = _jnp()
     return jnp.argmax(x, axis=1).astype(jnp.float32)

@@ -52,7 +52,8 @@ class Op:
 
     def __init__(self, name, fcompute, num_outputs=1, needs_rng=False,
                  mode_dependent=False, no_jit=False, doc=None,
-                 visible_outputs=None, dynamic_attrs=()):
+                 visible_outputs=None, dynamic_attrs=(), no_grad=False,
+                 shape_rule=None, dtype_rule=None):
         self.name = name
         self.fcompute = fcompute
         self.num_outputs = num_outputs
@@ -63,6 +64,18 @@ class Op:
         self.needs_rng = needs_rng
         self.mode_dependent = mode_dependent
         self.no_jit = no_jit
+        # audit metadata (mxnet_tpu/analysis/registry_audit.py).  Jitted
+        # ops get shape/dtype inference from XLA tracing and gradients
+        # from jax.vjp over fcompute; these markers declare the exceptions:
+        #   no_grad    — True / reason-string / callable(attrs)->bool for
+        #                index- or integer-valued and gradient-blocking ops
+        #                (the reference's MakeZeroGradNodes analog)
+        #   shape_rule — how a no_jit op's output shape is determined
+        #                (e.g. "attrs": computed from attributes alone)
+        #   dtype_rule — same for the output dtype
+        self.no_grad = no_grad
+        self.shape_rule = shape_rule
+        self.dtype_rule = dtype_rule
         # attrs traced as scalar ARGUMENTS instead of baked-in statics, so a
         # per-step value (optimizer lr with bias correction / schedule) hits
         # the jit cache instead of recompiling the update kernel every step

@@ -126,12 +126,14 @@ def _reshape_like(attrs, x, y):
     return x.reshape(y.shape)
 
 
-@register("shape_array", no_jit=True)
+@register("shape_array", no_jit=True, no_grad=True,
+          shape_rule="input-rank", dtype_rule="int64")
 def _shape_array(attrs, x):
     return _jnp().asarray(_np.array(x.shape, dtype=_np.int64))
 
 
-@register("size_array", no_jit=True)
+@register("size_array", no_jit=True, no_grad=True,
+          shape_rule="scalar", dtype_rule="int64")
 def _size_array(attrs, x):
     n = 1
     for s in x.shape:
@@ -355,7 +357,7 @@ def _embedding(attrs, data, weight):
     return jnp.take(weight, idx, axis=0)
 
 
-@register("one_hot")
+@register("one_hot", no_grad=True)
 def _one_hot(attrs, indices):
     import jax
     jnp = _jnp()
@@ -411,7 +413,7 @@ def _sort(attrs, x):
     return out
 
 
-@register("argsort")
+@register("argsort", no_grad=True)
 def _argsort(attrs, x):
     jnp = _jnp()
     axis = attrs.get("axis", -1)
@@ -428,7 +430,9 @@ def _argsort(attrs, x):
     return out.astype(_np.dtype(dtype))
 
 
-@register("topk", num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1)
+@register("topk", num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1,
+          no_grad=lambda attrs: attrs.get("ret_typ", "indices")
+          not in ("value", "both"))  # "both" has a differentiable value out
 def _topk(attrs, x):
     import jax
     jnp = _jnp()
@@ -508,7 +512,7 @@ def _khatri_rao(attrs, *mats):
 # init-style ops (used by the symbolic path & generated namespaces)
 # ---------------------------------------------------------------------------
 
-@register("_zeros", no_jit=True)
+@register("_zeros", no_jit=True, shape_rule="attrs", dtype_rule="attrs")
 def _zeros_op(attrs, *unused):
     jnp = _jnp()
     dtype = attrs.get("dtype", "float32")
@@ -516,7 +520,7 @@ def _zeros_op(attrs, *unused):
                      dtype=jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype))
 
 
-@register("_ones", no_jit=True)
+@register("_ones", no_jit=True, shape_rule="attrs", dtype_rule="attrs")
 def _ones_op(attrs, *unused):
     jnp = _jnp()
     dtype = attrs.get("dtype", "float32")
@@ -524,7 +528,7 @@ def _ones_op(attrs, *unused):
                     dtype=jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype))
 
 
-@register("_full", no_jit=True)
+@register("_full", no_jit=True, shape_rule="attrs", dtype_rule="attrs")
 def _full_op(attrs, *unused):
     jnp = _jnp()
     dtype = attrs.get("dtype", "float32")
@@ -532,7 +536,7 @@ def _full_op(attrs, *unused):
                     dtype=_np.dtype(dtype))
 
 
-@register("_arange", no_jit=True)
+@register("_arange", no_jit=True, shape_rule="attrs", dtype_rule="attrs")
 def _arange_op(attrs, *unused):
     jnp = _jnp()
     dtype = attrs.get("dtype", "float32")
@@ -546,7 +550,7 @@ def _arange_op(attrs, *unused):
     return v
 
 
-@register("_eye", no_jit=True)
+@register("_eye", no_jit=True, shape_rule="attrs", dtype_rule="attrs")
 def _eye_op(attrs, *unused):
     jnp = _jnp()
     dtype = attrs.get("dtype", "float32")

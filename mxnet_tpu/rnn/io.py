@@ -4,11 +4,11 @@
 from __future__ import annotations
 
 import bisect
-import random
 
 import numpy as np
 
 from ..io.io import DataIter, DataBatch, DataDesc
+from .. import random as _mxrand
 from .. import ndarray as nd
 
 
@@ -104,9 +104,13 @@ class BucketSentenceIter(DataIter):
 
     def reset(self):
         self.curr_idx = 0
-        random.shuffle(self.idx)
+        # one framework-derived stream for BOTH shuffles (bucket visit
+        # order and within-bucket rows), so mx.random.seed controls the
+        # whole epoch order — neither python's nor numpy's global state
+        rng = _mxrand.derived_numpy_rng()
+        rng.shuffle(self.idx)
         for rows in self.data:
-            np.random.shuffle(rows)
+            rng.shuffle(rows)
         self.nddata = []
         self.ndlabel = []
         for rows in self.data:

@@ -103,11 +103,13 @@ int fail() {
   PyObject *s = PyObject_Str(exc);
   PyObject *t = PyObject_Str(reinterpret_cast<PyObject *>(Py_TYPE(exc)));
   tls_last_error.clear();
-  if (t != nullptr) {
-    tls_last_error += PyUnicode_AsUTF8(t);
+  const char *ts = (t != nullptr) ? PyUnicode_AsUTF8(t) : nullptr;
+  if (ts != nullptr) {
+    tls_last_error += ts;
     tls_last_error += ": ";
   }
-  tls_last_error += (s != nullptr) ? PyUnicode_AsUTF8(s) : "<unprintable>";
+  const char *ss = (s != nullptr) ? PyUnicode_AsUTF8(s) : nullptr;
+  tls_last_error += (ss != nullptr) ? ss : "<unprintable>";
   Py_XDECREF(s);
   Py_XDECREF(t);
   Py_DECREF(exc);
@@ -117,6 +119,45 @@ int fail() {
 int fail_msg(const char *msg) {
   tls_last_error = msg;
   return -1;
+}
+
+// Defensive views over bridge returns.  The bridge is Python —
+// monkey-patchable, miswirable — so a wrong-typed return must surface
+// through tls_last_error, never as a null/garbage dereference (PyUnicode_
+// AsUTF8 returns nullptr for non-str; the GET_ITEM macros check nothing).
+
+// UTF-8 view of a bridge-returned object, or nullptr with the error set.
+const char *utf8_or_fail(PyObject *o, const char *who) {
+  if (o == nullptr || !PyUnicode_Check(o)) {
+    tls_last_error = std::string(who) + ": bridge returned a non-string";
+    return nullptr;
+  }
+  const char *s = PyUnicode_AsUTF8(o);
+  if (s == nullptr) fail();  // encoding failure: capture the Python error
+  return s;
+}
+
+// 0 if r is a list, else -1 with the error set (r is NOT released: every
+// caller owns r and releases it on all paths).
+int expect_list(PyObject *r, const char *who) {
+  if (r == nullptr || !PyList_Check(r)) {
+    tls_last_error = std::string(who) + ": bridge did not return a list";
+    return -1;
+  }
+  return 0;
+}
+
+// 0 if r is a tuple of exactly `size` items (any size when size < 0).
+int expect_tuple(PyObject *r, Py_ssize_t size, const char *who) {
+  if (r == nullptr || !PyTuple_Check(r)) {
+    tls_last_error = std::string(who) + ": bridge did not return a tuple";
+    return -1;
+  }
+  if (size >= 0 && PyTuple_Size(r) != size) {
+    tls_last_error = std::string(who) + ": bridge tuple has wrong arity";
+    return -1;
+  }
+  return 0;
 }
 
 PyObject *bridge() {  // borrowed ref, cached; GIL must be held
@@ -229,11 +270,19 @@ MXTPU_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
   PyObject *r = bcall("shape_of", args);
   Py_DECREF(args);
   if (r == nullptr) return fail();
+  if (expect_tuple(r, -1, "MXNDArrayGetShape") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyTuple_Size(r);
   tls_ret.shape.resize(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
     tls_ret.shape[i] =
         static_cast<mx_uint>(PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  }
+  if (PyErr_Occurred()) {  // non-int element: surface it, don't return junk
+    Py_DECREF(r);
+    return fail();
   }
   Py_DECREF(r);
   *out_dim = static_cast<mx_uint>(n);
@@ -322,12 +371,21 @@ MXTPU_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
   Gil gil;
   PyObject *r = bcall("all_op_names", nullptr);
   if (r == nullptr) return fail();
+  if (expect_list(r, "MXListAllOpNames") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyList_Size(r);
   tls_ret.strings.clear();
   tls_ret.cstrs.clear();
   tls_ret.strings.reserve(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
-    tls_ret.strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+    const char *s = utf8_or_fail(PyList_GET_ITEM(r, i), "MXListAllOpNames");
+    if (s == nullptr) {
+      Py_DECREF(r);
+      return -1;
+    }
+    tls_ret.strings.emplace_back(s);
   }
   Py_DECREF(r);
   for (auto &s : tls_ret.strings) tls_ret.cstrs.push_back(s.c_str());
@@ -385,6 +443,10 @@ MXTPU_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
   PyObject *r = bcall("invoke", args);
   Py_DECREF(args);
   if (r == nullptr) return fail();
+  if (expect_list(r, "MXImperativeInvoke") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyList_Size(r);
   if (*num_outputs > 0) {
     // caller-provided outputs were written in place; nothing to hand back
@@ -544,7 +606,12 @@ MXTPU_DLL int MXKVStoreGetType(KVStoreHandle handle, const char **out) {
   PyObject *r = bcall("kv_type", args);
   Py_DECREF(args);
   if (r == nullptr) return fail();
-  tls_ret.strings.assign(1, PyUnicode_AsUTF8(r));
+  const char *s = utf8_or_fail(r, "MXKVStoreGetType");
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return -1;
+  }
+  tls_ret.strings.assign(1, s);
   Py_DECREF(r);
   *out = tls_ret.strings[0].c_str();
   return 0;
@@ -681,11 +748,19 @@ MXTPU_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
   PyObject *r = bcall("pred_output_shape", args);
   Py_DECREF(args);
   if (r == nullptr) return fail();
+  if (expect_tuple(r, -1, "MXPredGetOutputShape") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyTuple_Size(r);
   tls_ret.shape.resize(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
     tls_ret.shape[i] =
         static_cast<mx_uint>(PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  }
+  if (PyErr_Occurred()) {  // non-int element: surface it, don't return junk
+    Py_DECREF(r);
+    return fail();
   }
   Py_DECREF(r);
   *shape_ndim = static_cast<mx_uint>(n);
@@ -764,12 +839,21 @@ namespace {
 int return_str_list(PyObject *r, mx_uint *out_size,
                     const char ***out_array) {
   if (r == nullptr) return fail();
+  if (expect_list(r, "return_str_list") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyList_Size(r);
   tls_ret.strings.clear();
   tls_ret.cstrs.clear();
   tls_ret.strings.reserve(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
-    tls_ret.strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+    const char *s = utf8_or_fail(PyList_GET_ITEM(r, i), "return_str_list");
+    if (s == nullptr) {
+      Py_DECREF(r);
+      return -1;
+    }
+    tls_ret.strings.emplace_back(s);
   }
   Py_DECREF(r);
   for (auto &s : tls_ret.strings) tls_ret.cstrs.push_back(s.c_str());
@@ -789,8 +873,9 @@ int sym_str_list(const char *fn, SymbolHandle symbol, mx_uint *out_size,
 }
 
 // Unpack one list[tuple[int]] group into slot g of the return store.
-void store_shape_group(PyObject *lst, int g, mx_uint *size,
-                       const mx_uint **ndim, const mx_uint ***data) {
+int store_shape_group(PyObject *lst, int g, mx_uint *size,
+                      const mx_uint **ndim, const mx_uint ***data) {
+  if (expect_list(lst, "MXSymbolInferShape") != 0) return -1;
   Py_ssize_t n = PyList_Size(lst);
   auto &shapes = tls_ret.group_shapes[g];
   auto &ndims = tls_ret.group_ndim[g];
@@ -801,6 +886,7 @@ void store_shape_group(PyObject *lst, int g, mx_uint *size,
   shapes.resize(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject *tup = PyList_GET_ITEM(lst, i);
+    if (expect_tuple(tup, -1, "MXSymbolInferShape") != 0) return -1;
     Py_ssize_t nd = PyTuple_Size(tup);
     for (Py_ssize_t d = 0; d < nd; ++d) {
       shapes[i].push_back(static_cast<mx_uint>(
@@ -808,10 +894,12 @@ void store_shape_group(PyObject *lst, int g, mx_uint *size,
     }
     ndims.push_back(static_cast<mx_uint>(nd));
   }
+  if (PyErr_Occurred()) return fail();  // non-int dim in a shape tuple
   for (auto &s : shapes) ptrs.push_back(s.data());
   *size = static_cast<mx_uint>(n);
   *ndim = ndims.data();
   *data = ptrs.data();
+  return 0;
 }
 
 }  // namespace
@@ -843,8 +931,13 @@ MXTPU_DLL int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
   PyObject *r = bcall("sym_tojson", args);
   Py_DECREF(args);
   if (r == nullptr) return fail();
+  const char *s = utf8_or_fail(r, "MXSymbolSaveToJSON");
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return -1;
+  }
   tls_ret.strings.clear();
-  tls_ret.strings.emplace_back(PyUnicode_AsUTF8(r));
+  tls_ret.strings.emplace_back(s);
   Py_DECREF(r);
   *out_json = tls_ret.strings.back().c_str();
   return 0;
@@ -902,13 +995,20 @@ MXTPU_DLL int MXSymbolInferShape(
   Py_DECREF(args);
   if (r == nullptr) return fail();
   // r = (complete, arg_shapes, out_shapes, aux_shapes)
+  if (expect_tuple(r, 4, "MXSymbolInferShape") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   *complete = PyObject_IsTrue(PyTuple_GET_ITEM(r, 0));
-  store_shape_group(PyTuple_GET_ITEM(r, 1), 0, in_shape_size, in_shape_ndim,
-                    in_shape_data);
-  store_shape_group(PyTuple_GET_ITEM(r, 2), 1, out_shape_size,
-                    out_shape_ndim, out_shape_data);
-  store_shape_group(PyTuple_GET_ITEM(r, 3), 2, aux_shape_size,
-                    aux_shape_ndim, aux_shape_data);
+  if (store_shape_group(PyTuple_GET_ITEM(r, 1), 0, in_shape_size,
+                        in_shape_ndim, in_shape_data) != 0 ||
+      store_shape_group(PyTuple_GET_ITEM(r, 2), 1, out_shape_size,
+                        out_shape_ndim, out_shape_data) != 0 ||
+      store_shape_group(PyTuple_GET_ITEM(r, 3), 2, aux_shape_size,
+                        aux_shape_ndim, aux_shape_data) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_DECREF(r);
   return 0;
 }
@@ -977,6 +1077,10 @@ MXTPU_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
   PyObject *r = bcall("exec_outputs", args);
   Py_DECREF(args);
   if (r == nullptr) return fail();
+  if (expect_list(r, "MXExecutorOutputs") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyList_Size(r);
   tls_ret.handles.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -1017,11 +1121,20 @@ MXTPU_DLL int MXListDataIters(mx_uint *out_size,
   Gil gil;
   PyObject *r = bcall("list_data_iters", nullptr);
   if (r == nullptr) return fail();
+  if (expect_list(r, "MXListDataIters") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyList_Size(r);
   std::lock_guard<std::mutex> lk(g_iters_mu);
   tls_iter_creators.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
-    const char *name = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    const char *name = utf8_or_fail(PyList_GET_ITEM(r, i),
+                                    "MXListDataIters");
+    if (name == nullptr) {
+      Py_DECREF(r);
+      return -1;
+    }
     std::string *slot = nullptr;
     for (auto &c : g_iter_creators) {
       if (*c == name) slot = c.get();
@@ -1133,11 +1246,19 @@ MXTPU_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
   PyObject *r = bcall("dataiter_getindex", args);
   Py_DECREF(args);
   if (r == nullptr) return fail();
+  if (expect_list(r, "MXDataIterGetIndex") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_ssize_t n = PyList_Size(r);
   tls_index.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
     tls_index.push_back(static_cast<uint64_t>(
         PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i))));
+  }
+  if (PyErr_Occurred()) {  // non-int element: surface it, don't return junk
+    Py_DECREF(r);
+    return fail();
   }
   Py_DECREF(r);
   *out_size = static_cast<uint64_t>(n);

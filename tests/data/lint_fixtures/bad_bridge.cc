@@ -1,0 +1,24 @@
+// Known-bad fixture for the C-ABI defensiveness pass: every marked line
+// must fire exactly one rule.
+#include <Python.h>
+#include <string>
+#include <vector>
+
+int BadStringList(PyObject *r, std::vector<std::string> *out) {
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out->emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));  // ABI001+ABI002 (line 10)
+  }
+  return 0;
+}
+
+int BadTupleUnpack(PyObject *r, int *a, int *b) {
+  *a = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));  // ABI002 (line 16)
+  *b = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  return 0;
+}
+
+int SuppressedUse(PyObject *r, const char **out) {
+  *out = PyUnicode_AsUTF8(r);  // mxlint: disable=ABI001
+  return 0;
+}

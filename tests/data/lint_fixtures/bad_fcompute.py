@@ -1,0 +1,40 @@
+"""Known-bad fixture: tracer concretization / host syncs in fcompute bodies.
+
+Linted as if it lived under ``mxnet_tpu/ops/`` (the test passes
+``in_ops_dir=True``); each marked line must fire exactly one rule.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.ops.registry import register
+
+
+@register("fixture_bad_scale")
+def _bad_scale(attrs, x):
+    scale = float(x.max())                  # TRC002 (line 15)
+    return x * scale
+
+
+@register("fixture_bad_item")
+def _bad_item(attrs, x, y):
+    total = x.sum()
+    if total.item() > 0:                    # TRC001 (line 22)
+        return y
+    return x
+
+
+@register("fixture_bad_hostsync")
+def _bad_hostsync(attrs, x):
+    x.block_until_ready()                   # HSY001 (line 29)
+    h = np.exp(x)                           # HSY002 (line 30)
+    arr = np.asarray(x)                     # TRC003 (line 31)
+    return jnp.asarray(h) + jnp.asarray(arr)
+
+
+@register("fixture_bad_nested")
+def _bad_nested(attrs, x):
+    def body(i, acc):
+        return acc + int(acc)               # TRC002 (line 38): loop state
+
+    return jax.lax.fori_loop(0, 4, body, x)

@@ -1,0 +1,30 @@
+"""Known-bad fixture: global-numpy-RNG discipline violations.
+
+Each marked line must produce exactly one finding (see test_mxlint.py for
+the expected rule/line pairs).
+"""
+import numpy as np
+import numpy as _np
+
+
+def draw_weights(shape):
+    return np.random.uniform(-0.07, 0.07, shape)      # RNG001 (line 11)
+
+
+def shuffle_rows(rows):
+    _np.random.shuffle(rows)                          # RNG001 (line 15)
+
+
+def reseed():
+    np.random.seed(0)                                 # RNG002 (line 19)
+
+
+def sanctioned(shape):
+    # explicit generators are fine: not the process-global stream
+    rng = np.random.RandomState(7)
+    g = np.random.default_rng(7)
+    return rng.uniform(size=shape) + g.uniform(size=shape)
+
+
+def suppressed(shape):
+    return np.random.normal(size=shape)  # mxlint: disable=RNG001

@@ -1,0 +1,29 @@
+// Clean fixture for the C-ABI defensiveness pass: guarded bridge-return
+// handling in every function — must produce ZERO findings.
+#include <Python.h>
+#include <string>
+#include <vector>
+
+int GoodStringList(PyObject *r, std::vector<std::string> *out) {
+  if (r == nullptr || !PyList_Check(r)) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    if (s == nullptr) return -1;
+    out->emplace_back(s);
+  }
+  return 0;
+}
+
+int GoodTupleUnpack(PyObject *r, int *a, int *b) {
+  if (r == nullptr || !PyTuple_Check(r) || PyTuple_Size(r) != 2) return -1;
+  *a = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *b = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  return 0;
+}
+
+int HelperGuarded(PyObject *r, int *n, int expect_tuple_rc) {
+  if (expect_tuple_rc != 0) return -1;
+  *n = static_cast<int>(PyLong_AsLong(r));
+  return 0;
+}

@@ -1,0 +1,45 @@
+"""Clean fixture: idiomatic fcompute patterns that must produce ZERO
+findings (the no-false-positives contract of the tracing pass)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.ops.registry import register, register_sparse
+
+
+@register("fixture_clean_pool")
+def _clean_pool(attrs, x):
+    # attrs and shapes are static under tracing: all of this is fine
+    kernel = int(attrs.get("kernel", 2))
+    scale = float(attrs.get("scale", 1.0))
+    n = int(np.prod(x.shape[1:]))
+    pad = np.zeros((len(x.shape),), np.int32)
+    w = jnp.asarray(np.full((kernel,), 1.0 / max(n, 1)))
+    del pad
+    return x * scale + w.sum()
+
+
+@register("fixture_clean_nested")
+def _clean_nested(attrs, x):
+    h, w = x.shape[-2:]
+
+    def window(n_in, n_out):
+        # called with static shape ints only: numpy here is fine
+        m = np.zeros((n_out, n_in), np.float32)
+        m[:, : max(n_in // max(n_out, 1), 1)] = 1.0
+        return jnp.asarray(m)
+
+    return jnp.einsum("...hw,oh->...ow", x, window(h, h))
+
+
+@register("fixture_clean_nojit", no_jit=True)
+def _clean_nojit(attrs, x):
+    # no_jit ops run eagerly by contract: concretization is legal
+    return jnp.asarray(np.array(x.shape, dtype=np.int64))
+
+
+@register_sparse("fixture_clean_pool")
+def _clean_sparse_ex(attrs, lhs, rhs):
+    # fcompute_ex handlers are eager NDArray-level code
+    idx = np.union1d(np.asarray(lhs), np.asarray(rhs))
+    return jnp.asarray(idx)

@@ -1,0 +1,212 @@
+"""mxlint static-analysis tests (mxnet_tpu/analysis/ + tools/mxlint.py).
+
+Two contracts, both tier-1:
+
+* every rule FIRES on its known-bad fixture at exactly the marked line,
+  and stays quiet on the clean fixtures (no false positives);
+* the repo itself is lint-clean modulo the checked-in baseline
+  (.mxlint-baseline.json) — a new violation anywhere fails this file.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import cabi_lint, common, tracing_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "lint_fixtures")
+BASELINE = os.path.join(REPO, common.DEFAULT_BASELINE)
+
+
+def _fixture(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+def _pairs(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# rule-by-rule: known-bad fixtures
+# ---------------------------------------------------------------------------
+
+def test_rng_rules_fire_at_marked_lines():
+    findings = tracing_lint.lint_source(
+        _fixture("bad_rng.py"), "bad_rng.py")
+    assert _pairs(findings) == [("RNG001", 11), ("RNG001", 15),
+                                ("RNG002", 19)]
+
+
+def test_tracing_rules_fire_at_marked_lines():
+    findings = tracing_lint.lint_source(
+        _fixture("bad_fcompute.py"), "bad_fcompute.py", in_ops_dir=True)
+    assert _pairs(findings) == [
+        ("HSY001", 29), ("HSY002", 30), ("TRC001", 22), ("TRC002", 15),
+        ("TRC002", 38), ("TRC003", 31)]
+
+
+def test_cabi_rules_fire_at_marked_lines():
+    findings = cabi_lint.lint_source(
+        _fixture("bad_bridge.cc"), "bad_bridge.cc")
+    assert _pairs(findings) == [("ABI001", 10), ("ABI002", 10),
+                                ("ABI002", 16)]
+
+
+def test_cabi_findings_name_the_function_scope():
+    findings = cabi_lint.lint_source(
+        _fixture("bad_bridge.cc"), "bad_bridge.cc")
+    assert {f.scope for f in findings} == {"BadStringList",
+                                           "BadTupleUnpack"}
+
+
+# ---------------------------------------------------------------------------
+# no false positives on clean fixtures
+# ---------------------------------------------------------------------------
+
+def test_clean_ops_fixture_has_no_findings():
+    findings = tracing_lint.lint_source(
+        _fixture("clean_ops.py"), "clean_ops.py", in_ops_dir=True)
+    assert findings == []
+
+
+def test_clean_bridge_fixture_has_no_findings():
+    findings = cabi_lint.lint_source(
+        _fixture("clean_bridge.cc"), "clean_bridge.cc")
+    assert findings == []
+
+
+def test_inline_suppressions_silence_the_marked_line():
+    # both fixtures carry one "mxlint: disable" line; stripping the
+    # comment must surface exactly one extra finding each
+    for name, linter, kwargs in (
+            ("bad_rng.py", tracing_lint.lint_source, {}),
+            ("bad_bridge.cc", cabi_lint.lint_source, {})):
+        src = _fixture(name)
+        assert "mxlint: disable" in src
+        with_comment = linter(src, name, **kwargs)
+        stripped = linter(src.replace("mxlint: disable", "ignore"), name,
+                          **kwargs)
+        assert len(stripped) == len(with_comment) + 1
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+def test_baseline_partition_and_stale_detection(tmp_path):
+    findings = tracing_lint.lint_source(
+        _fixture("bad_rng.py"), "bad_rng.py")
+    bl = common.Baseline.from_findings(findings[:2])
+    bl.entries["RNG999|gone.py|nowhere|x"] = "stale entry"
+    new, old, stale = bl.partition(findings)
+    assert len(new) == 1 and len(old) == 2
+    assert stale == ["RNG999|gone.py|nowhere|x"]
+    # round-trips through the file format
+    p = tmp_path / "bl.json"
+    bl.save(str(p))
+    assert common.load_baseline(str(p)).entries == bl.entries
+
+
+def test_partial_pass_baseline_update_keeps_other_passes(tmp_path):
+    """--update-baseline with --passes must not drop unscanned passes'
+    suppressions (an unscanned pass yields no findings, which must not
+    read as 'all fixed')."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import mxlint
+    p = tmp_path / "bl.json"
+    reg_key = "REG106|mxnet_tpu/ops/registry.py|someop|untested"
+    common.Baseline({reg_key: "kept"}).save(str(p))
+    # fixture repo: only the cabi pass, over a tree with no src/c_api.cc,
+    # produces zero findings — the registry entry must survive
+    rc = mxlint.main(["--passes", "cabi", "--root", str(tmp_path),
+                      "--baseline", str(p), "--update-baseline"])
+    assert rc == 0
+    assert common.load_baseline(str(p)).entries == {reg_key: "kept"}
+
+
+def test_baseline_keys_survive_line_moves():
+    src = _fixture("bad_rng.py")
+    moved = "# a new leading comment line\n" + src
+    k1 = {f.key for f in tracing_lint.lint_source(src, "bad_rng.py")}
+    k2 = {f.key for f in tracing_lint.lint_source(moved, "bad_rng.py")}
+    assert k1 == k2
+
+
+# ---------------------------------------------------------------------------
+# the repo gate (tier-1): zero non-baselined findings
+# ---------------------------------------------------------------------------
+
+def test_repo_tracing_and_cabi_clean_modulo_baseline():
+    findings = tracing_lint.run(REPO) + cabi_lint.run(REPO)
+    baseline = common.load_baseline(BASELINE)
+    new, _, _ = baseline.partition(findings)
+    assert new == [], ("new lint finding(s) — fix them or (sanctioned "
+                       "only) add to %s:\n%s"
+                       % (BASELINE, "\n".join(map(repr, new))))
+
+
+def test_repo_registry_audit_clean_modulo_baseline():
+    from mxnet_tpu.analysis import registry_audit
+    findings, report = registry_audit.audit(REPO)
+    baseline = common.load_baseline(BASELINE)
+    new, _, _ = baseline.partition(findings)
+    assert new == [], ("new registry-audit finding(s):\n%s"
+                       % "\n".join(map(repr, new)))
+    # every registered op is in the report, and the registry is the size
+    # the roadmap advertises (~305 registered names)
+    from mxnet_tpu.ops import registry
+    canonical = {op.name for op in registry._OP_REGISTRY.values()}
+    assert set(report["ops"]) == canonical
+    assert report["summary"]["registered_names"] == len(
+        registry._OP_REGISTRY)
+    # shape/dtype coverage is total: traced ops by construction, no_jit
+    # ops via explicit shape_rule/dtype_rule markers
+    uncovered = [n for n, r in report["ops"].items()
+                 if not r["shape"] or not r["dtype"]]
+    assert uncovered == []
+    # gradient status is declared for every op (vjp or explicit no_grad)
+    assert all(r["grad"] for r in report["ops"].values())
+    # nd/sym namespaces are complete
+    assert all(r["nd"] and r["sym"] for r in report["ops"].values())
+
+
+def test_registry_untested_ops_are_tracked_not_silent():
+    """Untested ops may only exist as explicit baseline entries."""
+    from mxnet_tpu.analysis import registry_audit
+    findings, report = registry_audit.audit(REPO)
+    baseline = common.load_baseline(BASELINE)
+    untested = [f for f in findings if f.rule == "REG106"]
+    for f in untested:
+        assert baseline.is_suppressed(f), (
+            "op %r has no test and no baseline entry" % f.scope)
+    assert report["summary"]["untested"] == len(untested)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("passes", ["tracing,cabi"])
+def test_cli_json_mode(passes):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+         "--json", "--passes", passes],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1
+    assert doc["findings"] == []
+    assert isinstance(doc["baselined"], list)
+
+
+def test_cli_rejects_unknown_pass():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+         "--passes", "nope"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0

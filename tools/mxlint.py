@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""mxlint — framework-native static analysis for the TPU build.
+
+Runs three passes (see docs/LINT.md) and exits non-zero iff any finding is
+not covered by the checked-in baseline:
+
+  tracing   AST pass over mxnet_tpu/ (tracer concretization, host syncs in
+            fcompute bodies, numpy global-RNG discipline)
+  registry  op-registry audit (shape/dtype/grad coverage, nd/sym bindings,
+            per-op test coverage)
+  cabi      bridge-return defensiveness pass over src/c_api.cc
+
+Usage:
+  python tools/mxlint.py                      # all passes, text output
+  python tools/mxlint.py --json               # machine-readable report
+  python tools/mxlint.py --passes tracing,cabi
+  python tools/mxlint.py --update-baseline    # rewrite .mxlint-baseline.json
+  python tools/mxlint.py --no-baseline        # raw findings, no suppression
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PASSES = ("tracing", "registry", "cabi")
+
+
+def collect(passes, root):
+    """-> (findings, registry_report)."""
+    from mxnet_tpu.analysis import cabi_lint, tracing_lint
+    findings, report = [], None
+    if "tracing" in passes:
+        findings.extend(tracing_lint.run(root))
+    if "cabi" in passes:
+        findings.extend(cabi_lint.run(root))
+    if "registry" in passes:
+        from mxnet_tpu.analysis import registry_audit
+        reg_findings, report = registry_audit.audit(root)
+        findings.extend(reg_findings)
+    return findings, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mxlint", description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON report on stdout")
+    ap.add_argument("--passes", default=",".join(PASSES),
+                    help="comma list from {%s}" % ",".join(PASSES))
+    ap.add_argument("--root", default=REPO, help="repo root to analyze")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, ".mxlint-baseline.json"),
+                    help="baseline/suppression file "
+                         "(analysis.common.DEFAULT_BASELINE)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+    unknown = sorted(set(passes) - set(PASSES))
+    if unknown:
+        ap.error("unknown pass(es): %s" % ", ".join(unknown))
+
+    # runtime imports happen after arg validation so --help / bad usage
+    # stay instant (the analysis package pulls in the full framework)
+    from mxnet_tpu.analysis import common
+
+    findings, report = collect(passes, args.root)
+
+    if args.update_baseline:
+        bl = common.Baseline.from_findings(findings)
+        if set(passes) != set(PASSES):
+            # partial run: an unscanned pass produced no findings, which
+            # must not read as "all fixed" — carry its entries over
+            for k, reason in common.load_baseline(args.baseline).entries.items():
+                if common.pass_of_key(k) not in passes:
+                    bl.entries.setdefault(k, reason)
+        bl.save(args.baseline)
+        print("wrote %d suppression(s) to %s"
+              % (len(bl.entries), args.baseline))
+        return 0
+
+    if args.no_baseline:
+        new, old, stale = findings, [], []
+    else:
+        baseline = common.load_baseline(args.baseline)
+        new, old, stale = baseline.partition(findings)
+        if set(passes) != set(PASSES):
+            # a partial run cannot distinguish "fixed" from "not scanned"
+            stale = []
+
+    if args.json:
+        print(common.render_json(new, stale, old, report))
+    else:
+        print(common.render_text(new, stale, baselined_count=len(old)))
+        if report is not None:
+            s = report["summary"]
+            print("registry: %(ops)d ops (%(registered_names)d names) | "
+                  "shape %(shape_covered)d/%(ops)d dtype "
+                  "%(dtype_covered)d/%(ops)d | grad vjp=%(grad_vjp)d "
+                  "no_grad=%(grad_no_grad)d | tested %(tested)d "
+                  "untested %(untested)d" % s)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
