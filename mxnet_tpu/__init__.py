@@ -96,6 +96,7 @@ from . import monitor
 from .monitor import Monitor
 from . import contrib
 from . import rnn
+from . import serving
 from .executor import Executor
 from . import rtc  # compat shim: runtime kernels are Pallas on TPU
 

@@ -24,6 +24,7 @@ executor + memory planner + op bulking, all in the compiler).  Notes:
 """
 from __future__ import annotations
 
+import threading as _threading
 import time as _time
 
 from . import autograd
@@ -50,6 +51,58 @@ class CachedOp:
         self._jitted = {}          # training(bool) -> jitted fn
         self._bwd_jitted = {}      # training(bool) -> jitted backward
         self._out_tree = None      # 'single' | 'list'
+        self._sig_stats = {}       # signature str -> [hits, misses]
+        self._stats_lock = _threading.Lock()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _signature(training, input_vals):
+        """Compile-cache key of one dispatch, as a readable string.
+
+        jax.jit keys its executable cache on the argument shapes/dtypes (and
+        static state); parameters keep one shape for the life of the op, so
+        the observable signature is (mode, input shapes/dtypes) — e.g.
+        ``infer|float32[4,16]``.  A new signature means XLA compiles a fresh
+        executable (the bucketed-DynamicForward recompile analog)."""
+        parts = ["train" if training else "infer"]
+        for v in input_vals:
+            shape = ",".join(str(d) for d in getattr(v, "shape", ()))
+            parts.append("%s[%s]" % (getattr(v, "dtype", "?"), shape))
+        return "|".join(parts)
+
+    def _note_dispatch(self, training, input_vals):
+        sig = self._signature(training, input_vals)
+        with self._stats_lock:
+            rec = self._sig_stats.get(sig)
+            if rec is None:
+                self._sig_stats[sig] = [0, 1]
+            else:
+                rec[0] += 1
+
+    def cache_stats(self):
+        """Per-signature compile-cache counters (debugging / serving aid).
+
+        Returns ``{"signatures": {sig: {"hits": h, "misses": m}},
+        "hits": H, "misses": M, "recompiles": M}``.  A *miss* is the first
+        dispatch of a signature (jax.jit traces + XLA compiles); every later
+        dispatch of that signature is a *hit* (executable-cache lookup).
+        ``recompiles`` == total misses, the number the serving warmup gate
+        asserts stays flat in steady state.  Caveat: a parameter cast()
+        changes jit's cache key without changing the input signature, so it
+        recompiles without a counted miss — rebuild the CachedOp after
+        casting instead."""
+        with self._stats_lock:
+            sigs = {sig: {"hits": rec[0], "misses": rec[1]}
+                    for sig, rec in self._sig_stats.items()}
+        hits = sum(r["hits"] for r in sigs.values())
+        misses = sum(r["misses"] for r in sigs.values())
+        return {"signatures": sigs, "hits": hits, "misses": misses,
+                "recompiles": misses}
+
+    def reset_cache_stats(self):
+        """Zero the hit/miss counters (does NOT drop compiled executables)."""
+        with self._stats_lock:
+            self._sig_stats.clear()
 
     # ------------------------------------------------------------------
     def _make_traced(self, training):
@@ -155,6 +208,7 @@ class CachedOp:
 
         jitted = self._get_jitted(training)
         n_aux = len(self._aux_names)
+        self._note_dispatch(training, input_vals)
 
         if profiler.profiling_imperative():
             # one span per compiled-graph dispatch, named like the

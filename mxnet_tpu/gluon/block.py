@@ -355,22 +355,8 @@ class HybridBlock(Block):
     def _build_cache(self):
         """Create the CachedOp over this block's full forward
         (analog of block.py:787 _build_cache)."""
-        params = {p.name: p for p in self.collect_params().values()}
-        # resolve one NDArray handle per param (single-ctx fast path)
-        aux_names = [name for name, p in params.items() if p.grad_req == "null"
-                     and ("running" in name or "moving" in name)]
-        block = self
-
-        def forward_fn(param_nds, *input_nds):
-            # substitute each Parameter's data with the provided handle for the
-            # duration of the call
-            return _with_param_override(block, params, param_nds,
-                                        lambda: block.hybrid_call(*input_nds))
-
-        self._cached_op = CachedOp(forward_fn, {n: params[n].data()
-                                                for n in params}, aux_names,
-                                   self._flags)
-        self._cached_params = params
+        self._cached_op, self._cached_params = build_cached_op(self,
+                                                              self._flags)
 
     def _call_cached_op(self, *args):
         if self._cached_op is None:
@@ -502,6 +488,12 @@ class SymbolBlock(HybridBlock):
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix=None, params=params)
+        # graph arg names ARE the parameter names: an auto "symbolblock0_"
+        # prefix would break both imports() param matching and forward()'s
+        # arg_dict binding (reference block.py:1010 resets prefix to '')
+        self._prefix = ""
+        self._name = ""
+        self._params = ParameterDict("", params)
         from .. import symbol as sym_mod
         if isinstance(inputs, sym_mod.Symbol):
             inputs = [inputs]
@@ -539,6 +531,32 @@ class SymbolBlock(HybridBlock):
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
+
+
+def build_cached_op(block, flags=None):
+    """CachedOp over ``block``'s full forward + its {name: Parameter} map.
+
+    The single construction point for whole-block compilation — used by
+    ``HybridBlock._build_cache`` (hybridize) and the serving registry (which
+    wants its own inference-mode instance without touching the block's
+    hybridize cache).  Keeps the aux-state detection heuristic in ONE place:
+    grad_req=='null' params whose name marks running/moving statistics are
+    captured as extra outputs and written back after training calls."""
+    params = {p.name: p for p in block.collect_params().values()}
+    aux_names = [name for name, p in params.items() if p.grad_req == "null"
+                 and ("running" in name or "moving" in name)]
+
+    def forward_fn(param_nds, *input_nds):
+        # substitute each Parameter's data with the provided handle for the
+        # duration of the call
+        call = (block.hybrid_call if isinstance(block, HybridBlock)
+                else block.forward)
+        return _with_param_override(block, params, param_nds,
+                                    lambda: call(*input_nds))
+
+    cop = CachedOp(forward_fn, {n: params[n].data() for n in params},
+                   aux_names, flags)
+    return cop, params
 
 
 def functional_call(block, param_vals, *input_vals, training=False, rng_key=None):

@@ -26,8 +26,14 @@ def _jnp():
 _UNARY = {
     "abs": lambda jnp, x: jnp.abs(x),
     "sign": lambda jnp, x: jnp.sign(x),
-    "round": lambda jnp, x: jnp.round(x),
-    "rint": lambda jnp, x: jnp.rint(x),
+    # reference tie-breaking differs from numpy's ties-to-even
+    # (mshadow_op.h): round sends n.5 away from zero, rint sends it to n
+    # (i.e. ties toward -inf): round(2.5)=3, round(-2.5)=-3, rint(1.5)=1,
+    # rint(-2.5)=-3
+    "round": lambda jnp, x: jnp.where(x >= 0, jnp.floor(x + 0.5),
+                                      jnp.ceil(x - 0.5)),
+    "rint": lambda jnp, x: jnp.where(x - jnp.floor(x) <= 0.5,
+                                     jnp.floor(x), jnp.ceil(x)),
     "ceil": lambda jnp, x: jnp.ceil(x),
     "floor": lambda jnp, x: jnp.floor(x),
     "trunc": lambda jnp, x: jnp.trunc(x),

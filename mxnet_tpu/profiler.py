@@ -88,6 +88,17 @@ def profiling_imperative():
     return _state["running"] and _config.get("profile_imperative", True)
 
 
+def profiling_active():
+    """True while a profiling session is running.
+
+    High-rate counter writers (the serving queue-depth / batch-latency
+    gauges update on every request) must gate on this: Counter.set_value
+    appends a trace event unconditionally, so an ungated per-request update
+    in a long-lived server grows the event buffer without bound between
+    dumps."""
+    return _state["running"]
+
+
 def record_op_span(name, t0_s, t1_s, cat="operator"):
     """One imperative op dispatch: B/E trace events + aggregate-table bump
     (src/profiler ProfileOperator analog).  Times are ``time.time()``

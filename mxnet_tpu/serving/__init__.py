@@ -1,0 +1,22 @@
+"""mxnet_tpu.serving — in-process model server on the CachedOp compile cache.
+
+The serving subsystem the north star names: a multi-model, dynamically
+micro-batched inference server with a fixed bucket ladder (so steady-state
+traffic never triggers a fresh XLA compile), per-request deadlines, bounded
+admission with load-shedding backpressure, and profiler-integrated
+observability.  See docs/SERVING.md for architecture and tuning.
+
+    from mxnet_tpu import serving
+    server = serving.ModelServer()
+    server.load_model("net", block, input_shapes=[(16,), (32,)])
+    result = server.predict("net", x, timeout_ms=100)
+"""
+from .buckets import BucketLadder, shape_key
+from .batcher import MicroBatcher, Request
+from .registry import ModelRegistry, ServableModel
+from .server import (ModelServer, InferenceResult,
+                     OK, TIMEOUT, OVERLOADED, INVALID_INPUT, ERROR)
+
+__all__ = ["ModelServer", "InferenceResult", "BucketLadder", "Request",
+           "MicroBatcher", "ModelRegistry", "ServableModel", "shape_key",
+           "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR"]

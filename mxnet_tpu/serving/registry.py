@@ -1,0 +1,149 @@
+"""Multi-model registry: loadable, warmable, inference-mode CachedOps.
+
+A ``ServableModel`` wraps one model — a hybridizable Gluon block or an
+exported symbol+params pair re-imported as a SymbolBlock — as an
+inference-mode :class:`~mxnet_tpu.cached_op.CachedOp` plus its bucket menu
+(admissible input shapes x batch ladder).  ``warmup()`` dispatches a zeros
+batch for every (shape, rung) pair at load time so XLA compiles the entire
+menu before traffic arrives; after that, ``CachedOp.cache_stats()`` must show
+zero new misses in steady state (the acceptance gate tests/test_serving.py
+asserts).
+
+The registry itself is a flat name -> ServableModel map guarded by one lock;
+models load/unload independently and hold no shared mutable state.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import autograd
+from ..base import MXNetError
+from .buckets import BucketLadder, normalize_shape_variants, shape_key
+from .stats import ModelStats
+
+__all__ = ["ServableModel", "ModelRegistry"]
+
+
+class ServableModel:
+    """One loaded model: CachedOp + bucket menu + per-model stats."""
+
+    def __init__(self, name, block, input_shapes, dtype="float32",
+                 max_batch=8, batch_ladder=None, flags=None):
+        self.name = name
+        self.block = block
+        self.ladder = (batch_ladder if isinstance(batch_ladder, BucketLadder)
+                       else BucketLadder(max_batch, batch_ladder))
+        self.variants = normalize_shape_variants(input_shapes)
+        n_inputs = len(self.variants[0])
+        if any(len(v) != n_inputs for v in self.variants):
+            raise ValueError("all shape variants must have the same number "
+                             "of inputs")
+        self.n_inputs = n_inputs
+        if isinstance(dtype, (list, tuple)):
+            if len(dtype) != n_inputs:
+                raise ValueError("need one dtype per input")
+            self.dtypes = [np.dtype(d) for d in dtype]
+        else:
+            self.dtypes = [np.dtype(dtype)] * n_inputs
+        self._ensure_initialized(block)
+        # own CachedOp instance (never perturbs the block's hybridize cache),
+        # built by the one shared construction point in gluon.block
+        from ..gluon.block import build_cached_op
+        self._cop, params = build_cached_op(block, flags)
+        self._params = {n: p.data() for n, p in params.items()}
+        self.stats = ModelStats(name)
+        self.warmup_report = None
+        # every admissible (per-request shapes, dtypes) coalescing key
+        self.allowed_keys = frozenset(
+            tuple((shape, str(dt)) for shape, dt in zip(v, self.dtypes))
+            for v in self.variants)
+
+    def _ensure_initialized(self, block):
+        """Finish deferred parameter init with a zeros probe if needed."""
+        try:
+            for p in block.collect_params().values():
+                p.data()
+            return
+        except Exception:
+            pass
+        from .. import ndarray as nd
+        probe = [nd.zeros((1,) + v, dtype=str(dt))
+                 for v, dt in zip(self.variants[0], self.dtypes)]
+        with autograd.pause():
+            block(*probe)
+
+    # ------------------------------------------------------------------
+    def execute(self, batch_arrays):
+        """Run one padded batch (numpy, batch-major) -> list of numpy
+        outputs, still batch-major.  Inference mode regardless of the
+        caller thread's autograd state."""
+        from ..ndarray import NDArray
+        inputs = [NDArray(np.ascontiguousarray(a)) for a in batch_arrays]
+        with autograd.pause():
+            out = self._cop(self._params, *inputs)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return [o.asnumpy() for o in outs]
+
+    def warmup(self):
+        """Precompile every (shape variant, ladder rung) signature.
+
+        Returns {"signatures": n, "compiles": misses_delta, "skipped": m}
+        and stores it as ``self.warmup_report``.  Load-time cost, steady-
+        state zero-recompile guarantee."""
+        before = self._cop.cache_stats()["misses"]
+        n = 0
+        for variant in self.variants:
+            for rung in self.ladder:
+                arrays = [np.zeros((rung,) + shape, dt)
+                          for shape, dt in zip(variant, self.dtypes)]
+                self.execute(arrays)
+                n += 1
+        after = self._cop.cache_stats()
+        self.warmup_report = {
+            "signatures": n,
+            "compiles": after["misses"] - before,
+            "cache": {"hits": after["hits"], "misses": after["misses"]},
+        }
+        return self.warmup_report
+
+    def cache_stats(self):
+        return self._cop.cache_stats()
+
+    def admissible(self, arrays):
+        return shape_key(arrays) in self.allowed_keys
+
+
+class ModelRegistry:
+    """Thread-safe name -> ServableModel map."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+
+    def add(self, model):
+        with self._lock:
+            if model.name in self._models:
+                raise MXNetError("model %r is already loaded" % model.name)
+            self._models[model.name] = model
+
+    def remove(self, name):
+        with self._lock:
+            try:
+                return self._models.pop(name)
+            except KeyError:
+                raise MXNetError("no model %r; loaded: %s"
+                                 % (name, sorted(self._models) or "none"))
+
+    def get(self, name):
+        with self._lock:
+            try:
+                return self._models[name]
+            except KeyError:
+                raise MXNetError("no model %r; loaded: %s"
+                                 % (name, sorted(self._models) or "none"))
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)

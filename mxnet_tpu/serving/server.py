@@ -1,0 +1,263 @@
+"""ModelServer: the in-process serving front-end.
+
+The predictor API the MXNet paper names (Amalgamation/MXPred) ends at one
+caller, one shape; this server is the production shape of that capability on
+the TPU stack: multi-model, dynamically batched, deadline-aware, and
+overload-safe, built entirely on ``CachedOp``'s compile cache.
+
+Request lifecycle::
+
+    predict() -> admission (shape check, bounded queue) -> micro-batcher
+    coalesces same-shape requests -> padded batch on the bucket ladder ->
+    one precompiled XLA executable -> per-row results fan back out
+
+Every terminal state is a *status*, not an exception: TIMEOUT (deadline
+passed before execution), OVERLOADED (queue full — shed at admission),
+INVALID_INPUT (shape not in the model's bucket menu), ERROR (model raised).
+Callers distinguish outcomes without try/except around the hot path, and an
+overloaded server degrades to fast rejections instead of growing a queue.
+
+Quickstart (see docs/SERVING.md)::
+
+    server = serving.ModelServer()
+    server.load_model("mlp", net, input_shapes=[(16,), (32,)], max_batch=8)
+    res = server.predict("mlp", np.ones((16,), np.float32), timeout_ms=50)
+    assert res.status == serving.OK
+    server.stats()["models"]["mlp"]
+    server.stop()
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from .batcher import MicroBatcher, Request
+from .registry import ModelRegistry, ServableModel
+
+__all__ = ["ModelServer", "InferenceResult",
+           "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR"]
+
+OK = "OK"
+TIMEOUT = "TIMEOUT"
+OVERLOADED = "OVERLOADED"
+INVALID_INPUT = "INVALID_INPUT"
+ERROR = "ERROR"
+
+# extra client-side wait beyond the deadline before declaring TIMEOUT
+# locally (covers worker wakeup jitter; the completion race is settled by
+# Request.complete's first-wins lock either way)
+_WAIT_GRACE_S = 0.25
+
+
+class InferenceResult:
+    """Terminal state of one request: status + outputs + latency."""
+
+    __slots__ = ("status", "outputs", "latency_ms", "error")
+
+    def __init__(self, status, outputs=None, latency_ms=None, error=None):
+        self.status = status
+        self.outputs = outputs
+        self.latency_ms = latency_ms
+        self.error = error
+
+    @property
+    def output(self):
+        """First output array (the common single-output convenience)."""
+        return self.outputs[0] if self.outputs else None
+
+    def __repr__(self):
+        return ("InferenceResult(status=%s, latency_ms=%s%s)"
+                % (self.status,
+                   None if self.latency_ms is None
+                   else round(self.latency_ms, 3),
+                   ", error=%r" % self.error if self.error else ""))
+
+
+class _Entry:
+    __slots__ = ("model", "batcher", "default_timeout_ms")
+
+    def __init__(self, model, batcher, default_timeout_ms):
+        self.model = model
+        self.batcher = batcher
+        self.default_timeout_ms = default_timeout_ms
+
+
+class ModelServer:
+    def __init__(self):
+        self._registry = ModelRegistry()
+        self._entries = {}           # name -> _Entry (guarded by registry)
+        self._t_start = time.time()
+
+    # -- model management ----------------------------------------------
+    def load_model(self, name, block, input_shapes, dtype="float32",
+                   max_batch=8, batch_ladder=None, max_queue=64,
+                   linger_ms=2.0, default_timeout_ms=None, warmup=True,
+                   flags=None):
+        """Load a Gluon block (hybridizable or plain) for serving.
+
+        ``input_shapes`` is the complete menu of admissible per-request
+        shapes (batch dim excluded); requests outside it get
+        INVALID_INPUT.  ``warmup=True`` precompiles every
+        (shape, ladder rung) signature before the model takes traffic.
+        Outputs must be batch-major (row i of every output belongs to
+        request i) — true of standard inference-mode networks.
+        """
+        if name in self._entries:
+            # cheap early duplicate check so a name collision fails before
+            # the model build + whole-bucket-menu warmup compile; the
+            # registry.add below is the authoritative (locked) check
+            raise MXNetError("model %r is already loaded" % name)
+        model = ServableModel(name, block, input_shapes, dtype=dtype,
+                              max_batch=max_batch, batch_ladder=batch_ladder,
+                              flags=flags)
+        if warmup:
+            model.warmup()
+        self._registry.add(model)
+        try:
+            entry = _Entry(model, MicroBatcher(model, max_queue=max_queue,
+                                               linger_ms=linger_ms),
+                           default_timeout_ms)
+            self._entries[name] = entry
+        except Exception:
+            self._registry.remove(name)
+            raise
+        return model
+
+    def load_exported(self, name, prefix, epoch=0, input_names=("data",),
+                      ctx=None, **kwargs):
+        """Load an ``HybridBlock.export()`` artifact pair
+        (``<prefix>-symbol.json`` + ``<prefix>-<epoch>.params``) via
+        SymbolBlock.imports — the saved-model serving path."""
+        from ..gluon import SymbolBlock
+        block = SymbolBlock.imports(
+            "%s-symbol.json" % prefix, list(input_names),
+            "%s-%04d.params" % (prefix, epoch), ctx=ctx)
+        return self.load_model(name, block, **kwargs)
+
+    def unload(self, name):
+        # registry first: concurrent predicts turn into unknown-model errors
+        # for the whole teardown window (the reverse of load_model's order)
+        self._registry.remove(name)
+        entry = self._entries.pop(name)
+        entry.batcher.stop()
+
+    def models(self):
+        return self._registry.names()
+
+    def pause(self, name):
+        """Stop dispatching ``name`` (maintenance/drain); admission stays
+        open up to the queue bound."""
+        self._entry(name).batcher.pause()
+
+    def resume(self, name):
+        self._entry(name).batcher.resume()
+
+    # -- inference ------------------------------------------------------
+    def predict_async(self, name, data, timeout_ms=None):
+        """Submit one request; returns a Request handle (``wait()`` then
+        read status/outputs) or an InferenceResult for immediate
+        rejections (shed / invalid shape)."""
+        entry = self._entry(name)
+        model = entry.model
+        try:
+            inputs = self._coerce(model, data)
+        except (ValueError, TypeError) as exc:
+            # malformed payload (wrong input count, ragged/uncastable data)
+            # is a status like every other terminal state, not an exception
+            model.stats.on_invalid()
+            return InferenceResult(INVALID_INPUT, latency_ms=0.0,
+                                   error=str(exc))
+        if not model.admissible(inputs):
+            model.stats.on_invalid()
+            return InferenceResult(
+                INVALID_INPUT, latency_ms=0.0,
+                error="shapes %s not in bucket menu %s"
+                % ([tuple(a.shape) for a in inputs],
+                   sorted(tuple(s for s, _ in k)
+                          for k in model.allowed_keys)))
+        if timeout_ms is None:
+            timeout_ms = entry.default_timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        request = Request(inputs, deadline=deadline)
+        if not entry.batcher.submit(request):
+            return InferenceResult(OVERLOADED, latency_ms=0.0,
+                                   error="admission queue full")
+        return request
+
+    def predict(self, name, data, timeout_ms=None):
+        """Blocking inference; always returns an InferenceResult."""
+        handle = self.predict_async(name, data, timeout_ms=timeout_ms)
+        if isinstance(handle, InferenceResult):
+            return handle
+        return self.result(name, handle)
+
+    def result(self, name, request):
+        """Wait a submitted Request out and convert it to a result."""
+        entry = self._entry(name)
+        if request.deadline is not None:
+            request.wait(request.deadline - time.monotonic() + _WAIT_GRACE_S)
+            if request.status is None and request.complete(TIMEOUT):
+                entry.model.stats.on_result(TIMEOUT, request.latency_ms)
+        else:
+            request.wait()
+        return InferenceResult(request.status, request.outputs,
+                               request.latency_ms, request.error)
+
+    # -- observability --------------------------------------------------
+    def stats(self):
+        """Snapshot: per-model counters + compile-cache + warmup report."""
+        models = {}
+        for name in self._registry.names():
+            model = self._registry.get(name)
+            snap = model.stats.snapshot()
+            cache = model.cache_stats()
+            snap["cache"] = {"hits": cache["hits"],
+                             "misses": cache["misses"],
+                             "recompiles": cache["recompiles"],
+                             "signatures": len(cache["signatures"])}
+            snap["warmup"] = model.warmup_report
+            models[name] = snap
+        return {"uptime_s": time.time() - self._t_start, "models": models}
+
+    # -- lifecycle ------------------------------------------------------
+    def stop(self):
+        for name in list(self._entries):
+            self.unload(name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- internals ------------------------------------------------------
+    def _entry(self, name):
+        self._registry.get(name)       # raises the helpful unknown-model error
+        entry = self._entries.get(name)
+        if entry is None:
+            # registry row exists but the entry doesn't: caller raced a
+            # load/unload transition — a clean retryable error, not KeyError
+            raise MXNetError("model %r is mid load/unload; retry" % name)
+        return entry
+
+    @staticmethod
+    def _coerce(model, data):
+        """Normalize user data (array / NDArray / tuple) to the model's
+        per-input numpy arrays with the configured dtypes."""
+        from ..ndarray import NDArray
+        if isinstance(data, (list, tuple)):
+            items = list(data)
+        else:
+            items = [data]
+        if len(items) != model.n_inputs:
+            raise ValueError("model %r takes %d input(s), got %d"
+                             % (model.name, model.n_inputs, len(items)))
+        out = []
+        for x, dt in zip(items, model.dtypes):
+            if isinstance(x, NDArray):
+                x = x.asnumpy()
+            out.append(np.asarray(x, dtype=dt))
+        return tuple(out)

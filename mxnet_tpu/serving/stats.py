@@ -1,0 +1,131 @@
+"""Serving observability: per-model counters + latency percentiles.
+
+Two sinks, one writer: every event updates (1) plain numeric fields read by
+``ModelServer.stats()`` (always on, lock-protected) and (2) a ``serving``
+profiler Domain's Counters — queue depth, batch latency, shed count — so a
+``profiler.dump()`` trace shows server activity on the same timeline as op
+spans.  Counter writes are gated on ``profiler.profiling_active()``: each
+``Counter.set_value`` appends a trace event, and an ungated per-request
+update would grow the event buffer without bound in a long-lived server.
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import profiler
+
+__all__ = ["ModelStats", "LatencyWindow"]
+
+
+class LatencyWindow:
+    """Ring buffer of the last ``capacity`` latencies, for percentiles."""
+
+    def __init__(self, capacity=2048):
+        self._cap = int(capacity)
+        self._buf = []
+        self._next = 0
+
+    def add(self, ms):
+        if len(self._buf) < self._cap:
+            self._buf.append(ms)
+        else:
+            self._buf[self._next] = ms
+            self._next = (self._next + 1) % self._cap
+
+    def percentiles(self, ps=(50, 95, 99)):
+        """{"p50": ms, ...} over the window (zeros when empty)."""
+        if not self._buf:
+            return {"p%d" % p: 0.0 for p in ps}
+        ordered = sorted(self._buf)
+        out = {}
+        for p in ps:
+            idx = min(len(ordered) - 1, int(round(p / 100.0 * (len(ordered) - 1))))
+            out["p%d" % p] = ordered[idx]
+        return out
+
+
+class ModelStats:
+    """All counters for one loaded model.  Thread-safe."""
+
+    def __init__(self, model_name):
+        self._lock = threading.Lock()
+        self.requests = 0        # admitted submissions
+        self.ok = 0
+        self.timeouts = 0
+        self.shed = 0            # rejected: queue full
+        self.invalid = 0         # rejected: shape not in the bucket menu
+        self.errors = 0
+        self.batches = 0
+        self.batched_requests = 0   # real rows executed
+        self.padded_rows = 0        # ladder pad rows executed
+        self.queue_depth = 0
+        self._req_lat = LatencyWindow()
+        self._batch_lat = LatencyWindow()
+        domain = profiler.Domain("serving")
+        self._c_queue = domain.new_counter("%s:queue_depth" % model_name)
+        self._c_batch_ms = domain.new_counter("%s:batch_ms" % model_name)
+        self._c_shed = domain.new_counter("%s:shed" % model_name)
+
+    # -- event hooks ----------------------------------------------------
+    def on_queue_depth(self, depth):
+        with self._lock:
+            self.queue_depth = depth
+        if profiler.profiling_active():
+            self._c_queue.set_value(depth)
+
+    def on_admitted(self):
+        with self._lock:
+            self.requests += 1
+
+    def on_shed(self):
+        with self._lock:
+            self.shed += 1
+            count = self.shed
+        if profiler.profiling_active():
+            self._c_shed.set_value(count)
+
+    def on_invalid(self):
+        with self._lock:
+            self.invalid += 1
+
+    def on_batch(self, n_real, bucket, latency_ms):
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += n_real
+            self.padded_rows += bucket - n_real
+            self._batch_lat.add(latency_ms)
+        if profiler.profiling_active():
+            self._c_batch_ms.set_value(latency_ms)
+
+    def on_result(self, status, latency_ms=None):
+        from .server import OK, TIMEOUT, ERROR
+        with self._lock:
+            if status == OK:
+                self.ok += 1
+            elif status == TIMEOUT:
+                self.timeouts += 1
+            elif status == ERROR:
+                self.errors += 1
+            if latency_ms is not None:
+                self._req_lat.add(latency_ms)
+
+    # -- snapshot -------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            rows = self.batched_requests + self.padded_rows
+            return {
+                "requests": self.requests,
+                "ok": self.ok,
+                "timeouts": self.timeouts,
+                "shed": self.shed,
+                "invalid": self.invalid,
+                "errors": self.errors,
+                "batches": self.batches,
+                "batched_requests": self.batched_requests,
+                "avg_batch": (self.batched_requests / self.batches
+                              if self.batches else 0.0),
+                "pad_waste": (self.padded_rows / rows if rows else 0.0),
+                "queue_depth": self.queue_depth,
+                "latency_ms": self._req_lat.percentiles(),
+                "batch_latency_ms": self._batch_lat.percentiles(),
+            }

@@ -1,0 +1,127 @@
+"""Coverage for registry ops a serving pipeline exercises (REG106 burn-down).
+
+Each op here was in the .mxlint-baseline.json REG106 untested set at PR 1;
+these tests exercise them with numpy references so their baseline entries
+could be deleted.  The framing is the serving post-processing path: turning
+a served model's raw logits into labels/scores (argmin/argmax_channel/
+softmin/batch_take/gather_nd), shaping replies (reshape_like/slice_like/
+broadcast_like/identity), introspecting payloads (shape_array/size_array),
+and scoring (softmax_cross_entropy), plus the numeric cleanups bench
+reporting uses (round/rint/fix/log2/log10/logical_not).
+"""
+import numpy as np
+
+from mxnet_tpu import nd
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def test_softmin_matches_negated_softmax():
+    x = _rs(0).randn(3, 5).astype(np.float32)
+    out = nd.softmin(nd.array(x), axis=-1).asnumpy()
+    e = np.exp(-x - (-x).max(axis=-1, keepdims=True))
+    ref = e / e.sum(axis=-1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out.sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_argmin_axis_and_flat():
+    x = _rs(1).randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(nd.argmin(nd.array(x), axis=1).asnumpy(),
+                               x.argmin(axis=1).astype(np.float32))
+    flat = nd.argmin(nd.array(x)).asnumpy()
+    assert flat.shape == (1,) and flat[0] == x.reshape(-1).argmin()
+
+
+def test_argmax_channel_is_axis1_argmax():
+    x = _rs(2).randn(5, 7).astype(np.float32)
+    np.testing.assert_allclose(nd.argmax_channel(nd.array(x)).asnumpy(),
+                               x.argmax(axis=1).astype(np.float32))
+
+
+def test_batch_take_picks_per_row():
+    logits = _rs(3).randn(4, 5).astype(np.float32)
+    labels = np.array([0, 3, 1, 4], np.float32)
+    out = nd.batch_take(nd.array(logits), nd.array(labels)).asnumpy()
+    np.testing.assert_allclose(out, logits[np.arange(4), labels.astype(int)])
+
+
+def test_gather_nd_coordinate_lookup():
+    data = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([[0, 2, 1], [1, 3, 0]], np.float32)   # (ndim, n) coords
+    out = nd.gather_nd(nd.array(data), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(out, data[[0, 2, 1], [1, 3, 0]])
+
+
+def test_shape_array_and_size_array():
+    x = nd.zeros((2, 3, 5))
+    shp = nd.shape_array(x).asnumpy()
+    # int64 per the dtype_rule; jax without x64 narrows to int32
+    assert np.issubdtype(shp.dtype, np.integer)
+    np.testing.assert_array_equal(shp, [2, 3, 5])
+    siz = nd.size_array(x).asnumpy()
+    assert int(siz.reshape(-1)[0]) == 30
+
+
+def test_identity_and_reshape_like():
+    x = _rs(4).randn(2, 6).astype(np.float32)
+    np.testing.assert_allclose(nd.identity(nd.array(x)).asnumpy(), x)
+    like = nd.zeros((3, 4))
+    out = nd.reshape_like(nd.array(x), like)
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out.asnumpy().reshape(-1), x.reshape(-1))
+
+
+def test_slice_like_trims_to_reference():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(4, 6))
+    ref = nd.zeros((2, 3))
+    out = nd.slice_like(x, ref).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy()[:2, :3])
+    axis0 = nd.slice_like(x, ref, axes=(0,)).asnumpy()
+    np.testing.assert_allclose(axis0, x.asnumpy()[:2, :])
+
+
+def test_broadcast_like_expands_to_reference():
+    row = nd.array(np.array([[1.0, 2.0, 3.0]], np.float32))
+    like = nd.zeros((4, 3))
+    out = nd.broadcast_like(row, like).asnumpy()
+    np.testing.assert_allclose(out, np.tile([[1.0, 2.0, 3.0]], (4, 1)))
+
+
+def test_softmax_cross_entropy_scalar_loss():
+    logits = _rs(5).randn(4, 6).astype(np.float32)
+    labels = np.array([1, 0, 5, 2], np.float32)
+    out = nd.softmax_cross_entropy(nd.array(logits), nd.array(labels)).asnumpy()
+    shifted = logits - logits.max(axis=1, keepdims=True)
+    logp = shifted - np.log(np.exp(shifted).sum(axis=1, keepdims=True))
+    ref = -logp[np.arange(4), labels.astype(int)].sum()
+    assert out.shape == (1,)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+
+
+def test_rounding_family_round_rint_fix():
+    # reference semantics, NOT numpy's ties-to-even: round sends n.5 away
+    # from zero, rint sends n.5 to n (mshadow_op.h; "for input n.5 rint
+    # returns n while round returns n+1" per the reference op docs)
+    x = np.array([-2.5, -1.4, -0.5, 0.5, 1.4, 2.5], np.float32)
+    np.testing.assert_allclose(nd.round(nd.array(x)).asnumpy(),
+                               [-3.0, -1.0, -1.0, 1.0, 1.0, 3.0])
+    np.testing.assert_allclose(nd.rint(nd.array(x)).asnumpy(),
+                               [-3.0, -1.0, -1.0, 0.0, 1.0, 2.0])
+    np.testing.assert_allclose(nd.fix(nd.array(x)).asnumpy(), np.fix(x))
+
+
+def test_log2_and_log10():
+    x = np.array([1.0, 2.0, 8.0, 100.0], np.float32)
+    np.testing.assert_allclose(nd.log2(nd.array(x)).asnumpy(), np.log2(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(nd.log10(nd.array(x)).asnumpy(), np.log10(x),
+                               rtol=1e-6)
+
+
+def test_logical_not_zero_one_mask():
+    x = np.array([0.0, 1.0, -3.0, 0.0, 2.5], np.float32)
+    out = nd.logical_not(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, (x == 0).astype(np.float32))

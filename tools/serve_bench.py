@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""serve_bench — load generator for mxnet_tpu.serving.
+
+Serves a small shape-polymorphic Gluon MLP (mean over a variable-length
+axis, then two Dense layers) under concurrent closed-loop clients firing a
+mixed-shape workload, and reports throughput, per-request latency
+percentiles, status counts, batching efficiency, and the compile-cache
+delta (which must be zero after warmup) to a BENCH_SERVE.json-style
+artifact.
+
+Usage:
+  python tools/serve_bench.py                       # full run
+  python tools/serve_bench.py --smoke               # fast tier-1 smoke
+  python tools/serve_bench.py --clients 16 --requests 64 --out bench.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def build_model(feat=16, hidden=32, classes=10):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    class PoolMLP(mx.gluon.HybridBlock):
+        """(B, L, feat) -> mean over L -> MLP.  L varies per bucket."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.h = nn.Dense(hidden, activation="relu", in_units=feat)
+                self.out = nn.Dense(classes, in_units=hidden)
+
+        def hybrid_forward(self, F, x):
+            return self.out(self.h(F.mean(x, axis=1)))
+
+    net = PoolMLP()
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def run_bench(clients, requests_per_client, shapes, max_batch, linger_ms,
+              timeout_ms, max_queue):
+    from mxnet_tpu import serving
+
+    net = build_model(feat=shapes[0][-1])
+    server = serving.ModelServer()
+    t0 = time.monotonic()
+    model = server.load_model("bench", net, input_shapes=shapes,
+                              max_batch=max_batch, linger_ms=linger_ms,
+                              max_queue=max_queue)
+    warmup_s = time.monotonic() - t0
+
+    rng = np.random.RandomState(0)
+    payloads = [rng.randn(*s).astype(np.float32) for s in shapes]
+    latencies, statuses = [], {}
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients)
+
+    def client(cid):
+        barrier.wait()
+        for i in range(requests_per_client):
+            x = payloads[(cid + i) % len(payloads)]
+            res = server.predict("bench", x, timeout_ms=timeout_ms)
+            with lock:
+                statuses[res.status] = statuses.get(res.status, 0) + 1
+                if res.status == serving.OK:
+                    latencies.append(res.latency_ms)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t0
+
+    snap = server.stats()["models"]["bench"]
+    server.stop()
+
+    total = clients * requests_per_client
+    # same nearest-rank estimator the server's stats() reports, so bench
+    # artifacts and server snapshots agree on what "p99" means
+    from mxnet_tpu.serving.stats import LatencyWindow
+    window = LatencyWindow(capacity=max(1, len(latencies)))
+    for ms in latencies:
+        window.add(ms)
+    pcts = {k: round(v, 3) for k, v in window.percentiles().items()}
+
+    return {
+        "workload": {
+            "clients": clients,
+            "requests_per_client": requests_per_client,
+            "total_requests": total,
+            "shapes": [list(s) for s in shapes],
+            "max_batch": max_batch,
+            "linger_ms": linger_ms,
+            "timeout_ms": timeout_ms,
+        },
+        "warmup_s": round(warmup_s, 3),
+        "wall_s": round(wall_s, 3),
+        "throughput_rps": round(total / wall_s, 1) if wall_s else 0.0,
+        "latency_ms": pcts,
+        "statuses": statuses,
+        "avg_batch": round(snap["avg_batch"], 3),
+        "pad_waste": round(snap["pad_waste"], 4),
+        "cache": snap["cache"],
+        "warmup": snap["warmup"],
+        "steady_state_recompiles": (snap["cache"]["recompiles"]
+                                    - snap["warmup"]["cache"]["misses"]),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="serve_bench", description=__doc__)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=40,
+                    help="requests per client")
+    ap.add_argument("--shapes", default="4x16,8x16,16x16,32x16",
+                    help="comma list of LxF per-request shapes")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--linger-ms", type=float, default=2.0)
+    ap.add_argument("--timeout-ms", type=float, default=5000.0)
+    ap.add_argument("--max-queue", type=int, default=1024)
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SERVE.json"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="small fast run for tier-1 (overrides sizes)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.clients, args.requests = 4, 6
+        args.shapes = "4x16,8x16"
+        args.max_batch = 4          # 6 warmup compiles: cheap on 1-core CI
+    shapes = [tuple(int(d) for d in s.split("x"))
+              for s in args.shapes.split(",")]
+
+    report = run_bench(args.clients, args.requests, shapes, args.max_batch,
+                       args.linger_ms, args.timeout_ms, args.max_queue)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print("throughput: %s req/s  p50/p95/p99: %s/%s/%s ms  avg_batch: %s  "
+          "steady-state recompiles: %d"
+          % (report["throughput_rps"], report["latency_ms"]["p50"],
+             report["latency_ms"]["p95"], report["latency_ms"]["p99"],
+             report["avg_batch"], report["steady_state_recompiles"]))
+    print("wrote %s" % args.out)
+    return 0 if report["steady_state_recompiles"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
