@@ -1,7 +1,6 @@
 """Static analysis passes over the TPU build (``tools/mxlint.py`` front end).
 
-Three passes, one per defect class the round-5 postmortem showed the green
-test suite cannot see:
+Four passes, one per defect class the green test suite cannot see:
 
 * :mod:`.tracing_lint` — AST pass over ``mxnet_tpu/`` for tracer
   concretization, implicit host syncs inside fcompute bodies, and
@@ -11,6 +10,11 @@ test suite cannot see:
   shape/dtype/gradient coverage, nd/sym bindings, and test coverage.
 * :mod:`.cabi_lint` — pattern pass over ``src/c_api.cc`` for bridge-return
   dereferences without null/type guards.
+* :mod:`.concurrency_lint` — concurrency-safety pass over ``mxnet_tpu/``:
+  guarded-by inference per class, unguarded module-global writes,
+  lock-order cycle detection, thread-target hygiene.  Its dynamic twin is
+  :mod:`.schedule` (``tools/mxstress.py``), a seeded adversarial-schedule
+  stress harness over the threaded runtime.
 
 All passes emit :class:`.common.Finding` records keyed by stable identity
 (rule + path + scope + detail, no line numbers) so a checked-in baseline

@@ -30,7 +30,7 @@ DEFAULT_BASELINE = ".mxlint-baseline.json"
 # updates so `--passes tracing --update-baseline` cannot drop the other
 # passes' suppressions)
 RULE_FAMILY_PASS = {"TRC": "tracing", "HSY": "tracing", "RNG": "tracing",
-                    "REG": "registry", "ABI": "cabi"}
+                    "REG": "registry", "ABI": "cabi", "CON": "concur"}
 
 
 def pass_of_key(key):

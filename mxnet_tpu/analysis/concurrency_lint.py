@@ -1,0 +1,600 @@
+"""Concurrency-safety linter (the ``concur`` pass): lock discipline over
+``mxnet_tpu/``.
+
+PR 2 made the framework genuinely multi-threaded (serving batcher workers,
+registry load/unload, profiler counters, CachedOp stats); this pass makes
+lock discipline *checkable* instead of folklore.  Four rule families:
+
+``CON101`` — guarded-by violations, inferred per class.  An attribute whose
+every write (outside ``__init__``) happens inside a ``with self._lock:`` /
+``with self._cond:`` block is *guarded*; a read of a guarded attribute
+outside any lock block is a stale/torn-read hazard and fires.  An attribute
+written both inside and outside lock blocks fires on the unlocked writes
+(mixed discipline is worse than none: the locked sites suggest the unlocked
+ones are oversights).  Attributes only ever written in ``__init__`` are
+immutable-after-construction and exempt; attributes never written under a
+lock carry no inferred contract (CON104 covers the thread-target subset).
+
+``CON102`` — module-level mutable state written outside a lock.  Fires on
+``global X`` rebinds and on mutations (subscript stores, ``.update()`` /
+``.append()`` / … calls) of module-level dict/list/set/deque globals from
+inside a function with no lock held.  Import-time (module top-level) writes
+are exempt — imports are serialized by the import lock.  Globals bound to
+``threading.local()`` (or a subclass defined in the same file) are exempt:
+thread-local state is the sanctioned lock-free pattern (``engine.bulk``).
+
+``CON103`` — lock-order hazards.  Every syntactic nesting ``with A: …
+with B:`` adds an A→B edge to a lock-order graph (locks identified by
+class-qualified attribute name); a cycle means two call paths can acquire
+the same locks in opposite orders — the classic ABBA deadlock.  Acquiring a
+lock *known* to be a plain ``threading.Lock`` while already holding it is
+an immediate self-deadlock and also fires (``RLock``/``Condition`` are
+reentrant and exempt).
+
+``CON104`` — thread-target hygiene.  A function handed to
+``threading.Thread(target=...)`` runs concurrently with everything else by
+construction; any write it makes to ``self.<attr>`` outside a lock block
+(to an attribute with no locked-write contract) fires.  Reads are not
+flagged (too noisy: config reads of immutable attrs are idiomatic); writes
+to module globals are CON102's job and are not double-reported.
+
+Known limitations (documented in docs/LINT.md): the analysis is syntactic
+and per-file — aliased locks, locks passed across modules, and mutations
+through non-``self`` references are invisible; nested ``def``s inherit the
+lock context of their definition site.  The dynamic side of this pass is
+``mxnet_tpu/analysis/schedule.py`` (tools/mxstress.py), which catches what
+static inference cannot.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .common import Finding, apply_line_suppressions, relpath
+
+__all__ = ["run", "lint_file", "lint_source"]
+
+# attribute / variable names treated as locks when used in `with`:
+# token match (underscore-split) plus an explicit `_lock` suffix — NOT a
+# substring test: 'seconds' must not read as a condition variable,
+# 'semantics' as a semaphore, nor (critically, in a Gluon codebase)
+# 'block' as a lock via a bare endswith("lock")
+_LOCK_TOKENS = frozenset({
+    "lock", "rlock", "mutex", "cond", "condition", "condvar", "cv",
+    "sem", "semaphore"})
+
+
+def _is_lockish(name):
+    low = name.lower()
+    if low.endswith("_lock"):
+        return True
+    return any(tok in _LOCK_TOKENS for tok in low.split("_"))
+# method calls that mutate their receiver (container mutation = write)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popitem", "popleft", "remove",
+    "discard", "clear", "sort", "reverse"})
+# constructors whose result is module-level mutable state worth guarding
+_MUTABLE_CTORS = frozenset({
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter", "bytearray"})
+_LOCK_CTORS = frozenset({
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"})
+_REENTRANT = frozenset({"RLock", "Condition"})  # Condition wraps an RLock
+_INIT_METHODS = frozenset({"__init__", "__new__", "__del__"})
+
+
+def _expr_str(node):
+    """Readable dotted form of a Name/Attribute chain ('' if neither)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_str(node.value)
+        return base + "." + node.attr if base else ""
+    return ""
+
+
+def _lock_key(node, class_name):
+    """Identity of a lock expression in a `with` item, or None.
+
+    `self._lock` is class-scoped (each instance has its own, but the
+    *ordering discipline* is per class); a bare `_lock` is module-scoped.
+    """
+    s = _expr_str(node)
+    if not s:
+        return None
+    last = s.rsplit(".", 1)[-1]
+    if not _is_lockish(last):
+        return None
+    if s.startswith("self.") and class_name:
+        return "%s.%s" % (class_name, s[len("self."):])
+    return s
+
+
+def _ctor_name(value):
+    """`threading.Lock()` / `Lock()` / `deque()` -> 'Lock' / 'deque'."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _mutation_base(node):
+    """Peel Subscript/Attribute chains off a write target or mutator
+    receiver down to the object actually mutated.
+
+    `self.x[k] = v` mutates `self.x`; `x[k].y = v` mutates (something
+    reached from) `x`.  Returns ('self', attr) | ('name', id) | None.
+    """
+    n = node
+    while isinstance(n, (ast.Subscript, ast.Attribute)):
+        if (isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name)
+                and n.value.id == "self"):
+            return ("self", n.attr)
+        n = n.value
+    if isinstance(n, ast.Name):
+        return ("name", n.id)
+    return None
+
+
+def _assigned_names(fn):
+    """Names bound locally in a function body (shadow detection)."""
+    out = set(a.arg for a in fn.args.args + fn.args.posonlyargs
+              + fn.args.kwonlyargs)
+    if fn.args.vararg:
+        out.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        out.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Name):
+                            out.add(el.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            t = node.target
+            for el in ast.walk(t):
+                if isinstance(el, ast.Name):
+                    out.add(el.id)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for el in ast.walk(item.optional_vars):
+                        if isinstance(el, ast.Name):
+                            out.add(el.id)
+    return out
+
+
+class _Access(object):
+    __slots__ = ("attr", "write", "held", "line", "method")
+
+    def __init__(self, attr, write, held, line, method):
+        self.attr = attr
+        self.write = write
+        self.held = frozenset(held)   # lock keys held at the access
+        self.line = line
+        self.method = method
+
+    @property
+    def locked(self):
+        return bool(self.held)
+
+
+class _ModuleInfo(object):
+    """Module-level facts: mutable globals, lock globals, local()s."""
+
+    def __init__(self, tree):
+        self.mutables = {}       # name -> lineno of the defining assign
+        self.locks = {}          # name -> ctor kind
+        self.local_exempt = set()  # names bound to threading.local (subclass)
+        local_classes = {
+            node.name for node in tree.body
+            if isinstance(node, ast.ClassDef)
+            and any(_expr_str(b).rsplit(".", 1)[-1] == "local"
+                    for b in node.bases)}
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                v = node.value
+                ctor = _ctor_name(v)
+                if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                                  ast.ListComp, ast.SetComp)):
+                    self.mutables[t.id] = node.lineno
+                elif ctor in _MUTABLE_CTORS:
+                    self.mutables[t.id] = node.lineno
+                elif ctor in _LOCK_CTORS:
+                    self.locks[t.id] = ctor
+                elif ctor == "local" or ctor in local_classes:
+                    self.local_exempt.add(t.id)
+
+
+class _Linter(object):
+    def __init__(self, path, source):
+        self.path = path
+        self.findings = []
+        self.tree = ast.parse(source, filename=path)
+        self.mod = _ModuleInfo(self.tree)
+        # lock-order edges: (from_key, to_key) -> (line, scope)
+        self.edges = {}
+        self.lock_kinds = dict(self.mod.locks)   # key -> ctor kind
+        # thread targets discovered: [(class_name or None, func_name, line)]
+        self.thread_targets = []
+        # per-class access records: class -> [Access]
+        self.class_accesses = {}
+        self._walk_module()
+        self._emit_guarded_by()
+        self._emit_thread_targets()
+        self._emit_lock_order()
+
+    # -- traversal -------------------------------------------------------
+
+    def _walk_module(self):
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._walk_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(node, class_name=None)
+
+    def _walk_class(self, cls):
+        self.class_accesses.setdefault(cls.name, [])
+        # lock attribute kinds: self.X = threading.Lock() anywhere in class
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                ctor = _ctor_name(node.value)
+                if ctor in _LOCK_CTORS:
+                    for t in node.targets:
+                        b = _mutation_base(t)
+                        if b and b[0] == "self":
+                            self.lock_kinds["%s.%s" % (cls.name, b[1])] = ctor
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(node, class_name=cls.name)
+            elif isinstance(node, ast.ClassDef):
+                self._walk_class(node)   # nested class: analyzed on its own
+
+    def _walk_function(self, fn, class_name, held=()):
+        scope = (class_name + "." + fn.name) if class_name else fn.name
+        locals_ = _assigned_names(fn)
+        globals_ = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_.update(node.names)
+        ctx = {
+            "class": class_name, "method": fn.name, "scope": scope,
+            "locals": locals_ - globals_, "globals": globals_,
+        }
+        self._walk_stmts(fn.body, held, ctx)
+
+    def _walk_stmts(self, body, held, ctx):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: analyzed with the lock context of its
+                # definition site (thread targets get CON104 separately)
+                self._walk_function(stmt, ctx["class"], held=held)
+                continue
+            if isinstance(stmt, ast.With):
+                acquired = []
+                for item in stmt.items:
+                    # the lock expression itself is evaluated pre-acquire
+                    self._scan_expr(item.context_expr, held, ctx)
+                    key = _lock_key(item.context_expr, ctx["class"])
+                    if key is not None:
+                        if key in held or key in acquired:
+                            kind = self.lock_kinds.get(key)
+                            if kind == "Lock":
+                                self._add(
+                                    "CON103", stmt, ctx["scope"],
+                                    "re-acquiring non-reentrant lock %r "
+                                    "while already holding it: guaranteed "
+                                    "self-deadlock" % key, detail=key)
+                        for h in held + tuple(acquired):
+                            if h != key:
+                                self.edges.setdefault(
+                                    (h, key), (stmt.lineno, ctx["scope"]))
+                        acquired.append(key)
+                self._walk_stmts(stmt.body, held + tuple(acquired), ctx)
+                continue
+            # this statement's own (header) expressions, then sub-bodies
+            for expr in self._own_exprs(stmt):
+                self._scan_expr(expr, held, ctx)
+            self._scan_thread_ctor(stmt, ctx)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._walk_stmts(sub, held, ctx)
+            for h in getattr(stmt, "handlers", ()):
+                self._walk_stmts(h.body, held, ctx)
+
+    @staticmethod
+    def _own_exprs(stmt):
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, ast.For):
+            return [stmt.iter, stmt.target]
+        if isinstance(stmt, ast.Try):
+            return []
+        return [stmt]
+
+    # -- access recording ------------------------------------------------
+
+    def _scan_expr(self, node, held, ctx):
+        # writes: assignment / deletion / augassign targets
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._record_write_target(t, held, ctx)
+            self._scan_reads(node.value, held, ctx)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            self._record_write_target(node.target, held, ctx)
+            if node.value is not None:
+                self._scan_reads(node.value, held, ctx)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._record_write_target(t, held, ctx)
+            return
+        self._scan_reads(node, held, ctx)
+
+    def _record_write_target(self, target, held, ctx):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._record_write_target(el, held, ctx)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_write_target(target.value, held, ctx)
+            return
+        base = _mutation_base(target)
+        if base is None:
+            return
+        if base[0] == "self":
+            self._record_self(base[1], True, held, target, ctx)
+        else:
+            self._record_global_write(base[1], held, target, ctx)
+        # a subscript store also *reads* the container expression
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            self._scan_reads(target.value, held, ctx)
+            if isinstance(target, ast.Subscript):
+                self._scan_reads(target.slice, held, ctx)
+
+    def _scan_reads(self, node, held, ctx):
+        """Record self-attr reads and mutator-call writes inside ``node``."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                        ast.Attribute):
+                if sub.func.attr in _MUTATORS:
+                    base = _mutation_base(sub.func.value)
+                    if base is not None:
+                        if base[0] == "self":
+                            self._record_self(base[1], True, held, sub, ctx)
+                        else:
+                            self._record_global_write(base[1], held, sub,
+                                                      ctx)
+            elif (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and isinstance(sub.ctx, ast.Load)):
+                self._record_self(sub.attr, False, held, sub, ctx)
+
+    def _record_self(self, attr, write, held, node, ctx):
+        if ctx["class"] is None or _is_lockish(attr):
+            return
+        self.class_accesses[ctx["class"]].append(_Access(
+            attr, write, held, getattr(node, "lineno", 0), ctx["method"]))
+
+    def _record_global_write(self, name, held, node, ctx):
+        """CON102: unlocked mutation of module-level mutable state."""
+        if held:
+            return
+        if name in self.mod.local_exempt:
+            return
+        is_global_rebind = name in ctx["globals"]
+        is_known_mutable = (name in self.mod.mutables
+                            and name not in ctx["locals"])
+        if not (is_global_rebind or is_known_mutable):
+            return
+        what = ("global rebind of %r" % name if is_global_rebind
+                and not is_known_mutable
+                else "mutation of module-level mutable %r" % name)
+        self._add(
+            "CON102", node, ctx["scope"],
+            "%s outside any lock: concurrent callers race "
+            "(guard with a module lock, or make it threading.local)"
+            % what, detail=name)
+
+    def _scan_thread_ctor(self, stmt, ctx):
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                t = kw.value
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    self.thread_targets.append(
+                        (ctx["class"], t.attr, node.lineno))
+                elif isinstance(t, ast.Name):
+                    self.thread_targets.append((None, t.id, node.lineno))
+
+    # -- finding emission ------------------------------------------------
+
+    def _emit_guarded_by(self):
+        for cls, accesses in sorted(self.class_accesses.items()):
+            per_attr = {}
+            for a in accesses:
+                if a.method in _INIT_METHODS:
+                    continue
+                per_attr.setdefault(a.attr, []).append(a)
+            for attr, accs in sorted(per_attr.items()):
+                locked_w = [a for a in accs if a.write and a.locked]
+                unlocked_w = [a for a in accs if a.write and not a.locked]
+                if not locked_w:
+                    continue     # no inferred lock contract
+                if unlocked_w:
+                    for a in unlocked_w:
+                        self._add_at(
+                            "CON101", a.line, "%s.%s" % (cls, a.method),
+                            "attribute %r is written under a lock in "
+                            "%s but written WITHOUT one here: mixed "
+                            "discipline, lost-update race"
+                            % (attr, ", ".join(sorted(
+                                {x.method for x in locked_w}))),
+                            detail=attr)
+                    continue
+                # every write holds SOME lock — but they must share one:
+                # writes under disjoint locks do not exclude each other
+                common = frozenset.intersection(
+                    *[a.held for a in locked_w])
+                if not common:
+                    all_locks = sorted(set().union(
+                        *[a.held for a in locked_w]))
+                    for a in locked_w:
+                        self._add_at(
+                            "CON101", a.line, "%s.%s" % (cls, a.method),
+                            "attribute %r is written under DIFFERENT locks "
+                            "(%s) with no lock common to every writer: the "
+                            "writers do not exclude each other"
+                            % (attr, ", ".join(all_locks)), detail=attr)
+                    continue
+                # a read is only safe holding one of the writers' common
+                # locks — a *different* lock excludes nothing
+                for a in accs:
+                    if a.write or a.held & common:
+                        continue
+                    self._add_at(
+                        "CON101", a.line, "%s.%s" % (cls, a.method),
+                        "attribute %r is guarded by %s (every write holds "
+                        "it) but read %s here: torn/stale read"
+                        % (attr, "/".join(sorted(common)),
+                           "under a different lock" if a.held
+                           else "WITHOUT it"), detail=attr)
+
+    def _emit_thread_targets(self):
+        methods = {}
+        for cls, accesses in self.class_accesses.items():
+            for a in accesses:
+                methods.setdefault((cls, a.method), []).append(a)
+        # dedupe: a Thread() inside a compound statement is seen by both
+        # the compound's scan and the nested statement's; two spawn sites
+        # of one target must also not double-report its writes
+        for cls, name in sorted({(c, n) for c, n, _ in self.thread_targets
+                                 if c is not None}):
+            guarded = set()
+            for a in self.class_accesses.get(cls, ()):
+                if a.write and a.locked:
+                    guarded.add(a.attr)
+            for a in methods.get((cls, name), ()):
+                if a.write and not a.locked and a.attr not in guarded:
+                    self._add_at(
+                        "CON104", a.line, "%s.%s" % (cls, name),
+                        "thread target %s.%s writes %r outside any lock; "
+                        "the spawning thread (and every other) can observe "
+                        "or race this write" % (cls, name, a.attr),
+                        detail=a.attr)
+
+    def _emit_lock_order(self):
+        # cycle detection over this file's lock-order graph (Tarjan SCC)
+        graph = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index, low, onstack, stack = {}, {}, set(), []
+        sccs, counter = [], [0]
+
+        def strongconnect(v):
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            onstack.add(v)
+            for w in graph[v]:
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in onstack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            comp = sorted(comp)
+            sites = sorted(
+                (line, scope, a, b)
+                for (a, b), (line, scope) in self.edges.items()
+                if a in comp and b in comp)
+            line, scope = sites[0][0], sites[0][1]
+            self._add_at(
+                "CON103", line, scope,
+                "lock-order cycle between {%s}: opposite acquisition "
+                "orders can deadlock (%s)" % (
+                    ", ".join(comp),
+                    "; ".join("%s->%s in %s:%d" % (a, b, sc, ln)
+                              for ln, sc, a, b in sites)),
+                detail="->".join(comp))
+
+    def _add(self, rule, node, scope, message, detail=""):
+        self._add_at(rule, getattr(node, "lineno", 0), scope, message,
+                     detail=detail)
+
+    def _add_at(self, rule, line, scope, message, detail=""):
+        self.findings.append(Finding(rule, self.path, line, scope, message,
+                                     detail=detail))
+
+
+def lint_source(source, path):
+    """Lint one python source string; returns a list of Findings."""
+    try:
+        linter = _Linter(path, source)
+    except SyntaxError as e:
+        return [Finding("CON100", path, e.lineno or 0, "<module>",
+                        "syntax error: %s" % e.msg)]
+    findings = sorted(linter.findings,
+                      key=lambda f: (f.line, f.rule, f.detail))
+    return apply_line_suppressions(findings, source.splitlines())
+
+
+def lint_file(filename, root):
+    with open(filename) as f:
+        source = f.read()
+    return lint_source(source, relpath(filename, root))
+
+
+def run(root, package_dir=None):
+    """Lint every .py under ``package_dir`` (default ``<root>/mxnet_tpu``)."""
+    package_dir = package_dir or os.path.join(root, "mxnet_tpu")
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fn), root))
+    return findings

@@ -26,19 +26,22 @@ from __future__ import annotations
 import inspect
 import os
 import re
+import threading
 
 from .common import Finding
 
 __all__ = ["run", "audit"]
 
 _CORPUS_CACHE = {}
+_CORPUS_CACHE_LOCK = threading.Lock()
 
 
 def _tests_corpus(tests_dir):
     """Concatenated source of every test file (fixtures excluded)."""
     key = os.path.abspath(tests_dir)
-    if key in _CORPUS_CACHE:
-        return _CORPUS_CACHE[key]
+    with _CORPUS_CACHE_LOCK:
+        if key in _CORPUS_CACHE:
+            return _CORPUS_CACHE[key]
     parts = []
     for dirpath, dirnames, filenames in os.walk(tests_dir):
         dirnames[:] = [d for d in dirnames
@@ -49,7 +52,8 @@ def _tests_corpus(tests_dir):
                           errors="replace") as f:
                     parts.append(f.read())
     corpus = "\n".join(parts)
-    _CORPUS_CACHE[key] = corpus
+    with _CORPUS_CACHE_LOCK:
+        _CORPUS_CACHE[key] = corpus
     return corpus
 
 

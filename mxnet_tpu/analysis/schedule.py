@@ -1,0 +1,518 @@
+"""Seeded adversarial-schedule stress harness for the threaded runtime.
+
+The static half of the concurrency story (`concurrency_lint`, the ``concur``
+mxlint pass) proves lock *discipline*; this module attacks lock
+*sufficiency*: it runs the real threaded subsystems — the serving
+admission/coalescing path, registry load/unload churn, the CachedOp
+compile-cache counters, and ``engine.bulk`` scoping — under seeded
+adversarial preemption and asserts runtime invariants.
+
+How preemption is injected
+--------------------------
+``chaos(sched)`` monkeypatches ``threading.Lock`` and ``threading.RLock``
+so every lock *created inside the scope* is wrapped: each ``acquire()``
+(and each release) first consults a seeded RNG and, with probability
+``p_preempt``, sleeps 0..``max_sleep_ms`` — stretching critical sections
+and shifting thread interleavings at exactly the points where races
+surface.  ``threading.Condition`` and ``threading.Event`` pick the wrapped
+primitives up automatically (their internals call the patched factories),
+so the batcher's condition variable and every Request's completion event
+are perturbed without touching library code.  Seeds diversify the
+perturbation pattern; runs are adversarial and reproducible in
+distribution, not bit-identical replays (the OS still schedules).
+
+Invariants asserted (per seed)
+------------------------------
+* **no lost requests** — every submitted request reaches exactly one
+  terminal status, and the per-model counters conserve:
+  ``requests == ok + timeouts + errors``, shed/invalid tallies match the
+  client-observed rejections.
+* **no torn results** — an OK result carries outputs that match the
+  eager reference for *that client's* input (catches batch-row mixups);
+  a TIMEOUT result never carries outputs (the Request completion race
+  regression).
+* **monotonic counters** — a monitor thread snapshots stats during the
+  storm; no counter ever decreases, and the compile cache records ZERO
+  new misses after warmup (the zero-steady-state-recompile serving gate,
+  now asserted under contention).
+* **no deadlock** — every worker/client joins within a timeout.
+* **registry churn safety** — concurrent load/unload/duplicate-load only
+  ever fail with MXNetError, and the registry ends in the expected state.
+* **bulk scoping** — ``engine.bulk`` scopes stay per-thread.
+
+``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
+wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1.
+"""
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+
+__all__ = ["ChaosScheduler", "chaos", "stress", "SMOKE_SEEDS"]
+
+# real primitives captured at import time: the wrappers and the scheduler
+# must keep working while threading.Lock/RLock point at the factories
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+SMOKE_SEEDS = tuple(range(25))
+_JOIN_TIMEOUT_S = 20.0
+
+
+class ChaosScheduler(object):
+    """Seeded preemption source shared by every wrapped lock."""
+
+    def __init__(self, seed=0, p_preempt=0.25, max_sleep_ms=0.5):
+        self._rng_lock = _REAL_LOCK()
+        self._rng = random.Random(seed)
+        self.p_preempt = float(p_preempt)
+        self.max_sleep_s = float(max_sleep_ms) / 1e3
+        self.enabled = True
+        self.preemptions = 0
+
+    def reseed(self, seed):
+        with self._rng_lock:
+            self._rng.seed(seed)
+
+    def maybe_preempt(self):
+        if not self.enabled:
+            return
+        with self._rng_lock:
+            fire = self._rng.random() < self.p_preempt
+            dur = self._rng.random() * self.max_sleep_s if fire else 0.0
+            if fire:
+                self.preemptions += 1
+        if fire:
+            time.sleep(dur)   # dur==0 still yields the GIL
+
+
+class _ChaosLock(object):
+    """``threading.Lock`` wrapper that preempts at acquire/release edges."""
+
+    _factory = staticmethod(_REAL_LOCK)
+
+    def __init__(self, sched):
+        self._sched = sched
+        self._inner = self._factory()
+
+    def acquire(self, blocking=True, timeout=-1):
+        self._sched.maybe_preempt()
+        return self._inner.acquire(blocking, timeout)
+
+    def release(self):
+        self._inner.release()
+        self._sched.maybe_preempt()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        # route through release() so `with lock:` — the dominant pattern in
+        # the code under test — gets the release-edge preemption too
+        self.release()
+
+    def __getattr__(self, name):
+        # Condition binds _release_save/_acquire_restore/_is_owned straight
+        # off the lock when present (RLock); delegate honestly so a plain
+        # Lock still raises AttributeError and Condition uses its fallbacks
+        return getattr(self._inner, name)
+
+
+class _ChaosRLock(_ChaosLock):
+    _factory = staticmethod(_REAL_RLOCK)
+
+
+@contextlib.contextmanager
+def chaos(sched):
+    """Patch the lock factories so locks created inside are chaos-wrapped.
+
+    Objects built in the scope keep their wrapped locks after exit; set
+    ``sched.enabled = False`` to stop perturbing them (each acquire then
+    costs one attribute check)."""
+    real = (threading.Lock, threading.RLock)
+    threading.Lock = lambda: _ChaosLock(sched)
+    threading.RLock = lambda: _ChaosRLock(sched)
+    try:
+        yield sched
+    finally:
+        threading.Lock, threading.RLock = real
+
+
+# ---------------------------------------------------------------------------
+# fixture: one tiny servable model + eager references
+# ---------------------------------------------------------------------------
+
+_FEAT = 6
+_CLASSES = 3
+
+
+def _build_fixture(n_clients, max_queue):
+    """-> (server, model_name, net, client_inputs, client_expected)."""
+    import numpy as np
+    from .. import gluon, init
+    from ..gluon import nn
+    from .. import ndarray as nd
+    from .. import serving
+
+    class _Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.out = nn.Dense(_CLASSES, in_units=_FEAT)
+
+        def hybrid_forward(self, F, x):
+            return self.out(x)
+
+    net = _Net()
+    net.initialize(init.Xavier())
+    server = serving.ModelServer()
+    server.load_model("stable", net, input_shapes=[(_FEAT,)], max_batch=4,
+                      max_queue=max_queue, linger_ms=1.0, warmup=True)
+    inputs, expected = [], []
+    for i in range(n_clients):
+        x = np.full((_FEAT,), 0.25 * (i + 1), np.float32)
+        inputs.append(x)
+        expected.append(net(nd.array(x[None])).asnumpy()[0])
+    return server, "stable", net, inputs, expected
+
+
+def _spawn(fns):
+    """Run thunks on threads; -> (violations from joins, exceptions list)."""
+    errors = []
+    threads = []
+
+    def runner(fn):
+        try:
+            fn()
+        except Exception as exc:   # an invariant harness must not die silently
+            errors.append("unexpected exception: %r" % (exc,))
+
+    for fn in fns:
+        t = threading.Thread(target=runner, args=(fn,), daemon=True)
+        threads.append(t)
+        t.start()
+    violations = []
+    for t in threads:
+        t.join(_JOIN_TIMEOUT_S)
+        if t.is_alive():
+            violations.append("deadlock: thread %s did not join within %ss"
+                              % (t.name, _JOIN_TIMEOUT_S))
+    violations.extend(errors)
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: serving storm
+# ---------------------------------------------------------------------------
+
+def serving_storm(server, name, inputs, expected, seed, per_client=3):
+    """Concurrent mixed-deadline predicts; full invariant suite."""
+    import numpy as np
+    from ..serving import server as srv
+
+    terminal = {srv.OK, srv.TIMEOUT, srv.OVERLOADED, srv.INVALID_INPUT,
+                srv.ERROR}
+    rng = random.Random(seed ^ 0xC0FFEE)
+    n_clients = len(inputs)
+    before = server.stats()["models"][name]
+    results = [[] for _ in range(n_clients)]
+    plans = []
+    for c in range(n_clients):
+        plan = []
+        for r in range(per_client):
+            roll = rng.random()
+            if roll < 0.2:
+                plan.append(("tiny", rng.uniform(0.2, 2.0)))   # likely TIMEOUT
+            elif roll < 0.3:
+                plan.append(("invalid", None))                 # wrong shape
+            elif roll < 0.5:
+                plan.append(("none", None))                    # no deadline
+            else:
+                plan.append(("ok", rng.uniform(150.0, 400.0)))
+        plans.append(plan)
+
+    def client(c):
+        for kind, tmo in plans[c]:
+            if kind == "invalid":
+                data = np.zeros((_FEAT + 1,), np.float32)
+            else:
+                data = inputs[c]
+            res = server.predict(name, data, timeout_ms=tmo)
+            results[c].append(res)
+
+    # monitor: counters must never go backwards mid-storm
+    stop = threading.Event()
+    monitor_violations = []
+
+    def monitor():
+        keys = ("requests", "ok", "timeouts", "shed", "invalid", "errors",
+                "batches")
+        prev = None
+        while not stop.is_set():
+            snap = server.stats()["models"][name]
+            cache = snap["cache"]
+            cur = tuple(snap[k] for k in keys) + (
+                cache["hits"] + cache["misses"],)
+            if prev is not None:
+                for k, p, c in zip(keys + ("cache_total",), prev, cur):
+                    if c < p:
+                        monitor_violations.append(
+                            "counter %r went backwards: %s -> %s" % (k, p, c))
+            prev = cur
+            time.sleep(0.002)
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+    violations = _spawn([lambda c=c: client(c) for c in range(n_clients)])
+    stop.set()
+    mon.join(_JOIN_TIMEOUT_S)
+    violations.extend(monitor_violations)
+
+    tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "OVERLOADED": 0,
+             "INVALID_INPUT": 0, "ERROR": 0}
+    for c in range(n_clients):
+        if len(results[c]) != len(plans[c]):
+            violations.append("client %d lost results: %d of %d"
+                              % (c, len(results[c]), len(plans[c])))
+        for (kind, _), res in zip(plans[c], results[c]):
+            if res is None or res.status not in terminal:
+                violations.append("client %d got non-terminal result %r"
+                                  % (c, res))
+                continue
+            tally[res.status] += 1
+            if res.status not in (srv.OVERLOADED, srv.INVALID_INPUT):
+                tally["admitted"] += 1
+            if res.status == srv.OK:
+                if res.outputs is None:
+                    violations.append("torn result: OK with outputs=None")
+                elif not np.allclose(res.outputs[0], expected[c],
+                                     rtol=1e-4, atol=1e-5):
+                    violations.append(
+                        "row mixup: client %d OK output does not match its "
+                        "reference" % c)
+            elif res.status == srv.TIMEOUT and res.outputs is not None:
+                violations.append(
+                    "torn result: TIMEOUT carrying outputs (completion race)")
+            if kind == "invalid" and res.status != srv.INVALID_INPUT:
+                violations.append("wrong-shape request got %s" % res.status)
+
+    # settle: a request's completion event fires BEFORE the worker's
+    # stats bump (complete() then on_result()), and the chaos locks
+    # stretch exactly that edge — give the counters a bounded window to
+    # conserve before treating an imbalance as a lost request
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = server.stats()["models"][name]
+        d = {k: after[k] - before[k] for k in
+             ("requests", "ok", "timeouts", "shed", "invalid", "errors")}
+        if (d["requests"] == d["ok"] + d["timeouts"] + d["errors"]
+                or time.monotonic() >= settle_until):
+            break
+        time.sleep(0.005)
+    if d["requests"] != tally["admitted"]:
+        violations.append("admission mismatch: server %d vs clients %d"
+                          % (d["requests"], tally["admitted"]))
+    if d["requests"] != d["ok"] + d["timeouts"] + d["errors"]:
+        violations.append(
+            "lost requests: admitted %d but only %d reached a terminal "
+            "counter" % (d["requests"],
+                         d["ok"] + d["timeouts"] + d["errors"]))
+    for client_key, server_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
+                                   ("OVERLOADED", "shed"),
+                                   ("INVALID_INPUT", "invalid"),
+                                   ("ERROR", "errors")):
+        if d[server_key] != tally[client_key]:
+            violations.append(
+                "%s count mismatch: server %d vs clients %d"
+                % (server_key, d[server_key], tally[client_key]))
+    cache_before, cache_after = before["cache"], after["cache"]
+    if cache_after["recompiles"] != cache_before["recompiles"]:
+        violations.append(
+            "steady-state recompile under contention: %d -> %d"
+            % (cache_before["recompiles"], cache_after["recompiles"]))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: registry load/unload churn
+# ---------------------------------------------------------------------------
+
+def registry_churn(server, name, net, inputs, seed, n_churners=2, rounds=2):
+    from ..base import MXNetError
+    from ..serving import server as srv
+
+    terminal = {srv.OK, srv.TIMEOUT, srv.OVERLOADED, srv.INVALID_INPUT,
+                srv.ERROR}
+    violations = []
+    dup_wins = []
+
+    def churner(tid):
+        for r in range(rounds):
+            cname = "churn-%d-%d" % (tid, r)
+            server.load_model(cname, net, input_shapes=[(_FEAT,)],
+                              max_batch=2, warmup=False)
+            server.unload(cname)
+
+    def dup_loader():
+        # both race to load the same name: exactly one may win
+        try:
+            server.load_model("dup", net, input_shapes=[(_FEAT,)],
+                              max_batch=2, warmup=False)
+            dup_wins.append(1)
+        except MXNetError:
+            pass
+
+    def predictor():
+        for _ in range(3):
+            res = server.predict(name, inputs[0], timeout_ms=300.0)
+            if res.status not in terminal:
+                violations.append("predict during churn: non-terminal %r"
+                                  % (res,))
+
+    fns = [lambda t=t: churner(t) for t in range(n_churners)]
+    fns += [dup_loader, dup_loader, predictor]
+    violations.extend(_spawn(fns))
+    if len(dup_wins) != 1:
+        violations.append("duplicate load: %d winners (want exactly 1)"
+                          % len(dup_wins))
+    # clean up unconditionally so one violated seed cannot poison the rest
+    if "dup" in server.models():
+        server.unload("dup")
+    models = server.models()
+    if models != [name]:
+        violations.append("registry left dirty after churn: %s" % models)
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: CachedOp cache-stats hammer
+# ---------------------------------------------------------------------------
+
+def cache_stats_hammer(server, name, seed, n_threads=2, execs_per_thread=6):
+    import numpy as np
+
+    model = server._registry.get(name)
+    before = model.cache_stats()
+    calls = [0] * n_threads
+
+    def hammer(tid):
+        rng = random.Random(seed * 31 + tid)
+        for _ in range(execs_per_thread):
+            rung = rng.choice([1, 2, 4])          # all warmed signatures
+            arrays = [np.zeros((rung, _FEAT), np.float32)]
+            outs = model.execute(arrays)
+            calls[tid] += 1
+            if outs[0].shape != (rung, _CLASSES):
+                raise AssertionError("bad output shape %s"
+                                     % (outs[0].shape,))
+
+    def reader():
+        for _ in range(40):
+            s = model.cache_stats()
+            hits = sum(r["hits"] for r in s["signatures"].values())
+            misses = sum(r["misses"] for r in s["signatures"].values())
+            if hits != s["hits"] or misses != s["misses"]:
+                raise AssertionError("inconsistent cache_stats snapshot")
+            time.sleep(0.001)
+
+    violations = _spawn([lambda t=t: hammer(t) for t in range(n_threads)]
+                        + [reader])
+    after = model.cache_stats()
+    if after["misses"] != before["misses"]:
+        violations.append("cache hammer caused recompiles: %d -> %d"
+                          % (before["misses"], after["misses"]))
+    expected_hits = before["hits"] + sum(calls)
+    if after["hits"] != expected_hits:
+        violations.append(
+            "lost cache-stat updates: %d dispatches but hits %d -> %d"
+            % (sum(calls), before["hits"], after["hits"]))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: engine.bulk thread scoping
+# ---------------------------------------------------------------------------
+
+def bulk_scopes(seed, n_threads=3):
+    from .. import engine
+
+    violations = []
+
+    def scoped(tid):
+        want = 100 + tid
+        with engine.bulk(want):
+            time.sleep(0.001 * (seed % 3))
+            if engine.bulk_size() != want:
+                violations.append(
+                    "bulk scope stomped: thread %d saw %d (want %d)"
+                    % (tid, engine.bulk_size(), want))
+            with engine.bulk(want * 10):
+                if engine.bulk_size() != want * 10:
+                    violations.append("nested bulk scope broken in %d" % tid)
+            if engine.bulk_size() != want:
+                violations.append("bulk scope not restored in thread %d"
+                                  % tid)
+        if engine.bulk_size() != 15:
+            violations.append("thread %d default bulk size polluted: %d"
+                              % (tid, engine.bulk_size()))
+
+    violations.extend(_spawn([lambda t=t: scoped(t)
+                              for t in range(n_threads)]))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+SCENARIOS = ("serving", "registry", "cache", "bulk")
+
+
+def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
+           max_sleep_ms=0.5, n_clients=4, per_client=3, max_queue=2,
+           log=None):
+    """Run the invariant suite under every seed; -> report dict.
+
+    ``report["violations"]`` is the flat total; zero means every seeded
+    interleaving preserved every invariant."""
+    sched = ChaosScheduler(0, p_preempt=p_preempt, max_sleep_ms=max_sleep_ms)
+    report = {"seeds": {}, "violations": 0, "preemptions": 0}
+    t0 = time.monotonic()
+    with chaos(sched):
+        server, name, net, inputs, expected = _build_fixture(
+            n_clients, max_queue)
+        try:
+            for seed in seeds:
+                sched.reseed(seed)
+                per_seed = {}
+                if "serving" in scenarios:
+                    per_seed["serving"] = serving_storm(
+                        server, name, inputs, expected, seed,
+                        per_client=per_client)
+                if "registry" in scenarios:
+                    per_seed["registry"] = registry_churn(
+                        server, name, net, inputs, seed)
+                if "cache" in scenarios:
+                    per_seed["cache"] = cache_stats_hammer(server, name,
+                                                           seed)
+                if "bulk" in scenarios:
+                    per_seed["bulk"] = bulk_scopes(seed)
+                n = sum(len(v) for v in per_seed.values())
+                report["seeds"][seed] = per_seed
+                report["violations"] += n
+                if log is not None:
+                    log("seed %3d: %s (%d preemption(s) so far)"
+                        % (seed, "ok" if not n else "%d VIOLATION(S)" % n,
+                           sched.preemptions))
+        finally:
+            sched.enabled = False
+            server.stop()
+    report["preemptions"] = sched.preemptions
+    report["elapsed_s"] = time.monotonic() - t0
+    return report

@@ -243,6 +243,7 @@ def make_jitted_vjp(fn):
 
 _BWD_JIT_CACHE = {}
 _BWD_JIT_CACHE_MAX = 512
+_BWD_JIT_CACHE_LOCK = threading.Lock()
 
 
 def _cached_bwd(fn):
@@ -254,14 +255,18 @@ def _cached_bwd(fn):
     dynamic-attr workloads (bucketed shapes) could otherwise grow compiled
     executables without limit; on overflow the oldest half is dropped
     (the jitted pairs are rebuilt on demand)."""
-    bwd = _BWD_JIT_CACHE.get(fn)
-    if bwd is None:
-        if len(_BWD_JIT_CACHE) >= _BWD_JIT_CACHE_MAX:
-            for k in list(_BWD_JIT_CACHE)[:_BWD_JIT_CACHE_MAX // 2]:
-                del _BWD_JIT_CACHE[k]
-        bwd = make_jitted_vjp(fn)
-        _BWD_JIT_CACHE[fn] = bwd
-    return bwd
+    # the lock spans the build too: make_jitted_vjp only wraps (XLA compile
+    # is deferred to first call), and it keeps two racing threads from
+    # caching two distinct jitted pairs for one traceable
+    with _BWD_JIT_CACHE_LOCK:
+        bwd = _BWD_JIT_CACHE.get(fn)
+        if bwd is None:
+            if len(_BWD_JIT_CACHE) >= _BWD_JIT_CACHE_MAX:
+                for k in list(_BWD_JIT_CACHE)[:_BWD_JIT_CACHE_MAX // 2]:
+                    del _BWD_JIT_CACHE[k]
+            bwd = make_jitted_vjp(fn)
+            _BWD_JIT_CACHE[fn] = bwd
+        return bwd
 
 
 def _propagate(order, cts):

@@ -8,6 +8,7 @@ payloads.  Opset 11 semantics.
 from __future__ import annotations
 
 import logging
+import threading
 
 import numpy as _np
 
@@ -25,12 +26,14 @@ TENSOR_TYPE = {
 }
 
 _CONVERTERS = {}
+_CONVERTERS_LOCK = threading.Lock()
 
 
 def register_export(*op_names):
     def deco(fn):
-        for name in op_names:
-            _CONVERTERS[name] = fn
+        with _CONVERTERS_LOCK:
+            for name in op_names:
+                _CONVERTERS[name] = fn
         return fn
     return deco
 

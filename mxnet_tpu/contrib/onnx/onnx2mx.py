@@ -6,6 +6,8 @@ python/mxnet/contrib/onnx/onnx2mx/import_model.py + _op_translations.py).
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as _np
 
 from . import onnx_pb2 as op_pb
@@ -22,12 +24,14 @@ _NP_TYPE = {
 }
 
 _IMPORTERS = {}
+_IMPORTERS_LOCK = threading.Lock()
 
 
 def register_import(*op_types):
     def deco(fn):
-        for name in op_types:
-            _IMPORTERS[name] = fn
+        with _IMPORTERS_LOCK:
+            for name in op_types:
+                _IMPORTERS[name] = fn
         return fn
     return deco
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import io
 import logging
 import os
+import threading
 
 import numpy as _np
 
@@ -19,11 +20,13 @@ from . import _constants as C
 from ... import ndarray as nd
 
 _EMBEDDING_REGISTRY = {}
+_EMBEDDING_REGISTRY_LOCK = threading.Lock()
 
 
 def register(embedding_cls):
     """Register a _TokenEmbedding subclass under its lowercased name."""
-    _EMBEDDING_REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    with _EMBEDDING_REGISTRY_LOCK:
+        _EMBEDDING_REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
     return embedding_cls
 
 

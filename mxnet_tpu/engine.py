@@ -27,15 +27,34 @@ fast one (same split the reference documents for Gluon)."""
 from __future__ import annotations
 
 import contextlib
+import threading
 
-_bulk_size = 15
+
+class _BulkState(threading.local):
+    """Per-thread bulking config.
+
+    The reference's bulk size is engine-global, but this runtime is
+    multi-threaded (serving batcher workers share the process with user
+    threads): a process-global here would let one worker's ``bulk()``
+    scope stomp another's.  Thread-local keeps ``bulk()`` a correct
+    dynamic scope per thread of control."""
+
+    def __init__(self):
+        self.size = 15
+
+
+_bulk = _BulkState()
 
 
 def set_bulk_size(size):
-    global _bulk_size
-    prev = _bulk_size
-    _bulk_size = size
+    prev = _bulk.size
+    _bulk.size = size
     return prev
+
+
+def bulk_size():
+    """The calling thread's current bulk size."""
+    return _bulk.size
 
 
 @contextlib.contextmanager

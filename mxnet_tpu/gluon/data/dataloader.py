@@ -10,6 +10,7 @@ batch in the consumer.  A num_workers=0 path runs synchronously in-process.
 from __future__ import annotations
 
 import multiprocessing as _mp
+import threading
 
 import numpy as _np
 
@@ -49,15 +50,24 @@ class _SimpleIter:
 
 
 _worker_dataset = None
+_worker_dataset_lock = threading.Lock()
 
 
 def _worker_init(dataset):
+    # process-pool workers each run this once in their own process, but the
+    # ThreadPool fallback runs it once per *thread* in one process — the
+    # lock makes the publish safe either way
     global _worker_dataset
-    _worker_dataset = dataset
+    with _worker_dataset_lock:
+        _worker_dataset = dataset
 
 
 def _worker_fn(batch_indices):
-    samples = [_worker_dataset[i] for i in batch_indices]
+    # paired with _worker_init's locked publish: in the ThreadPool fallback
+    # the initializer and the first work item can run on different threads
+    with _worker_dataset_lock:
+        dataset = _worker_dataset
+    samples = [dataset[i] for i in batch_indices]
     # return numpy-only payloads for cheap pickling
     def to_np(s):
         if isinstance(s, NDArray):

@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import json
 import re
+import threading
+
 import numpy as _np
 
 from . import random as _rand
@@ -15,10 +17,12 @@ from . import random as _rand
 from .base import string_types
 
 _INITIALIZER_REGISTRY = {}
+_INITIALIZER_REGISTRY_LOCK = threading.Lock()
 
 
 def register(klass):
-    _INITIALIZER_REGISTRY[klass.__name__.lower()] = klass
+    with _INITIALIZER_REGISTRY_LOCK:
+        _INITIALIZER_REGISTRY[klass.__name__.lower()] = klass
     return klass
 
 

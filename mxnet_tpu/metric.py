@@ -12,6 +12,8 @@ The reference instead hand-rolls the update loop in every class.
 from __future__ import annotations
 
 import math
+import threading
+
 import numpy
 
 from .ndarray import NDArray
@@ -24,18 +26,21 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1"
            "np", "create", "register"]
 
 _METRIC_REGISTRY = {}
+_METRIC_REGISTRY_LOCK = threading.Lock()
 
 
 def register(klass):
     """Register a metric class under its lowercased class name."""
-    _METRIC_REGISTRY[klass.__name__.lower()] = klass
+    with _METRIC_REGISTRY_LOCK:
+        _METRIC_REGISTRY[klass.__name__.lower()] = klass
     return klass
 
 
 def _alias(*aliases):
     def deco(klass):
         register(klass)
-        _METRIC_REGISTRY.update({a.lower(): klass for a in aliases})
+        with _METRIC_REGISTRY_LOCK:
+            _METRIC_REGISTRY.update({a.lower(): klass for a in aliases})
         return klass
     return deco
 

@@ -20,6 +20,7 @@ TPU-native design: two execution paths share the same user protocol.
 from __future__ import annotations
 
 import functools
+import threading
 
 import numpy as _np
 
@@ -93,13 +94,15 @@ class CustomOpProp:
 
 
 _CUSTOM_OP_REGISTRY = {}
+_CUSTOM_OP_REGISTRY_LOCK = threading.Lock()
 
 
 def register(reg_name):
     """Class decorator: ``@mx.operator.register("sqr")`` on a CustomOpProp
     subclass (reference operator.py register)."""
     def do_register(prop_cls):
-        _CUSTOM_OP_REGISTRY[reg_name] = prop_cls
+        with _CUSTOM_OP_REGISTRY_LOCK:
+            _CUSTOM_OP_REGISTRY[reg_name] = prop_cls
         return prop_cls
     return do_register
 

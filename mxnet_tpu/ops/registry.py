@@ -21,12 +21,16 @@ namespaces (ndarray/register.py), exactly like ``_init_op_module``.
 from __future__ import annotations
 
 import functools
+import threading
 
 from ..base import attrs_key, MXNetError
 
 __all__ = ["Op", "register", "register_sparse", "get_op", "list_ops", "alias"]
 
 _OP_REGISTRY = {}
+# registration is import-time for the built-ins, but custom ops may register
+# from any thread at runtime (operator.py), so writes hold the lock
+_REGISTRY_LOCK = threading.Lock()
 
 
 class Op:
@@ -218,9 +222,10 @@ class Op:
 def register(name, **kwargs):
     """Decorator: register ``fcompute`` under ``name``."""
     def deco(fcompute):
-        if name in _OP_REGISTRY:
-            raise MXNetError("op %s already registered" % name)
-        _OP_REGISTRY[name] = Op(name, fcompute, **kwargs)
+        with _REGISTRY_LOCK:
+            if name in _OP_REGISTRY:
+                raise MXNetError("op %s already registered" % name)
+            _OP_REGISTRY[name] = Op(name, fcompute, **kwargs)
         return fcompute
     return deco
 
@@ -239,15 +244,17 @@ def register_sparse(name):
 
 
 def register_op(op):
-    if op.name in _OP_REGISTRY:
-        raise MXNetError("op %s already registered" % op.name)
-    _OP_REGISTRY[op.name] = op
+    with _REGISTRY_LOCK:
+        if op.name in _OP_REGISTRY:
+            raise MXNetError("op %s already registered" % op.name)
+        _OP_REGISTRY[op.name] = op
     return op
 
 
 def alias(new_name, existing_name):
     """Register an alias (MXNet exposes many ops under several names)."""
-    _OP_REGISTRY[new_name] = _OP_REGISTRY[existing_name]
+    with _REGISTRY_LOCK:
+        _OP_REGISTRY[new_name] = _OP_REGISTRY[existing_name]
 
 
 def get_op(name):

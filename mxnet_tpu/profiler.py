@@ -33,7 +33,8 @@ _agg = defaultdict(lambda: [0, 0.0])  # name -> [count, total_ms]
 
 
 def set_config(**kwargs):
-    _config.update(kwargs)
+    with _lock:
+        _config.update(kwargs)
 
 
 def set_state(state_="stop", profile_process="worker"):
@@ -42,9 +43,12 @@ def set_state(state_="stop", profile_process="worker"):
 
 def _set_state(state_, fresh):
     import jax
-    if state_ == "run" and not _state["running"]:
-        if fresh:
-            with _lock:
+    if state_ == "run":
+        with _lock:
+            if _state["running"]:
+                return       # atomic check-and-claim: one starter wins
+            _state["running"] = True
+            if fresh:
                 # each session is a fresh trace: without this, a long-lived
                 # process that profiles periodically re-emits every prior
                 # session's spans on dump() and grows the buffer unboundedly.
@@ -54,20 +58,37 @@ def _set_state(state_, fresh):
                 # sessions unless the caller remembered dumps(reset=True).
                 _events.clear()
                 _agg.clear()
-        trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+            trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+        # the jax call runs unlocked (it can block on backend init); the
+        # claim above excludes a second start_trace, but a concurrent
+        # stop() may land in this window — detected and honored below
         try:
             jax.profiler.start_trace(trace_dir)
-            _state["trace_dir"] = trace_dir
         except Exception:
-            _state["trace_dir"] = None
-        _state["running"] = True
-    elif state_ == "stop" and _state["running"]:
-        if _state["trace_dir"] is not None:
+            trace_dir = None
+        with _lock:
+            if _state["running"]:
+                _state["trace_dir"] = trace_dir
+                trace_dir = None
+        if trace_dir is not None:
+            # a stop() interleaved before our trace existed and could not
+            # stop it; honor the stop rather than leak an active trace
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-        _state["running"] = False
+    elif state_ == "stop":
+        with _lock:
+            if not _state["running"]:
+                return
+            _state["running"] = False
+            trace_dir = _state["trace_dir"]
+            _state["trace_dir"] = None
+        if trace_dir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
 
 
 def state():

@@ -63,15 +63,29 @@ class Request:
         with self._done_lock:
             if self.status is not None:
                 return False
-            self.status = status
             self.outputs = outputs
             self.error = error
             self.latency_ms = (time.monotonic() - self.t_enqueue) * 1e3
+            # status is assigned LAST: it is the done flag every racing
+            # reader keys on, so a terminal status must never be visible
+            # before the fields that go with it
+            self.status = status
         self._event.set()
         return True
 
     def wait(self, timeout=None):
         return self._event.wait(timeout)
+
+    def snapshot(self):
+        """Atomic read of the terminal state.
+
+        Readers must NOT sample ``status``/``outputs``/``latency_ms`` as
+        separate unlocked reads: a deadline expiry racing a batch
+        completion could interleave them and pair a TIMEOUT status with
+        the other completion's outputs (the torn-read this method
+        regression-tests against under tools/mxstress.py)."""
+        with self._done_lock:
+            return (self.status, self.outputs, self.latency_ms, self.error)
 
 
 class MicroBatcher:

@@ -199,12 +199,17 @@ class ModelServer:
         entry = self._entry(name)
         if request.deadline is not None:
             request.wait(request.deadline - time.monotonic() + _WAIT_GRACE_S)
-            if request.status is None and request.complete(TIMEOUT):
+            # complete() is the atomic claim: if the worker's completion is
+            # mid-flight (fields half-written under the lock) this blocks
+            # until it finishes and then loses cleanly — an unlocked
+            # `status is None` pre-check could pair our TIMEOUT with the
+            # worker's outputs
+            if request.complete(TIMEOUT):
                 entry.model.stats.on_result(TIMEOUT, request.latency_ms)
         else:
             request.wait()
-        return InferenceResult(request.status, request.outputs,
-                               request.latency_ms, request.error)
+        status, outputs, latency_ms, error = request.snapshot()
+        return InferenceResult(status, outputs, latency_ms, error)
 
     # -- observability --------------------------------------------------
     def stats(self):

@@ -1,0 +1,115 @@
+"""Known-bad fixture for the concur pass; line numbers are asserted in
+tests/test_mxlint.py — keep edits line-stable or update the test."""
+import threading
+
+_PENDING = {}
+_total = 0
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.peak = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+            if self.count > self.peak:
+                self.peak = self.count
+
+    def read_fast(self):
+        return self.count            # CON101: guarded attr read unlocked
+
+    def reset_unsafe(self):
+        self.peak = 0                # CON101: mixed write discipline
+
+
+def enqueue(key, value):
+    _PENDING[key] = value            # CON102: unlocked dict mutation
+
+
+def add(n):
+    global _total
+    _total = _total + n              # CON102: unlocked global rebind
+
+
+class ABBA:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:       # CON103: edge a->b
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:       # CON103: edge b->a closes the cycle
+                pass
+
+
+class Worker:
+    def __init__(self):
+        self.results = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.results.append(1)       # CON104: unguarded write in target
+        self.done = True             # CON104: unguarded write in target
+
+
+class SelfNest:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:         # CON103: non-reentrant self-deadlock
+                pass
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self.total = 0
+
+    def add(self):
+        with self._a_lock:
+            self.total += 1          # CON101: disjoint-lock writers
+
+    def sub(self):
+        with self._b_lock:
+            self.total -= 1          # CON101: disjoint-lock writers
+
+
+class WrongLockRead:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self.state = 0
+
+    def set(self, v):
+        with self._lock:
+            self.state = v
+
+    def peek(self):
+        with self._io_lock:
+            return self.state        # CON101: read under the WRONG lock
+
+
+class Swap:
+    """'block' is data here, not a lock — the matcher must analyze it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.block = None
+
+    def swap(self, new):
+        with self._lock:
+            self.block = new
+
+    def current(self):
+        return self.block            # CON101: guarded 'block' read unlocked

@@ -1,0 +1,98 @@
+"""Clean fixture for the concur pass: idioms that must NOT fire.
+
+Each block exercises one sanctioned pattern; a false positive here is a
+regression in the pass, not in this file."""
+import threading
+from collections import deque
+
+# import-time population of module mutables is exempt (import lock)
+_REGISTRY = {}
+_REGISTRY["seed"] = object()
+
+_REGISTRY_LOCK = threading.Lock()
+
+
+def register(name, value):
+    # mutation under a module lock is the sanctioned pattern
+    with _REGISTRY_LOCK:
+        _REGISTRY[name] = value
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_scope = _TLS()
+
+
+def push():
+    # writes to threading.local state are exempt by design
+    _scope.depth += 1
+    return _scope.depth
+
+
+def local_shadow():
+    # a LOCAL name that collides with a module mutable is not a mutation
+    _REGISTRY = {}
+    _REGISTRY["x"] = 1
+    return _REGISTRY
+
+
+class Stats:
+    """Immutable-after-init attrs + consistently guarded counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.name = "stats"          # init-only: immutable, free to read
+        self.count = 0
+        self._queue = deque()
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+            self._queue.append(self.count)
+
+    def snapshot(self):
+        with self._lock:
+            return (self.name, self.count, len(self._queue))
+
+    def label(self):
+        return self.name             # init-only attr: no lock contract
+
+
+class Ordered:
+    """Consistent a->b acquisition order in every path: no cycle."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._r_lock = threading.RLock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._a_lock, self._b_lock:
+            pass
+
+    def reentrant(self):
+        # RLock re-acquisition is legal, not a self-deadlock
+        with self._r_lock:
+            with self._r_lock:
+                pass
+
+
+class GoodWorker:
+    """Thread target that only writes under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.results = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self.results.append(1)

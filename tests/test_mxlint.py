@@ -14,7 +14,8 @@ import sys
 
 import pytest
 
-from mxnet_tpu.analysis import cabi_lint, common, tracing_lint
+from mxnet_tpu.analysis import (cabi_lint, common, concurrency_lint,
+                                tracing_lint)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "data", "lint_fixtures")
@@ -24,6 +25,19 @@ BASELINE = os.path.join(REPO, common.DEFAULT_BASELINE)
 def _fixture(name):
     with open(os.path.join(FIXTURES, name)) as f:
         return f.read()
+
+
+_AUDIT_CACHE = []
+
+
+def _audit_repo():
+    """One registry audit shared by the gate tests (it imports the full
+    framework and greps the test corpus per op — not free, and identical
+    for every caller in this process)."""
+    if not _AUDIT_CACHE:
+        from mxnet_tpu.analysis import registry_audit
+        _AUDIT_CACHE.append(registry_audit.audit(REPO))
+    return _AUDIT_CACHE[0]
 
 
 def _pairs(findings):
@@ -56,6 +70,29 @@ def test_cabi_rules_fire_at_marked_lines():
                                 ("ABI002", 16)]
 
 
+def test_concur_rules_fire_at_marked_lines():
+    findings = concurrency_lint.lint_source(
+        _fixture("bad_concurrency.py"), "bad_concurrency.py")
+    assert _pairs(findings) == [
+        ("CON101", 22), ("CON101", 25), ("CON101", 81), ("CON101", 85),
+        ("CON101", 100), ("CON101", 115), ("CON102", 29), ("CON102", 34),
+        ("CON103", 44), ("CON103", 69), ("CON104", 59), ("CON104", 60)]
+
+
+def test_concur_findings_name_class_and_attr():
+    findings = concurrency_lint.lint_source(
+        _fixture("bad_concurrency.py"), "bad_concurrency.py")
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, set()).add((f.scope, f.detail))
+    assert ("Counter.read_fast", "count") in by_rule["CON101"]
+    assert ("Counter.reset_unsafe", "peak") in by_rule["CON101"]
+    assert ("Worker._run", "results") in by_rule["CON104"]
+    # the cycle finding names both locks in its stable detail key
+    assert any("ABBA._a_lock" in d and "ABBA._b_lock" in d
+               for _, d in by_rule["CON103"])
+
+
 def test_cabi_findings_name_the_function_scope():
     findings = cabi_lint.lint_source(
         _fixture("bad_bridge.cc"), "bad_bridge.cc")
@@ -77,6 +114,24 @@ def test_clean_bridge_fixture_has_no_findings():
     findings = cabi_lint.lint_source(
         _fixture("clean_bridge.cc"), "clean_bridge.cc")
     assert findings == []
+
+
+def test_clean_concurrency_fixture_has_no_findings():
+    """Sanctioned patterns: module locks, threading.local, init-only attrs,
+    consistent lock order, RLock re-entry, locked thread targets."""
+    findings = concurrency_lint.lint_source(
+        _fixture("clean_concurrency.py"), "clean_concurrency.py")
+    assert findings == []
+
+
+def test_concur_inline_suppression():
+    src = ("_CACHE = {}\n"
+           "def put(k, v):\n"
+           "    _CACHE[k] = v  # mxlint: disable=CON102\n")
+    assert concurrency_lint.lint_source(src, "x.py") == []
+    raw = concurrency_lint.lint_source(src.replace("mxlint: disable",
+                                                   "ignore"), "x.py")
+    assert [f.rule for f in raw] == ["CON102"]
 
 
 def test_inline_suppressions_silence_the_marked_line():
@@ -149,9 +204,22 @@ def test_repo_tracing_and_cabi_clean_modulo_baseline():
                        % (BASELINE, "\n".join(map(repr, new))))
 
 
+def test_repo_concurrency_clean_with_empty_baseline():
+    """The concur pass holds a stronger line than the others: ZERO baseline
+    entries.  Every CON finding gets fixed in the introducing PR, so any
+    finding here is a new regression, not a suppression candidate."""
+    findings = concurrency_lint.run(REPO)
+    assert findings == [], (
+        "new concurrency finding(s) — fix the locking, do not baseline:\n%s"
+        % "\n".join(map(repr, findings)))
+    baseline = common.load_baseline(BASELINE)
+    assert not any(common.pass_of_key(k) == "concur"
+                   for k in baseline.entries), (
+        "the concurrency baseline must stay empty (fix, don't suppress)")
+
+
 def test_repo_registry_audit_clean_modulo_baseline():
-    from mxnet_tpu.analysis import registry_audit
-    findings, report = registry_audit.audit(REPO)
+    findings, report = _audit_repo()
     baseline = common.load_baseline(BASELINE)
     new, _, _ = baseline.partition(findings)
     assert new == [], ("new registry-audit finding(s):\n%s"
@@ -176,8 +244,7 @@ def test_repo_registry_audit_clean_modulo_baseline():
 
 def test_registry_untested_ops_are_tracked_not_silent():
     """Untested ops may only exist as explicit baseline entries."""
-    from mxnet_tpu.analysis import registry_audit
-    findings, report = registry_audit.audit(REPO)
+    findings, report = _audit_repo()
     baseline = common.load_baseline(BASELINE)
     untested = [f for f in findings if f.rule == "REG106"]
     for f in untested:
@@ -190,7 +257,7 @@ def test_registry_untested_ops_are_tracked_not_silent():
 # CLI
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("passes", ["tracing,cabi"])
+@pytest.mark.parametrize("passes", ["tracing,cabi,concur"])
 def test_cli_json_mode(passes):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),

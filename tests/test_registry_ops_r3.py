@@ -1,0 +1,220 @@
+"""Round-3 REG106 burn-down: scalar / broadcast-compare / numeric-cleanup ops.
+
+Every op here was in the .mxlint-baseline.json REG106 untested set before
+this round; each test exercises the op against a numpy reference so its
+baseline entry could be deleted.  The framing is the elementwise core a
+threaded serving stack leans on for pre/post-processing: scalar arithmetic
+and thresholding (`_*_scalar`, the operator-overload kernels), broadcast
+comparisons and masks (`broadcast_*`), NaN-tolerant aggregation
+(`nansum`/`nanprod` for metrics over partially-failed batches), and the
+numeric utilities (`diag`/`isinf`/`arctan2`/`ldexp`/`rcbrt`).
+
+Reference-semantics notes asserted below: scalar/broadcast comparisons
+return 0/1 masks in the INPUT dtype (not bool — mshadow_op.h comparison
+kernels); logical ops treat any non-zero as true; reductions with no axis
+return shape (1,), not a 0-d scalar.
+"""
+import numpy as np
+
+from mxnet_tpu import nd
+
+
+def _arr(values, dtype=np.float32):
+    return nd.array(np.asarray(values, dtype))
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+# ---------------------------------------------------------------------------
+# scalar arithmetic kernels (the x+c / x*c operator-overload family)
+# ---------------------------------------------------------------------------
+
+def test_scalar_arithmetic_family():
+    x = _rs(0).randn(3, 4).astype(np.float32)
+    for name, ref in (("_plus_scalar", lambda a, s: a + s),
+                      ("_minus_scalar", lambda a, s: a - s),
+                      ("_mul_scalar", lambda a, s: a * s),
+                      ("_div_scalar", lambda a, s: a / s)):
+        out = getattr(nd, name)(nd.array(x), scalar=2.5).asnumpy()
+        np.testing.assert_allclose(out, ref(x, 2.5), rtol=1e-6,
+                                   err_msg=name)
+
+
+def test_scalar_arithmetic_reverse_operand_order():
+    # reverse=True computes scalar OP x — the rsub/rdiv path
+    x = np.array([1.0, 2.0, 4.0], np.float32)
+    out = nd._minus_scalar(_arr(x), scalar=10.0, reverse=True).asnumpy()
+    np.testing.assert_allclose(out, 10.0 - x)
+    out = nd._div_scalar(_arr(x), scalar=8.0, reverse=True).asnumpy()
+    np.testing.assert_allclose(out, 8.0 / x)
+
+
+def test_scalar_power_maximum_minimum_mod():
+    x = np.array([0.5, 1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(
+        nd._power_scalar(_arr(x), scalar=2.0).asnumpy(), x ** 2.0,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        nd._maximum_scalar(_arr(x), scalar=1.5).asnumpy(),
+        np.maximum(x, 1.5))
+    np.testing.assert_allclose(
+        nd._minimum_scalar(_arr(x), scalar=1.5).asnumpy(),
+        np.minimum(x, 1.5))
+    np.testing.assert_allclose(
+        nd._mod_scalar(_arr(x), scalar=1.5).asnumpy(), np.mod(x, 1.5),
+        rtol=1e-6)
+
+
+def test_scalar_hypot():
+    x = np.array([3.0, 5.0, 8.0], np.float32)
+    np.testing.assert_allclose(
+        nd._hypot_scalar(_arr(x), scalar=4.0).asnumpy(),
+        np.hypot(x, 4.0), rtol=1e-6)
+
+
+def test_scalar_comparisons_return_input_dtype_masks():
+    x = np.array([-1.0, 0.0, 1.0, 2.0], np.float32)
+    cases = (("_equal_scalar", 1.0, x == 1.0),
+             ("_not_equal_scalar", 1.0, x != 1.0),
+             ("_greater_scalar", 0.0, x > 0.0),
+             ("_greater_equal_scalar", 0.0, x >= 0.0),
+             ("_lesser_scalar", 1.0, x < 1.0),
+             ("_lesser_equal_scalar", 1.0, x <= 1.0))
+    for name, scalar, ref in cases:
+        out = getattr(nd, name)(_arr(x), scalar=scalar).asnumpy()
+        assert out.dtype == np.float32, name   # mask in input dtype
+        np.testing.assert_array_equal(out, ref.astype(np.float32),
+                                      err_msg=name)
+
+
+def test_scalar_logical_family_nonzero_is_true():
+    x = np.array([-2.0, 0.0, 3.0], np.float32)
+    np.testing.assert_array_equal(
+        nd._logical_and_scalar(_arr(x), scalar=5.0).asnumpy(),
+        ((x != 0) & True).astype(np.float32))
+    np.testing.assert_array_equal(
+        nd._logical_or_scalar(_arr(x), scalar=0.0).asnumpy(),
+        ((x != 0) | False).astype(np.float32))
+    np.testing.assert_array_equal(
+        nd._logical_xor_scalar(_arr(x), scalar=0.0).asnumpy(),
+        ((x != 0) ^ False).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# broadcast comparison / logical / mod kernels
+# ---------------------------------------------------------------------------
+
+def test_broadcast_comparisons_with_broadcasting():
+    a = _rs(1).randn(3, 4).astype(np.float32)
+    b = _rs(2).randn(1, 4).astype(np.float32)
+    cases = (("broadcast_equal", a == b),
+             ("broadcast_not_equal", a != b),
+             ("broadcast_greater", a > b),
+             ("broadcast_greater_equal", a >= b),
+             ("broadcast_lesser", a < b),
+             ("broadcast_lesser_equal", a <= b))
+    for name, ref in cases:
+        out = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
+        assert out.shape == (3, 4) and out.dtype == np.float32, name
+        np.testing.assert_array_equal(out, ref.astype(np.float32),
+                                      err_msg=name)
+
+
+def test_broadcast_equal_exact_ties():
+    a = _arr([[1.0, 2.0], [3.0, 4.0]])
+    b = _arr([[1.0, 0.0], [3.0, 4.0]])
+    np.testing.assert_array_equal(
+        nd.broadcast_equal(a, b).asnumpy(),
+        [[1.0, 0.0], [1.0, 1.0]])
+
+
+def test_broadcast_logical_family():
+    a = np.array([[0.0, 1.0, -2.0]], np.float32)
+    b = np.array([[3.0], [0.0]], np.float32)     # broadcasts to (2, 3)
+    av, bv = (a != 0), (b != 0)
+    np.testing.assert_array_equal(
+        nd.broadcast_logical_and(nd.array(a), nd.array(b)).asnumpy(),
+        (av & bv).astype(np.float32))
+    np.testing.assert_array_equal(
+        nd.broadcast_logical_or(nd.array(a), nd.array(b)).asnumpy(),
+        (av | bv).astype(np.float32))
+    np.testing.assert_array_equal(
+        nd.broadcast_logical_xor(nd.array(a), nd.array(b)).asnumpy(),
+        (av ^ bv).astype(np.float32))
+
+
+def test_broadcast_mod_positive_operands():
+    a = np.array([[5.0, 7.0, 9.5]], np.float32)
+    b = np.array([[2.0], [4.0]], np.float32)
+    out = nd.broadcast_mod(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, np.mod(a, b), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# NaN-tolerant reductions
+# ---------------------------------------------------------------------------
+
+def test_nansum_treats_nan_as_zero():
+    x = np.array([[1.0, np.nan, 2.0], [np.nan, np.nan, 3.0]], np.float32)
+    flat = nd.nansum(nd.array(x)).asnumpy()
+    assert flat.shape == (1,)       # axis-free reduce returns shape (1,)
+    np.testing.assert_allclose(flat[0], 6.0)
+    np.testing.assert_allclose(
+        nd.nansum(nd.array(x), axis=1).asnumpy(), [3.0, 3.0])
+    np.testing.assert_allclose(
+        nd.nansum(nd.array(x), axis=0, keepdims=True).asnumpy(),
+        [[1.0, 0.0, 5.0]])
+
+
+def test_nanprod_treats_nan_as_one():
+    x = np.array([[2.0, np.nan], [3.0, 4.0]], np.float32)
+    flat = nd.nanprod(nd.array(x)).asnumpy()
+    assert flat.shape == (1,)
+    np.testing.assert_allclose(flat[0], 24.0)
+    np.testing.assert_allclose(
+        nd.nanprod(nd.array(x), axis=0).asnumpy(), [6.0, 4.0])
+
+
+# ---------------------------------------------------------------------------
+# numeric utilities
+# ---------------------------------------------------------------------------
+
+def test_diag_vector_matrix_and_offset():
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_array_equal(nd.diag(_arr(v)).asnumpy(), np.diag(v))
+    m = np.arange(9, dtype=np.float32).reshape(3, 3)
+    np.testing.assert_array_equal(nd.diag(nd.array(m)).asnumpy(),
+                                  np.diag(m))
+    np.testing.assert_array_equal(nd.diag(nd.array(m), k=1).asnumpy(),
+                                  np.diag(m, k=1))
+    np.testing.assert_array_equal(nd.diag(nd.array(m), k=-1).asnumpy(),
+                                  np.diag(m, k=-1))
+
+
+def test_isinf_mask():
+    x = np.array([1.0, np.inf, -np.inf, np.nan, 0.0], np.float32)
+    out = nd.isinf(_arr(x)).asnumpy()
+    np.testing.assert_array_equal(out.astype(bool), np.isinf(x))
+
+
+def test_arctan2_quadrants():
+    y = np.array([1.0, 1.0, -1.0, -1.0], np.float32)
+    x = np.array([1.0, -1.0, 1.0, -1.0], np.float32)
+    out = nd.arctan2(_arr(y), _arr(x)).asnumpy()
+    np.testing.assert_allclose(out, np.arctan2(y, x), rtol=1e-6)
+
+
+def test_ldexp_scales_by_power_of_two():
+    a = np.array([1.0, -2.0, 3.0], np.float32)
+    e = np.array([1.0, 2.0, 3.0], np.float32)
+    out = nd.ldexp(_arr(a), _arr(e)).asnumpy()
+    np.testing.assert_allclose(out, np.ldexp(a, e.astype(np.int32)),
+                               rtol=1e-6)
+
+
+def test_rcbrt_reciprocal_cube_root():
+    x = np.array([1.0, 8.0, 27.0], np.float32)
+    out = nd.rcbrt(_arr(x)).asnumpy()
+    np.testing.assert_allclose(out, 1.0 / np.cbrt(x), rtol=1e-6)

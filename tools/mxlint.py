@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """mxlint — framework-native static analysis for the TPU build.
 
-Runs three passes (see docs/LINT.md) and exits non-zero iff any finding is
+Runs four passes (see docs/LINT.md) and exits non-zero iff any finding is
 not covered by the checked-in baseline:
 
   tracing   AST pass over mxnet_tpu/ (tracer concretization, host syncs in
@@ -9,6 +9,8 @@ not covered by the checked-in baseline:
   registry  op-registry audit (shape/dtype/grad coverage, nd/sym bindings,
             per-op test coverage)
   cabi      bridge-return defensiveness pass over src/c_api.cc
+  concur    concurrency-safety pass over mxnet_tpu/ (guarded-by inference,
+            unguarded module globals, lock-order cycles, thread targets)
 
 Usage:
   python tools/mxlint.py                      # all passes, text output
@@ -28,7 +30,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-PASSES = ("tracing", "registry", "cabi")
+PASSES = ("tracing", "registry", "cabi", "concur")
 
 
 def collect(passes, root):
@@ -39,6 +41,9 @@ def collect(passes, root):
         findings.extend(tracing_lint.run(root))
     if "cabi" in passes:
         findings.extend(cabi_lint.run(root))
+    if "concur" in passes:
+        from mxnet_tpu.analysis import concurrency_lint
+        findings.extend(concurrency_lint.run(root))
     if "registry" in passes:
         from mxnet_tpu.analysis import registry_audit
         reg_findings, report = registry_audit.audit(root)
