@@ -37,17 +37,21 @@ IMG = int(os.environ.get("BENCH_IMG", "224"))
 # (docs/faq/perf.md:150-180: 1076.81 img/s fp32 / 2085.51 fp16 on V100)
 # | transformer (beyond-parity: GPT-2-small-ish decoder LM with the Pallas
 # flash-attention kernel; tokens/sec + MFU, no reference baseline exists)
+# | pipeline (END-TO-END input pipeline: synthetic decode -> DataLoader ->
+# DeviceFeed -> fused train step; reports e2e vs compute-only img/s and
+# overlap efficiency — tools/input_bench.py, artifact BENCH_PIPELINE.json)
 MODE = os.environ.get("BENCH_MODE", "train")
 # BENCH_LAYOUT=auto (default: measure NCHW first, then NHWC, report the
 # faster — settles SURVEY §7(f) with data in every driver capture) |
 # NCHW (reference layout) | NHWC (channels-last only)
 LAYOUT = os.environ.get("BENCH_LAYOUT", "auto").upper()
-if MODE not in ("train", "inference", "transformer", "int8"):
+if MODE not in ("train", "inference", "transformer", "int8", "pipeline"):
     # still honor the one-JSON-line-on-stdout contract
     print(json.dumps({"metric": "invalid_bench_mode", "value": None,
                       "unit": None, "vs_baseline": None,
                       "error": "unknown BENCH_MODE=%r "
-                               "(train|inference|transformer|int8)" % MODE}))
+                               "(train|inference|transformer|int8|pipeline)"
+                               % MODE}))
     sys.exit(1)
 if LAYOUT not in ("AUTO", "NCHW", "NHWC"):
     print(json.dumps({"metric": "invalid_bench_layout", "value": None,
@@ -68,6 +72,12 @@ if MODE == "transformer":
                  int(os.environ.get("BENCH_TFM_SEQ", "1024"))))
 elif MODE == "int8":
     METRIC = "resnet50_int8_infer_imgs_per_sec_bs%d" % BATCH
+elif MODE == "pipeline":
+    # end-to-end input-pipeline mode: decode -> DataLoader -> DeviceFeed ->
+    # fused train step; tools/input_bench.py is the implementation and
+    # BENCH_PIPELINE.json the artifact (config via BENCH_PIPE_*)
+    METRIC = ("pipeline_train_imgs_per_sec_bs%s"
+              % os.environ.get("BENCH_PIPE_BATCH", "32"))
 else:
     _KIND = "train" if MODE == "train" else "infer"
     METRIC = ("resnet50_%s_imgs_per_sec_bs%d" % (_KIND, BATCH) if IS_HEADLINE
@@ -456,6 +466,12 @@ def main():
         return
     if MODE == "int8":
         _measure_int8(device_kind)
+        return
+    if MODE == "pipeline":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import input_bench
+        input_bench.run(out_path=os.path.join(repo, "BENCH_PIPELINE.json"))
         return
 
     layouts = ("NCHW", "NHWC") if LAYOUT == "AUTO" else (LAYOUT,)

@@ -39,6 +39,9 @@ Invariants asserted (per seed)
 * **registry churn safety** — concurrent load/unload/duplicate-load only
   ever fail with MXNetError, and the registry ends in the expected state.
 * **bulk scoping** — ``engine.bulk`` scopes stay per-thread.
+* **feed pipeline** — the ``DeviceFeed`` input stage conserves batches in
+  order (no torn rows), shuts down cleanly mid-epoch, and propagates
+  source errors (see ``feed_pipeline``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1.
@@ -50,7 +53,7 @@ import random
 import threading
 import time
 
-__all__ = ["ChaosScheduler", "chaos", "stress", "SMOKE_SEEDS"]
+__all__ = ["ChaosScheduler", "chaos", "stress", "SMOKE_SEEDS", "SCENARIOS"]
 
 # real primitives captured at import time: the wrappers and the scheduler
 # must keep working while threading.Lock/RLock point at the factories
@@ -468,10 +471,108 @@ def bulk_scopes(seed, n_threads=3):
 
 
 # ---------------------------------------------------------------------------
+# scenario 5: DeviceFeed pipeline (the async input feed)
+# ---------------------------------------------------------------------------
+
+def feed_pipeline(seed, n_batches=16, depth=2):
+    """DeviceFeed under chaos: conservation, order, shutdown, errors.
+
+    Invariants:
+    * **batch conservation + order** — a full consume sees exactly
+      ``n_batches`` batches, in source order, each row un-torn (every
+      element of batch i equals i — a mixed/partial buffer fails);
+    * **clean shutdown mid-epoch** — ``close()`` after a partial consume
+      returns with the worker joined, repeated close is a no-op, and a
+      closed feed refuses iteration;
+    * **error propagation** — a source exception surfaces in the consumer
+      after the good prefix, and the worker joins;
+    * **no deadlock** — every consumer thread joins in time (stalls at the
+      bounded queue's put/get edges are where the chaos locks bite).
+    """
+    import numpy as np
+    from ..context import cpu
+    from ..io.device_feed import DeviceFeed
+
+    violations = []
+    rng = random.Random(seed ^ 0xFEED)
+
+    def source(n, fail_at=None):
+        for i in range(n):
+            if fail_at is not None and i == fail_at:
+                raise RuntimeError("planted decode failure")
+            yield np.full((3,), i, np.float32)
+
+    # full-epoch consume on a separate thread (deadlock-checked by _spawn)
+    feed = DeviceFeed(source(n_batches), ctx=cpu(0), depth=depth,
+                      name="stress-feed")
+    got = []
+
+    def consume():
+        for batch in feed:
+            got.append(np.asarray(batch))
+    violations.extend(_spawn([consume]))
+    if len(got) != n_batches:
+        violations.append("lost batches: %d of %d" % (len(got), n_batches))
+    for i, b in enumerate(got):
+        if not np.all(b == i):
+            violations.append(
+                "torn/reordered batch at %d: %s" % (i, b.tolist()))
+    stats = feed.stats()
+    if stats["batches"] != len(got):
+        violations.append("feed stats disagree: staged %d, consumed %d"
+                          % (stats["batches"], len(got)))
+
+    # mid-epoch shutdown at a seed-dependent point (consumed via _spawn so
+    # a deadlocked feed is REPORTED as a violation, not hung on — the
+    # whole point of the scenario's no-deadlock invariant)
+    feed2 = DeviceFeed(source(n_batches), ctx=cpu(0), depth=1,
+                       name="stress-feed")
+    stop_after = rng.randrange(1, max(2, n_batches // 2))
+    it = iter(feed2)
+
+    def partial_consume():
+        for _ in range(stop_after):
+            next(it)
+    violations.extend(_spawn([partial_consume]))
+    feed2.close()
+    feed2.close()    # idempotent
+    if feed2._thread is not None and feed2._thread.is_alive():
+        violations.append("close() left the feed worker running")
+    try:
+        next(it)
+        violations.append("closed feed kept yielding")
+    except (StopIteration, RuntimeError):
+        pass
+
+    # worker-error propagation after a good prefix
+    fail_at = rng.randrange(1, n_batches)
+    feed3 = DeviceFeed(source(n_batches, fail_at=fail_at), ctx=cpu(0),
+                       depth=depth, name="stress-feed")
+    seen = [0]
+
+    def consume_until_error():
+        try:
+            for _ in feed3:
+                seen[0] += 1
+            violations.append("source failure swallowed by the feed")
+        except RuntimeError:
+            if seen[0] != fail_at:
+                violations.append(
+                    "error surfaced after %d batches (want %d)"
+                    % (seen[0], fail_at))
+    violations.extend(_spawn([consume_until_error]))
+    if feed3._thread is not None:
+        feed3._thread.join(_JOIN_TIMEOUT_S)
+        if feed3._thread.is_alive():
+            violations.append("worker did not join after error")
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
-SCENARIOS = ("serving", "registry", "cache", "bulk")
+SCENARIOS = ("serving", "registry", "cache", "bulk", "feed")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -503,6 +604,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                                                            seed)
                 if "bulk" in scenarios:
                     per_seed["bulk"] = bulk_scopes(seed)
+                if "feed" in scenarios:
+                    per_seed["feed"] = feed_pipeline(seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n

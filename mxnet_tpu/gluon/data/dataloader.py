@@ -2,10 +2,32 @@
 multi-worker batch loading with shared-memory NDArray rebuild over
 kCPUShared storage + pthread_atfork engine handling).
 
-TPU-native: worker processes produce numpy batches over a
-multiprocessing.Pool (plain pickle transport — numpy arrays go through
-shared-memory-backed pipes on Linux); the device transfer happens once per
-batch in the consumer.  A num_workers=0 path runs synchronously in-process.
+TPU-native: worker processes produce per-sample numpy payloads over a
+persistent multiprocessing.Pool (plain pickle transport — numpy arrays go
+through shared-memory-backed pipes on Linux); a num_workers=0 path runs
+synchronously in-process.
+
+Pipeline composition (the src/io chain decode → batch → prefetch, rebuilt):
+
+* default path — workers (or the caller's thread) produce samples,
+  batchify runs in the consumer, arrays land wherever the current context
+  puts them.  Zero background threads.
+* ``pin_memory=True`` — batchify moves to a background ``DeviceFeed``
+  thread which stages each batch into committed host-side jax buffers
+  (``cpu_pinned`` context): the page-aligned staging-area analog of the
+  reference's kCPUPinned storage, ready for DMA to the device.
+* ``prefetch_to_device=ctx`` — same feed thread, but batches land ON the
+  device (``jax.device_put``) one-to-two batches ahead of the consumer, so
+  the training step never pays decode, batchify, or h2d transfer inline.
+  Supersedes ``pin_memory`` (the batch goes straight to HBM).
+
+Lifecycle: the worker pool is persistent across epochs.  ``close()`` is
+the deterministic teardown — it drains in-flight worker results (a
+mid-epoch worker exception therefore cannot strand the pool), closes and
+joins the pool, and is idempotent; the loader is a context manager, and
+``__del__`` routes through ``close()`` as a GC backstop.  Repeated and
+concurrent ``__iter__`` on one loader are safe: each call builds an
+independent iterator (and, in the feed paths, its own ``DeviceFeed``).
 """
 from __future__ import annotations
 
@@ -14,6 +36,7 @@ import threading
 
 import numpy as _np
 
+from ...context import Context
 from ...ndarray import NDArray, array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -35,6 +58,10 @@ def default_mp_batchify_fn(data):
 
 
 class _SimpleIter:
+    """num_workers=0: sample loading inline (on whichever thread iterates —
+    the consumer on the default path, the DeviceFeed thread on the feed
+    paths, which is what pipelines decode off the critical path)."""
+
     def __init__(self, loader):
         self._loader = loader
         self._iter = iter(loader._batch_sampler)
@@ -45,8 +72,7 @@ class _SimpleIter:
     def __next__(self):
         batch_indices = next(self._iter)
         dataset = self._loader._dataset
-        samples = [dataset[i] for i in batch_indices]
-        return self._loader._batchify_fn(samples)
+        return [dataset[i] for i in batch_indices]
 
 
 _worker_dataset = None
@@ -79,13 +105,15 @@ def _worker_fn(batch_indices):
 
 
 class _MultiWorkerIter:
+    """Sample batches from the loader's persistent pool, ``prefetch``
+    submissions ahead.  Yields raw sample lists; batchify is the caller's
+    (or the feed thread's) job."""
+
     def __init__(self, loader):
         self._loader = loader
         self._iter = iter(loader._batch_sampler)
-        self._pool = loader._pool
         self._pending = []
-        self._prefetch = max(2 * loader._num_workers, 4)
-        for _ in range(self._prefetch):
+        for _ in range(loader._prefetch):
             self._push_next()
 
     def _push_next(self):
@@ -93,7 +121,8 @@ class _MultiWorkerIter:
             batch_indices = next(self._iter)
         except StopIteration:
             return
-        self._pending.append(self._pool.apply_async(_worker_fn, (batch_indices,)))
+        result = self._loader._submit(batch_indices)
+        self._pending.append(result)
 
     def __iter__(self):
         return self
@@ -103,15 +132,80 @@ class _MultiWorkerIter:
             raise StopIteration
         result = self._pending.pop(0)
         self._push_next()
-        samples = result.get()
-        return self._loader._batchify_fn(samples)
+        try:
+            # bounded waits so a concurrent close() (which may terminate()
+            # a wedged pool — terminated pools never complete outstanding
+            # results) surfaces as an error here instead of hanging this
+            # consumer in an untimed get() forever
+            while True:
+                try:
+                    samples = result.get(timeout=1.0)
+                    break
+                except _mp.TimeoutError:
+                    with self._loader._lock:
+                        closed = self._loader._closed
+                    if closed:
+                        raise RuntimeError(
+                            "DataLoader was closed during iteration")
+        finally:
+            # success or worker exception, the result is no longer in
+            # flight — close() must not wait on it
+            self._loader._untrack(result)
+        return samples
+
+    def __del__(self):
+        # an epoch abandoned mid-stream must not strand its prefetch
+        # window in the loader's in-flight bookkeeping forever (each
+        # completed AsyncResult retains a whole batch payload).  Only
+        # completed results are dropped — still-running ones stay visible
+        # to close()'s bounded drain / wedged-worker detection.
+        try:
+            for result in self._pending:
+                if result.ready():
+                    self._loader._untrack(result)
+        except Exception:
+            pass  # interpreter teardown
+
+
+class _BatchifyIter:
+    """Synchronous tail of the default path: batchify in the consumer."""
+
+    def __init__(self, base, batchify_fn):
+        self._base = base
+        self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._batchify_fn(next(self._base))
 
 
 class DataLoader:
+    """Loads data from a dataset and returns mini-batches.
+
+    See the module docstring for the pipeline/lifecycle contract and
+    docs/PERF.md ("Input pipeline & overlap") for how the feed paths
+    compose with training.
+
+    Parameters beyond the reference set:
+
+    prefetch : int, optional
+        How many batch submissions each epoch keeps in flight in the
+        worker pool (default ``max(2 * num_workers, 4)``; reference
+        contrib DataLoader semantics).
+    pin_memory : bool
+        Honored (not the historical silent no-op): batches are staged
+        into committed host-side jax buffers on a background feed thread.
+    prefetch_to_device : Context, optional
+        Stage batches onto this device context ahead of the consumer
+        (the async device-feed path).
+    """
+
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False):
+                 thread_pool=False, prefetch_to_device=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -133,10 +227,26 @@ class DataLoader:
                              "not be specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
         self._num_workers = num_workers if num_workers >= 0 else 0
+        if prefetch is not None and int(prefetch) < 1:
+            raise ValueError("prefetch must be >= 1, got %r" % (prefetch,))
+        self._prefetch = (int(prefetch) if prefetch is not None
+                          else max(2 * self._num_workers, 4))
+        self._pin_memory = bool(pin_memory)
+        if prefetch_to_device is not None and \
+                not isinstance(prefetch_to_device, Context):
+            raise TypeError("prefetch_to_device expects a Context (e.g. "
+                            "mx.tpu(0)), got %r" % (prefetch_to_device,))
+        self._prefetch_to_device = prefetch_to_device
         if batchify_fn is None:
             self._batchify_fn = default_batchify_fn
         else:
             self._batchify_fn = batchify_fn
+        # lifecycle state, guarded by _lock: the pool is shared by every
+        # iterator this loader hands out, and close() races __iter__/
+        # __next__ by design (close from another thread must be safe)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._in_flight = []      # AsyncResults not yet consumed
         self._pool = None
         if self._num_workers > 0:
             if thread_pool:
@@ -150,14 +260,92 @@ class DataLoader:
                                       initializer=_worker_init,
                                       initargs=(self._dataset,))
 
+    # -- pool plumbing (shared by concurrent iterators) -----------------
+    def _submit(self, batch_indices):
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("DataLoader is closed")
+            # backstop for abandoned epochs: completed results nobody will
+            # consume must not accumulate across the loader's lifetime
+            self._in_flight = [r for r in self._in_flight if not r.ready()]
+            result = self._pool.apply_async(_worker_fn, (batch_indices,))
+            self._in_flight.append(result)
+        return result
+
+    def _untrack(self, result):
+        with self._lock:
+            try:
+                self._in_flight.remove(result)
+            except ValueError:
+                pass   # already drained by close()
+
+    # -- iteration ------------------------------------------------------
     def __iter__(self):
-        if self._num_workers == 0:
-            return _SimpleIter(self)
-        return _MultiWorkerIter(self)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("DataLoader is closed")
+        base = (_SimpleIter(self) if self._num_workers == 0
+                else _MultiWorkerIter(self))
+        ctx = self._prefetch_to_device
+        if ctx is None and not self._pin_memory:
+            return _BatchifyIter(base, self._batchify_fn)
+        if ctx is None:
+            # pin_memory: committed host-side buffers (kCPUPinned analog)
+            ctx = Context("cpu_pinned", 0)
+        from ...io.device_feed import DeviceFeed
+        return iter(DeviceFeed(base, ctx=ctx, depth=2,
+                               transform=self._batchify_fn,
+                               name="dataloader"))
 
     def __len__(self):
         return len(self._batch_sampler)
 
+    # -- lifecycle ------------------------------------------------------
+    def close(self):
+        """Tear the worker pool down deterministically.  Idempotent.
+
+        Drains results still in flight first (waiting, not raising — a
+        worker exception belongs to the iterator that submitted it), then
+        close()+join()s the pool so workers exit cleanly instead of the
+        historical bare ``terminate()``.  A worker wedged past the drain
+        timeout (hung ``__getitem__``) falls back to ``terminate()`` —
+        ``pool.join()`` has no timeout, and a ``close()`` that can hang
+        forever (reachable from ``__del__``) is worse than a hard stop.
+        """
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pool, self._pool = self._pool, None
+            in_flight, self._in_flight = self._in_flight, []
+        if pool is None:
+            return
+        # one shared deadline across the whole drain — per-result waits
+        # would stack to 5s * prefetch-window on a wedged worker, and
+        # close() is reachable from __del__/GC
+        import time as _time
+        deadline = _time.monotonic() + 5.0
+        wedged = False
+        for result in in_flight:
+            try:
+                result.wait(timeout=max(0.0, deadline - _time.monotonic()))
+                wedged = wedged or not result.ready()
+            except Exception:
+                pass
+        if wedged:
+            pool.terminate()
+        else:
+            pool.close()
+        pool.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     def __del__(self):
-        if self._pool is not None:
-            self._pool.terminate()
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: pool internals may be half-gone

@@ -1,3 +1,4 @@
 from .io import (DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
                  NDArrayIter, CSVIter, MNISTIter, ImageRecordIter,
                  LibSVMIter, DataLoaderIter)
+from .device_feed import DeviceFeed, stage_batch

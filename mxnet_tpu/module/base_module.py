@@ -144,8 +144,17 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
-            sparse_row_id_fn=None):
-        """Train the module (reference base_module.py:410)."""
+            sparse_row_id_fn=None, prefetch_to_device=None):
+        """Train the module (reference base_module.py:410).
+
+        ``prefetch_to_device`` (a Context) routes each epoch's batches
+        through an ``io.DeviceFeed``: a background thread stays up to two
+        batches ahead, staging DataBatch arrays onto the device so the
+        step never pays decode or host→device transfer inline (safe even
+        for iterators that reuse host buffers between ``next()`` calls —
+        staging copies each batch to the device before the feed advances
+        the source again).
+        """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
@@ -171,31 +180,46 @@ class BaseModule:
             tic = time.time()
             eval_metric.reset()
             eval_name_vals = []
-            batches = iter(train_data)
-            data_batch = next(batches, _NO_BATCH)
-            nbatch = 0
-            while data_batch is not _NO_BATCH:
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self._metric_from_batch(eval_metric, data_batch)
-                # only fetch the next batch AFTER training on this one — a
-                # DataIter may reuse the previous batch's buffers on next()
-                upcoming = next(batches, _NO_BATCH)
-                if upcoming is not _NO_BATCH:
-                    # prefetch hook for the next batch (e.g. sparse row pull)
-                    self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
-                if monitor is not None:
-                    monitor.toc_print()
-                if upcoming is _NO_BATCH:
-                    # snapshot before callbacks may auto-reset the metric
-                    eval_name_vals = eval_metric.get_name_value()
-                _fire(batch_end_callback,
-                      BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                    eval_metric=eval_metric, locals=locals()))
-                data_batch = upcoming
-                nbatch += 1
+            feed = None
+            if prefetch_to_device is not None:
+                from ..io.device_feed import DeviceFeed
+                feed = DeviceFeed(train_data, ctx=prefetch_to_device,
+                                  name="fit")
+                batches = iter(feed)
+            else:
+                batches = iter(train_data)
+            try:
+                data_batch = next(batches, _NO_BATCH)
+                nbatch = 0
+                while data_batch is not _NO_BATCH:
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    self._metric_from_batch(eval_metric, data_batch)
+                    # only fetch the next batch AFTER training on this one —
+                    # a DataIter may reuse the previous batch's buffers on
+                    # next() (the feed path is exempt: batches arrive as
+                    # device copies, staged before the source advances)
+                    upcoming = next(batches, _NO_BATCH)
+                    if upcoming is not _NO_BATCH:
+                        # prefetch hook for the next batch (sparse row pull)
+                        self.prepare(upcoming,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if upcoming is _NO_BATCH:
+                        # snapshot before callbacks may auto-reset the metric
+                        eval_name_vals = eval_metric.get_name_value()
+                    _fire(batch_end_callback,
+                          BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                        eval_metric=eval_metric,
+                                        locals=locals()))
+                    data_batch = upcoming
+                    nbatch += 1
+            finally:
+                if feed is not None:
+                    feed.close()
             for name, val in eval_name_vals:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()

@@ -1,0 +1,205 @@
+"""Round-4 REG106 burn-down: the input-pipeline support ops.
+
+Every op here was in the .mxlint-baseline.json REG106 untested set before
+this round; each test exercises the op against a reference so its baseline
+entry could be deleted (63 -> 44).  The framing matches this PR's async
+input pipeline: creation ops that synthesize feed data (`_arange`/`_eye`/
+`_full`/`_ones`/`_zeros`), index plumbing for batch assembly and sharding
+(`ravel_multi_index`/`unravel_index`/`scatter_nd`/`_scatter_set_nd`/
+`broadcast_axis`), the seeded sample generators a synthetic-decode
+workload leans on (`_random_uniform`/`_random_normal`/`_random_randint` —
+framework RNG stream, reproducible under ``mx.random.seed``), the
+training-head ops (`LogisticRegressionOutput`/`MAERegressionOutput`/
+`BlockGrad`/`make_loss`), and numeric utilities (`erfinv`/`khatri_rao`).
+
+Reference-semantics notes asserted below: regression outputs impose their
+OWN gradient (grad_scale * residual / num_out, independent of the incoming
+cotangent — RegressionOutput in the reference writes the gradient
+directly); BlockGrad is identity forward with a zero gradient;
+ravel/unravel round-trip in C order.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _arr(values, dtype=np.float32):
+    return nd.array(np.asarray(values, dtype))
+
+
+# ---------------------------------------------------------------------------
+# creation ops (attrs-only: shape_rule="attrs")
+# ---------------------------------------------------------------------------
+
+def test_zeros_ones_full_creation():
+    z = nd._zeros(shape=(2, 3)).asnumpy()
+    np.testing.assert_array_equal(z, np.zeros((2, 3), np.float32))
+    assert z.dtype == np.float32
+    o = nd._ones(shape=(4,), dtype="int32").asnumpy()
+    np.testing.assert_array_equal(o, np.ones((4,), np.int32))
+    assert o.dtype == np.int32
+    f = nd._full(shape=(2, 2), value=5.5).asnumpy()
+    np.testing.assert_array_equal(f, np.full((2, 2), 5.5, np.float32))
+
+
+def test_arange_with_repeat():
+    out = nd._arange(start=1.0, stop=7.0, step=2.0).asnumpy()
+    np.testing.assert_array_equal(out, np.arange(1.0, 7.0, 2.0,
+                                                 dtype=np.float32))
+    # repeat duplicates each element in place (reference range op contract)
+    rep = nd._arange(start=0.0, stop=3.0, step=1.0, repeat=2).asnumpy()
+    np.testing.assert_array_equal(rep, np.repeat(np.arange(3.0), 2))
+
+
+def test_eye_rect_and_diagonal_offset():
+    out = nd._eye(N=3, M=4, k=1).asnumpy()
+    np.testing.assert_array_equal(out, np.eye(3, 4, k=1, dtype=np.float32))
+    sq = nd._eye(N=2).asnumpy()
+    np.testing.assert_array_equal(sq, np.eye(2, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# index plumbing
+# ---------------------------------------------------------------------------
+
+def test_ravel_unravel_round_trip_c_order():
+    shape = (3, 4, 5)
+    multi = np.array([[2, 0, 1], [3, 1, 0], [4, 2, 3]], np.float32)
+    flat = nd.ravel_multi_index(_arr(multi), shape=shape).asnumpy()
+    ref = np.ravel_multi_index(multi.astype(np.int64), shape)
+    np.testing.assert_array_equal(flat, ref.astype(np.float32))
+    back = nd.unravel_index(_arr(flat), shape=shape).asnumpy()
+    np.testing.assert_array_equal(back, multi)
+
+
+def test_scatter_nd_builds_from_indices():
+    data = _arr([9.0, 8.0, 7.0])
+    indices = _arr([[0, 1, 2], [2, 0, 1]])   # (ndim, n) index layout
+    out = nd.scatter_nd(data, indices, shape=(3, 3)).asnumpy()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 2], ref[1, 0], ref[2, 1] = 9.0, 8.0, 7.0
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_scatter_set_nd_overwrites_in_place_semantics():
+    lhs = _arr(np.zeros((2, 3), np.float32) + 1.0)
+    indices = _arr([[0, 1], [2, 0]])
+    rhs = _arr([5.0, 6.0])
+    out = nd._scatter_set_nd(lhs, indices, rhs).asnumpy()
+    ref = np.ones((2, 3), np.float32)
+    ref[0, 2], ref[1, 0] = 5.0, 6.0
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_broadcast_axis_expands_singleton_axes():
+    x = np.arange(3, dtype=np.float32).reshape(3, 1)
+    out = nd.broadcast_axis(_arr(x), axis=1, size=4).asnumpy()
+    np.testing.assert_array_equal(out, np.broadcast_to(x, (3, 4)))
+    # multi-axis form
+    y = np.arange(2, dtype=np.float32).reshape(1, 2, 1)
+    out2 = nd.broadcast_axis(_arr(y), axis=(0, 2), size=(3, 2)).asnumpy()
+    np.testing.assert_array_equal(out2, np.broadcast_to(y, (3, 2, 2)))
+
+
+# ---------------------------------------------------------------------------
+# numeric utilities
+# ---------------------------------------------------------------------------
+
+def test_erfinv_inverts_erf():
+    x = np.array([-0.9, -0.25, 0.0, 0.5, 0.99], np.float32)
+    out = nd.erf(nd.erfinv(_arr(x))).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_khatri_rao_column_wise():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3) + 1
+    b = np.arange(9, dtype=np.float32).reshape(3, 3) - 4
+    out = nd.khatri_rao(_arr(a), _arr(b)).asnumpy()
+    ref = np.stack([np.kron(a[:, k], b[:, k]) for k in range(3)], axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    assert out.shape == (6, 3)
+
+
+# ---------------------------------------------------------------------------
+# training-head ops
+# ---------------------------------------------------------------------------
+
+def test_blockgrad_identity_forward_zero_gradient():
+    x = _arr([1.0, -2.0, 3.0])
+    np.testing.assert_array_equal(nd.BlockGrad(x).asnumpy(), x.asnumpy())
+    x.attach_grad()
+    with autograd.record():
+        # grad flows only through the un-blocked factor: d/dx of
+        # BlockGrad(x)*x is x (not 2x)
+        y = nd.BlockGrad(x) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), x.asnumpy(), rtol=1e-6)
+
+
+def test_make_loss_identity_forward():
+    x = _arr([[0.5, 1.5], [2.5, 3.5]])
+    np.testing.assert_array_equal(nd.make_loss(x).asnumpy(), x.asnumpy())
+
+
+def test_logistic_regression_output_forward_and_own_gradient():
+    d = np.array([[0.0, 1.0, -1.0]], np.float32)
+    l = np.array([[0.0, 1.0, 1.0]], np.float32)
+    data, label = _arr(d), _arr(l)
+    out = nd.LogisticRegressionOutput(data, label).asnumpy()
+    np.testing.assert_allclose(out, 1.0 / (1.0 + np.exp(-d)), rtol=1e-6)
+    data.attach_grad()
+    with autograd.record():
+        y = nd.LogisticRegressionOutput(data, label)
+    y.backward()
+    # the head writes its own gradient: (sigmoid(d) - l) / num_out,
+    # regardless of the incoming cotangent (reference RegressionOutput)
+    ref = (1.0 / (1.0 + np.exp(-d)) - l) / d.shape[1]
+    np.testing.assert_allclose(data.grad.asnumpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_mae_regression_output_forward_and_sign_gradient():
+    d = np.array([[2.0, -3.0], [0.5, 1.0]], np.float32)
+    l = np.array([[1.0, -1.0], [2.0, 1.0]], np.float32)
+    data, label = _arr(d), _arr(l)
+    np.testing.assert_array_equal(
+        nd.MAERegressionOutput(data, label).asnumpy(), d)
+    data.attach_grad()
+    with autograd.record():
+        y = nd.MAERegressionOutput(data, label)
+    y.backward()
+    ref = np.sign(d - l) / d.shape[1]
+    np.testing.assert_allclose(data.grad.asnumpy(), ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# seeded sample generators (framework RNG stream, not numpy global state)
+# ---------------------------------------------------------------------------
+
+def test_random_uniform_bounds_and_reproducible_stream():
+    mx.random.seed(7)
+    a = nd._random_uniform(low=2.0, high=5.0, shape=(4000,)).asnumpy()
+    assert a.shape == (4000,)
+    assert a.min() >= 2.0 and a.max() < 5.0
+    assert abs(a.mean() - 3.5) < 0.1
+    mx.random.seed(7)
+    b = nd._random_uniform(low=2.0, high=5.0, shape=(4000,)).asnumpy()
+    np.testing.assert_array_equal(a, b)   # mx.random.seed pins the stream
+
+
+def test_random_normal_moments():
+    mx.random.seed(11)
+    a = nd._random_normal(loc=3.0, scale=0.5, shape=(8000,)).asnumpy()
+    assert abs(a.mean() - 3.0) < 0.05
+    assert abs(a.std() - 0.5) < 0.05
+
+
+def test_random_randint_bounds_dtype_integrality():
+    mx.random.seed(13)
+    a = nd._random_randint(low=-3, high=4, shape=(2000,)).asnumpy()
+    assert a.dtype == np.int32
+    assert a.min() >= -3 and a.max() < 4
+    assert set(np.unique(a)) <= set(range(-3, 4))
+    # every admissible value should appear in 2000 draws over 7 buckets
+    assert len(np.unique(a)) == 7

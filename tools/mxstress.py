@@ -3,9 +3,10 @@
 
 Dynamic twin of ``tools/mxlint.py --passes concur`` (see docs/CONCURRENCY.md):
 monkeypatched chaos locks inject seeded preemptions into the serving
-batcher, registry load/unload, CachedOp cache-stats, and engine.bulk paths,
-and an invariant suite (no lost requests, no torn results, monotonic
-counters, zero steady-state recompiles, no deadlock) must hold under every
+batcher, registry load/unload, CachedOp cache-stats, engine.bulk, and
+DeviceFeed input-pipeline paths, and an invariant suite (no lost requests
+or batches, no torn results, monotonic counters, zero steady-state
+recompiles, clean mid-epoch shutdown, no deadlock) must hold under every
 seed.  Exit code is non-zero iff any seed violated any invariant.
 
 Usage:
