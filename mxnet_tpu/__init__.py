@@ -69,6 +69,7 @@ from .kvstore import create as _kvstore_create
 from . import engine
 from . import profiler
 from . import util
+from . import faults
 from . import env
 
 init = initializer  # mx.init.Xavier() style access

@@ -42,9 +42,23 @@ Invariants asserted (per seed)
 * **feed pipeline** — the ``DeviceFeed`` input stage conserves batches in
   order (no torn rows), shuts down cleanly mid-epoch, and propagates
   source errors (see ``feed_pipeline``).
+* **fault storm** (``faults``) — a serving storm under a seeded
+  ``mxnet_tpu.faults`` plan: transient predict faults are absorbed by the
+  retry envelope, request counts conserve INCLUDING ``UNAVAILABLE``
+  outcomes, nothing raises unhandled, and the circuit breaker demonstrably
+  opens after K consecutive failures and re-closes via half-open probing
+  once the faults clear (see ``fault_storm``).
+* **crash sweep** (``crash``) — kills a checkpoint save at EVERY injected
+  fault point (each write chunk, pre-replace, post-replace, manifest
+  commit; seed-chosen kinds mix plain crash and byte-level torn-write).
+  Invariant: after every kill, ``model.latest_complete_checkpoint`` still
+  returns a checkpoint whose files load bit-exact (see ``crash_sweep``;
+  the fit-level twin — resume to the uninterrupted run's exact params —
+  lives in tests/test_faults.py).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
-wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1.
+wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
+and ``tests/test_faults.py`` gates the two fault scenarios.
 """
 from __future__ import annotations
 
@@ -53,7 +67,8 @@ import random
 import threading
 import time
 
-__all__ = ["ChaosScheduler", "chaos", "stress", "SMOKE_SEEDS", "SCENARIOS"]
+__all__ = ["ChaosScheduler", "chaos", "stress", "SMOKE_SEEDS", "SCENARIOS",
+           "FAULT_SMOKE_SEEDS"]
 
 # real primitives captured at import time: the wrappers and the scheduler
 # must keep working while threading.Lock/RLock point at the factories
@@ -61,6 +76,10 @@ _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
 
 SMOKE_SEEDS = tuple(range(25))
+# the fault scenarios run real save/restore + breaker recovery cycles per
+# seed, so their tier-1 gate (tests/test_faults.py) uses a smaller fixed
+# set to stay inside its ~5 s smoke budget
+FAULT_SMOKE_SEEDS = tuple(range(5))
 _JOIN_TIMEOUT_S = 20.0
 
 
@@ -175,8 +194,11 @@ def _build_fixture(n_clients, max_queue):
     net = _Net()
     net.initialize(init.Xavier())
     server = serving.ModelServer()
+    # tight breaker backoff so the faults scenario's open -> half-open ->
+    # closed recovery cycle fits the smoke budget
     server.load_model("stable", net, input_shapes=[(_FEAT,)], max_batch=4,
-                      max_queue=max_queue, linger_ms=1.0, warmup=True)
+                      max_queue=max_queue, linger_ms=1.0, warmup=True,
+                      breaker_threshold=4, breaker_backoff_ms=15.0)
     inputs, expected = [], []
     for i in range(n_clients):
         x = np.full((_FEAT,), 0.25 * (i + 1), np.float32)
@@ -211,6 +233,58 @@ def _spawn(fns):
 
 
 # ---------------------------------------------------------------------------
+# shared invariant: request-count conservation (serving + fault storms)
+# ---------------------------------------------------------------------------
+
+def _settle_and_check(server, name, before, tally, label):
+    """Settle, then assert the conservation identity and per-status match.
+
+    A request's completion event fires BEFORE the worker's stats bump
+    (complete() then on_result()), and the chaos locks stretch exactly that
+    edge — so the counters get a bounded window to conserve before an
+    imbalance is treated as a lost request.  The identity includes
+    UNAVAILABLE on both sides: admitted requests drained at teardown land
+    in ``unavailable``; fast rejections (breaker open / shutting down) land
+    in ``unavailable_rejected`` and — like shed — never enter ``requests``.
+    Returns (violations, after_snapshot)."""
+    violations = []
+    keys = ("requests", "ok", "timeouts", "shed", "invalid", "errors",
+            "unavailable", "unavailable_rejected")
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = server.stats()["models"][name]
+        d = {k: after[k] - before[k] for k in keys}
+        terminal_sum = (d["ok"] + d["timeouts"] + d["errors"]
+                        + d["unavailable"])
+        if d["requests"] == terminal_sum or time.monotonic() >= settle_until:
+            break
+        time.sleep(0.005)
+    if d["requests"] != tally["admitted"]:
+        violations.append("%s: admission mismatch: server %d vs clients %d"
+                          % (label, d["requests"], tally["admitted"]))
+    if d["requests"] != terminal_sum:
+        violations.append(
+            "%s: lost requests: admitted %d but only %d reached a terminal "
+            "counter" % (label, d["requests"], terminal_sum))
+    for client_key, server_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
+                                   ("OVERLOADED", "shed"),
+                                   ("INVALID_INPUT", "invalid"),
+                                   ("ERROR", "errors")):
+        if d[server_key] != tally[client_key]:
+            violations.append(
+                "%s: %s count mismatch: server %d vs clients %d"
+                % (label, server_key, d[server_key], tally[client_key]))
+    # clients cannot distinguish drained-vs-rejected UNAVAILABLE, so the
+    # client tally must equal the two server buckets combined
+    if d["unavailable"] + d["unavailable_rejected"] != tally["UNAVAILABLE"]:
+        violations.append(
+            "%s: unavailable count mismatch: server %d+%d vs clients %d"
+            % (label, d["unavailable"], d["unavailable_rejected"],
+               tally["UNAVAILABLE"]))
+    return violations, after
+
+
+# ---------------------------------------------------------------------------
 # scenario 1: serving storm
 # ---------------------------------------------------------------------------
 
@@ -220,7 +294,7 @@ def serving_storm(server, name, inputs, expected, seed, per_client=3):
     from ..serving import server as srv
 
     terminal = {srv.OK, srv.TIMEOUT, srv.OVERLOADED, srv.INVALID_INPUT,
-                srv.ERROR}
+                srv.ERROR, srv.UNAVAILABLE}
     rng = random.Random(seed ^ 0xC0FFEE)
     n_clients = len(inputs)
     before = server.stats()["models"][name]
@@ -278,7 +352,7 @@ def serving_storm(server, name, inputs, expected, seed, per_client=3):
     violations.extend(monitor_violations)
 
     tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "OVERLOADED": 0,
-             "INVALID_INPUT": 0, "ERROR": 0}
+             "INVALID_INPUT": 0, "ERROR": 0, "UNAVAILABLE": 0}
     for c in range(n_clients):
         if len(results[c]) != len(plans[c]):
             violations.append("client %d lost results: %d of %d"
@@ -289,7 +363,8 @@ def serving_storm(server, name, inputs, expected, seed, per_client=3):
                                   % (c, res))
                 continue
             tally[res.status] += 1
-            if res.status not in (srv.OVERLOADED, srv.INVALID_INPUT):
+            if res.status not in (srv.OVERLOADED, srv.INVALID_INPUT,
+                                  srv.UNAVAILABLE):
                 tally["admitted"] += 1
             if res.status == srv.OK:
                 if res.outputs is None:
@@ -305,35 +380,9 @@ def serving_storm(server, name, inputs, expected, seed, per_client=3):
             if kind == "invalid" and res.status != srv.INVALID_INPUT:
                 violations.append("wrong-shape request got %s" % res.status)
 
-    # settle: a request's completion event fires BEFORE the worker's
-    # stats bump (complete() then on_result()), and the chaos locks
-    # stretch exactly that edge — give the counters a bounded window to
-    # conserve before treating an imbalance as a lost request
-    settle_until = time.monotonic() + 5.0
-    while True:
-        after = server.stats()["models"][name]
-        d = {k: after[k] - before[k] for k in
-             ("requests", "ok", "timeouts", "shed", "invalid", "errors")}
-        if (d["requests"] == d["ok"] + d["timeouts"] + d["errors"]
-                or time.monotonic() >= settle_until):
-            break
-        time.sleep(0.005)
-    if d["requests"] != tally["admitted"]:
-        violations.append("admission mismatch: server %d vs clients %d"
-                          % (d["requests"], tally["admitted"]))
-    if d["requests"] != d["ok"] + d["timeouts"] + d["errors"]:
-        violations.append(
-            "lost requests: admitted %d but only %d reached a terminal "
-            "counter" % (d["requests"],
-                         d["ok"] + d["timeouts"] + d["errors"]))
-    for client_key, server_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
-                                   ("OVERLOADED", "shed"),
-                                   ("INVALID_INPUT", "invalid"),
-                                   ("ERROR", "errors")):
-        if d[server_key] != tally[client_key]:
-            violations.append(
-                "%s count mismatch: server %d vs clients %d"
-                % (server_key, d[server_key], tally[client_key]))
+    conserve, after = _settle_and_check(server, name, before, tally,
+                                        "serving storm")
+    violations.extend(conserve)
     cache_before, cache_after = before["cache"], after["cache"]
     if cache_after["recompiles"] != cache_before["recompiles"]:
         violations.append(
@@ -351,7 +400,7 @@ def registry_churn(server, name, net, inputs, seed, n_churners=2, rounds=2):
     from ..serving import server as srv
 
     terminal = {srv.OK, srv.TIMEOUT, srv.OVERLOADED, srv.INVALID_INPUT,
-                srv.ERROR}
+                srv.ERROR, srv.UNAVAILABLE}
     violations = []
     dup_wins = []
 
@@ -569,10 +618,232 @@ def feed_pipeline(seed, n_batches=16, depth=2):
 
 
 # ---------------------------------------------------------------------------
+# scenario 6: serving storm under a seeded fault plan (+ breaker cycle)
+# ---------------------------------------------------------------------------
+
+def fault_storm(server, name, inputs, expected, seed, per_client=3):
+    """Serving under injected predict faults (the ``faults`` scenario).
+
+    Phase 1 — storm under a seeded transient-fault plan: the retry
+    envelope absorbs most faults (OK), a burst that outlasts the budget
+    fails its batch (ERROR); invariants: every request reaches a terminal
+    status, nothing raises unhandled, and the counters conserve INCLUDING
+    ``UNAVAILABLE``: ``requests == ok + timeouts + errors + unavailable``
+    with every per-status server delta matching the client tally.
+
+    Phase 2 — deterministic breaker cycle under a persistent-failure
+    plan: exactly K consecutive failures must OPEN the breaker (fast
+    UNAVAILABLE, no execution), and once the faults clear, the half-open
+    probe must re-CLOSE it and traffic returns to OK."""
+    import numpy as np
+    from .. import faults
+    from ..serving import server as srv
+
+    terminal = {srv.OK, srv.TIMEOUT, srv.OVERLOADED, srv.INVALID_INPUT,
+                srv.ERROR, srv.UNAVAILABLE}
+    violations = []
+    n_clients = len(inputs)
+    before = server.stats()["models"][name]
+
+    # -- phase 1: transient-fault storm ---------------------------------
+    plan = faults.FaultPlan(seed ^ 0xFA17)
+    plan.add("serving.predict", kind="transient", p=0.3,
+             times=2 * n_clients * per_client)
+    results = [[] for _ in range(n_clients)]
+
+    def client(c):
+        for _ in range(per_client):
+            res = server.predict(name, inputs[c], timeout_ms=2000.0)
+            results[c].append(res)
+
+    with faults.plan(plan):
+        violations.extend(_spawn([lambda c=c: client(c)
+                                  for c in range(n_clients)]))
+
+    tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "OVERLOADED": 0,
+             "INVALID_INPUT": 0, "ERROR": 0, "UNAVAILABLE": 0}
+    for c in range(n_clients):
+        if len(results[c]) != per_client:
+            violations.append("fault storm: client %d lost results: %d of %d"
+                              % (c, len(results[c]), per_client))
+        for res in results[c]:
+            if res is None or res.status not in terminal:
+                violations.append("fault storm: non-terminal result %r"
+                                  % (res,))
+                continue
+            tally[res.status] += 1
+            if res.status not in (srv.OVERLOADED, srv.INVALID_INPUT,
+                                  srv.UNAVAILABLE):
+                tally["admitted"] += 1
+            if res.status == srv.OK and not np.allclose(
+                    res.outputs[0], expected[c], rtol=1e-4, atol=1e-5):
+                violations.append("fault storm: row mixup for client %d" % c)
+
+    conserve, _ = _settle_and_check(server, name, before, tally,
+                                    "fault storm")
+    violations.extend(conserve)
+
+    # -- phase 2: breaker opens, then recovers --------------------------
+    snap = server.stats()["models"][name]["breaker"]
+    threshold = snap["failure_threshold"]
+    opens_before = server.stats()["models"][name]["breaker_opens"]
+    # drain any residual failure streak from phase 1 so the count is exact
+    res = server.predict(name, inputs[0], timeout_ms=2000.0)
+    if res.status != srv.OK:
+        violations.append("breaker phase: warm predict not OK: %r" % (res,))
+    persistent = faults.FaultPlan(seed).add("serving.predict", kind="fatal")
+    with faults.plan(persistent):
+        statuses = [server.predict(name, inputs[0], timeout_ms=2000.0).status
+                    for _ in range(threshold + 2)]
+        if statuses[:threshold] != [srv.ERROR] * threshold:
+            violations.append("breaker phase: first %d statuses %s (want "
+                              "all ERROR)" % (threshold, statuses[:threshold]))
+        if srv.UNAVAILABLE not in statuses[threshold:]:
+            violations.append("breaker did not open: tail statuses %s"
+                              % statuses[threshold:])
+        after_open = server.stats()["models"][name]
+        if after_open["breaker_opens"] <= opens_before:
+            violations.append("breaker_opens counter did not advance")
+        if after_open["health"] != "UNAVAILABLE":
+            violations.append("open breaker reports health %r"
+                              % after_open["health"])
+    # faults cleared: wait out the backoff, then the half-open probe must
+    # succeed and re-close the breaker
+    deadline = time.monotonic() + 5.0
+    recovered = False
+    while time.monotonic() < deadline:
+        res = server.predict(name, inputs[0], timeout_ms=2000.0)
+        if res.status == srv.OK:
+            recovered = True
+            break
+        time.sleep(0.005)
+    if not recovered:
+        violations.append("breaker never recovered after faults cleared")
+    final = server.stats()["models"][name]
+    if final["breaker"]["state"] != "closed":
+        violations.append("breaker state %r after recovery (want closed)"
+                          % final["breaker"]["state"])
+    if final["health"] != "HEALTHY":
+        violations.append("health %r after recovery (want HEALTHY)"
+                          % final["health"])
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# scenario 7: checkpoint crash sweep
+# ---------------------------------------------------------------------------
+
+def crash_sweep(seed):
+    """Kill a checkpoint save at every fault point (the ``crash`` scenario).
+
+    Enumerate every ``checkpoint.*`` fault point one save passes (per-chunk
+    writes, pre-replace, post-replace — for the symbol, params, and
+    manifest files), then for each point k run — against a FRESH prefix
+    holding only a committed epoch-1 checkpoint — a save of epoch 2 killed
+    exactly there (kind alternating crash / torn-write-truncate by seed).
+    The invariant is exact, not just "something restores": epoch 2 may be
+    the latest COMPLETE checkpoint only when the kill fired after the
+    manifest's own ``os.replace`` (the commit point); at every earlier kill
+    the restore must fall back to epoch 1.  Either way the winning epoch's
+    params must load bit-exact.  Finally a clean save must win."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from .. import faults
+    from .. import model as model_mod
+    from .. import ndarray as nd
+    from .. import symbol as sym_mod
+
+    violations = []
+    rng = random.Random(seed ^ 0xC4A5)
+    tmpdir = tempfile.mkdtemp(prefix="mxstress-crash-")
+
+    def params_for(epoch):
+        base = np.arange(8, dtype=np.float32).reshape(2, 4)
+        return {"w": nd.array(base + epoch), "b": nd.array(
+            np.full((4,), float(epoch), np.float32))}
+
+    x = sym_mod.Variable("data")
+    net = sym_mod.FullyConnected(x, num_hidden=4, name="fc")
+
+    def save(prefix, epoch, fault_plan=None):
+        if fault_plan is None:
+            model_mod.save_checkpoint(prefix, epoch, net,
+                                      params_for(epoch), {})
+            return
+        with faults.plan(fault_plan):
+            model_mod.save_checkpoint(prefix, epoch, net,
+                                      params_for(epoch), {})
+
+    def check(prefix, want_epoch, where):
+        latest = model_mod.latest_complete_checkpoint(prefix)
+        if latest != want_epoch:
+            violations.append("%s: latest complete is %r (want %r)"
+                              % (where, latest, want_epoch))
+        if latest is None:
+            return
+        try:
+            _, args, _ = model_mod.load_checkpoint(prefix, latest)
+        except Exception as exc:
+            violations.append("%s: latest_complete epoch %d failed to "
+                              "load: %r" % (where, latest, exc))
+            return
+        want = params_for(latest)
+        for k in want:
+            if not np.array_equal(args[k].asnumpy(), want[k].asnumpy()):
+                violations.append("%s: epoch %d param %r not bit-exact"
+                                  % (where, latest, k))
+
+    try:
+        # enumerate every (site, per-site hit index) fault point one save
+        # passes — an empty plan records hits without injecting anything —
+        # against a throwaway prefix so nothing real gets committed
+        probe = faults.FaultPlan(0)
+        save(os.path.join(tmpdir, "probe"), 2, probe)
+        points = [(site, i)
+                  for site in sorted(probe.hits)
+                  if site.startswith("checkpoint.")
+                  for i in range(probe.hits[site])]
+        if len(points) < 6:
+            violations.append("crash sweep: only %d checkpoint fault "
+                              "points (atomic writer shrank?)"
+                              % len(points))
+        # the save is committed exactly when the LAST file's (the
+        # manifest's) os.replace has happened: the final "replaced" hit
+        n_files = probe.hits.get("checkpoint.replaced", 0)
+        committed_at = ("checkpoint.replaced", n_files - 1)
+        for n, (site, i) in enumerate(points):
+            prefix = os.path.join(tmpdir, "k%d" % n, "ck")
+            os.makedirs(os.path.dirname(prefix))
+            save(prefix, 1)   # must survive the killed save of epoch 2
+            kind = "truncate" if rng.random() < 0.5 else "crash"
+            plan_k = faults.FaultPlan(seed * 131 + n)
+            plan_k.add(site, kind=kind, after=i, times=1)
+            try:
+                save(prefix, 2, plan_k)
+                violations.append("crash sweep: kill point %s#%d never "
+                                  "fired" % (site, i))
+            except faults.SimulatedCrash:
+                pass
+            want = 2 if (site, i) == committed_at else 1
+            check(prefix, want, "kill@%s#%d(%s)" % (site, i, kind))
+        prefix = os.path.join(tmpdir, "clean")
+        save(prefix, 1)
+        save(prefix, 2)   # clean save: newest-complete must be 2
+        check(prefix, 2, "after clean save")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
-SCENARIOS = ("serving", "registry", "cache", "bulk", "feed")
+SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
+             "crash")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -606,6 +877,12 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                     per_seed["bulk"] = bulk_scopes(seed)
                 if "feed" in scenarios:
                     per_seed["feed"] = feed_pipeline(seed)
+                if "faults" in scenarios:
+                    per_seed["faults"] = fault_storm(
+                        server, name, inputs, expected, seed,
+                        per_client=per_client)
+                if "crash" in scenarios:
+                    per_seed["crash"] = crash_sweep(seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n

@@ -41,6 +41,9 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
         done = epoch + 1
         if done % period == 0:
             mod.save_checkpoint(prefix, done, save_optimizer_states)
+    # fit(auto_resume=True) discovers the resume prefix from its
+    # epoch_end_callbacks through this attribute (docs/ROBUSTNESS.md)
+    _save.checkpoint_prefix = prefix
     return _save
 
 
@@ -53,6 +56,7 @@ def do_checkpoint(prefix, period=1):
         done = epoch + 1
         if done % period == 0:
             save_checkpoint(prefix, done, sym, arg, aux)
+    _save.checkpoint_prefix = prefix
     return _save
 
 

@@ -90,7 +90,13 @@ def _worker_init(dataset):
 
 def _worker_fn(batch_indices):
     # paired with _worker_init's locked publish: in the ThreadPool fallback
-    # the initializer and the first work item can run on different threads
+    # the initializer and the first work item can run on different threads.
+    # NOTE on the fault point: with thread_pool=True (and in the fork Pool
+    # when the FaultPlan was active at pool construction) the plan is the
+    # caller's; forked workers otherwise carry their own inherited copy, so
+    # per-plan hit/fired accounting is only exact in-process
+    from ...faults import fault_point
+    fault_point("dataloader.worker", batch_indices=tuple(batch_indices))
     with _worker_dataset_lock:
         dataset = _worker_dataset
     samples = [dataset[i] for i in batch_indices]
@@ -107,12 +113,19 @@ def _worker_fn(batch_indices):
 class _MultiWorkerIter:
     """Sample batches from the loader's persistent pool, ``prefetch``
     submissions ahead.  Yields raw sample lists; batchify is the caller's
-    (or the feed thread's) job."""
+    (or the feed thread's) job.
+
+    Worker-death recovery (docs/ROBUSTNESS.md): a batch whose worker died
+    with a *retryable* failure is resubmitted to the (persistent) pool up
+    to ``_RESUBMIT_ATTEMPTS`` times before the failure surfaces — a single
+    flaky worker blip costs one extra round-trip, not the epoch."""
+
+    _RESUBMIT_ATTEMPTS = 3
 
     def __init__(self, loader):
         self._loader = loader
         self._iter = iter(loader._batch_sampler)
-        self._pending = []
+        self._pending = []   # [batch_indices, AsyncResult] pairs, in order
         for _ in range(loader._prefetch):
             self._push_next()
 
@@ -122,36 +135,62 @@ class _MultiWorkerIter:
         except StopIteration:
             return
         result = self._loader._submit(batch_indices)
-        self._pending.append(result)
+        self._pending.append([batch_indices, result])
+
+    def _wait(self, result):
+        # bounded waits so a concurrent close() (which may terminate()
+        # a wedged pool — terminated pools never complete outstanding
+        # results) surfaces as an error here instead of hanging this
+        # consumer in an untimed get() forever.  The cumulative cap
+        # (loader.worker_timeout) covers the worker-DEATH case: a pool
+        # worker killed outright (SIGKILL, simulated crash) never posts
+        # its AsyncResult at all, and without a ceiling this loop would
+        # wedge for the life of the process
+        import time as _time
+        deadline = (None if self._loader._worker_timeout is None
+                    else _time.monotonic() + self._loader._worker_timeout)
+        while True:
+            try:
+                return result.get(timeout=1.0)
+            except _mp.TimeoutError:
+                with self._loader._lock:
+                    closed = self._loader._closed
+                if closed:
+                    raise RuntimeError(
+                        "DataLoader was closed during iteration")
+                if deadline is not None and _time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        "DataLoader batch did not arrive within "
+                        "worker_timeout=%.0fs — a pool worker likely died "
+                        "without returning (killed process?); close() the "
+                        "loader or raise worker_timeout for slow datasets"
+                        % self._loader._worker_timeout)
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        from ...faults import is_retryable
         if not self._pending:
             raise StopIteration
-        result = self._pending.pop(0)
+        batch_indices, result = self._pending.pop(0)
         self._push_next()
-        try:
-            # bounded waits so a concurrent close() (which may terminate()
-            # a wedged pool — terminated pools never complete outstanding
-            # results) surfaces as an error here instead of hanging this
-            # consumer in an untimed get() forever
-            while True:
+        for attempt in range(self._RESUBMIT_ATTEMPTS):
+            try:
                 try:
-                    samples = result.get(timeout=1.0)
-                    break
-                except _mp.TimeoutError:
-                    with self._loader._lock:
-                        closed = self._loader._closed
-                    if closed:
-                        raise RuntimeError(
-                            "DataLoader was closed during iteration")
-        finally:
-            # success or worker exception, the result is no longer in
-            # flight — close() must not wait on it
-            self._loader._untrack(result)
-        return samples
+                    return self._wait(result)
+                finally:
+                    # success or worker exception, the result is no longer
+                    # in flight — close() must not wait on it
+                    self._loader._untrack(result)
+            except Exception as exc:
+                if not is_retryable(exc) or \
+                        attempt == self._RESUBMIT_ATTEMPTS - 1:
+                    raise
+                # worker died on a retryable fault: same indices, new
+                # submission (sample order is preserved — the retried batch
+                # keeps its position in the epoch)
+                result = self._loader._submit(batch_indices)
 
     def __del__(self):
         # an epoch abandoned mid-stream must not strand its prefetch
@@ -160,7 +199,7 @@ class _MultiWorkerIter:
         # completed results are dropped — still-running ones stay visible
         # to close()'s bounded drain / wedged-worker detection.
         try:
-            for result in self._pending:
+            for _indices, result in self._pending:
                 if result.ready():
                     self._loader._untrack(result)
         except Exception:
@@ -200,13 +239,26 @@ class DataLoader:
     prefetch_to_device : Context, optional
         Stage batches onto this device context ahead of the consumer
         (the async device-feed path).
+    worker_timeout : float or None
+        Max seconds to wait for any single batch from the worker pool
+        (default 300).  A pool worker killed outright never posts its
+        result; the ceiling turns that permanent hang into a RuntimeError.
+        ``None`` disables it.
     """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, prefetch_to_device=None):
+                 thread_pool=False, prefetch_to_device=None,
+                 worker_timeout=300.0):
         self._dataset = dataset
+        # ceiling on waiting for ONE batch from the pool: a worker process
+        # killed outright never posts its result, and an unbounded wait
+        # would wedge the consumer forever (docs/ROBUSTNESS.md).  None
+        # disables the ceiling for datasets with legitimately unbounded
+        # per-batch latency.
+        self._worker_timeout = (None if worker_timeout is None
+                                else float(worker_timeout))
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size must be specified unless "

@@ -46,6 +46,7 @@ import threading
 import time
 
 from .. import profiler
+from .. import util as _util
 
 __all__ = ["DeviceFeed", "stage_batch"]
 
@@ -75,6 +76,11 @@ def _resolve_device(ctx):
 def stage_batch(item, ctx=None, mesh=None):
     """Place one batch item on device, preserving its structure.
 
+    The ``device_feed.put`` fault point sits at the top: an injected
+    transient transfer failure is absorbed by the worker's retry envelope
+    (``_stage_with_retry``); a persistent one propagates to the consumer
+    like any other worker error.
+
     Handles the shapes that flow through this framework's input paths:
     ``DataBatch`` (data/label NDArray lists), lists/tuples/dicts of leaves,
     and leaves themselves.  Leaf rule: ``NDArray`` in, ``NDArray`` out
@@ -88,8 +94,10 @@ def stage_batch(item, ctx=None, mesh=None):
     """
     import jax
 
+    from ..faults import fault_point
     from ..ndarray import NDArray, _wrap
 
+    fault_point("device_feed.put")
     if mesh is not None:
         from ..parallel import shard_batch
 
@@ -180,6 +188,12 @@ class _FeedState:
         return False
 
 
+# retry envelope for the staging transfer (docs/ROBUSTNESS.md): a
+# transient device_put failure re-stages the same item (device_put is
+# idempotent) instead of killing the epoch
+_stage_with_retry = _util.retry(attempts=3, backoff=0.002)(stage_batch)
+
+
 def _feed_worker(state):
     try:
         it = iter(state.source)
@@ -192,7 +206,7 @@ def _feed_worker(state):
             if state.transform is not None:
                 item = state.transform(item)
             t0 = time.perf_counter()
-            staged = (stage_batch(item, ctx=state.ctx, mesh=state.mesh)
+            staged = (_stage_with_retry(item, ctx=state.ctx, mesh=state.mesh)
                       if state.stage else item)
             h2d_ms = (time.perf_counter() - t0) * 1e3
             if not state.put(staged):

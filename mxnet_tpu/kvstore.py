@@ -33,8 +33,22 @@ import pickle
 from .base import MXNetError, string_types
 from .ndarray import NDArray, invoke, zeros, array
 from . import optimizer as opt
+from . import util as _util
 
 __all__ = ["KVStore", "create"]
+
+
+@_util.retry(attempts=3, backoff=0.002)
+def _transfer_boundary(direction, key):
+    """The injectable push/pull transfer edge (docs/ROBUSTNESS.md).
+
+    A real kvstore loses pushes/pulls to flaky links; this is where a
+    FaultPlan injects that.  Transient faults are absorbed by the retry
+    envelope (3 attempts, 2 ms exponential backoff); a fatal fault (or a
+    transient one outlasting the budget) propagates to the caller as the
+    per-key failure it models."""
+    from . import faults
+    faults.fault_point("kvstore." + direction, key=key)
 
 
 def _profile_span(name):
@@ -124,6 +138,7 @@ class KVStore:
             k = str(k)
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
+            _transfer_boundary("push", k)
             merged = self._reduce(vlist)
             if self._updater is not None:
                 self._updater(self._key_to_int(k), merged, self._store[k])
@@ -138,6 +153,7 @@ class KVStore:
             k = str(k)
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
+            _transfer_boundary("pull", k)
             src = self._store[k]
             for o in olist:
                 src.copyto(o)
@@ -213,8 +229,8 @@ class KVStore:
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
-        with open(fname, "wb") as fout:
-            fout.write(self._updater.get_states(dump_optimizer))
+        from .util import write_atomic
+        write_atomic(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot load states for distributed training"
@@ -397,6 +413,7 @@ class KVStoreDist(KVStoreTPUSync):
             k = str(k)
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
+            _transfer_boundary("push", k)
             span = _profile_span("KVStoreDist.push(%s)" % k)
             try:
                 merged = self._reduce(vlist)

@@ -97,13 +97,24 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """Checkpoint to prefix-symbol.json + prefix-%04d.params (model.py:383)."""
+    """Checkpoint to prefix-symbol.json + prefix-%04d.params (model.py:383).
+
+    Crash-consistent: every file is written atomically (util.write_atomic),
+    and the checkpoint is recorded in ``prefix-manifest.json`` with per-file
+    content hashes LAST — so a crash at any point leaves the manifest
+    pointing only at complete checkpoints, and ``fit(auto_resume=True)`` /
+    :func:`latest_complete_checkpoint` skip the torn tail."""
+    files = []
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        symbol_file = "%s-symbol.json" % prefix
+        symbol.save(symbol_file)
+        files.append(symbol_file)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
     nd.save(param_name, save_dict)
+    files.append(param_name)
+    record_checkpoint(prefix, epoch, files)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
@@ -120,3 +131,124 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifest: which epochs are COMPLETE, with content hashes
+# ---------------------------------------------------------------------------
+# Format of ``<prefix>-manifest.json`` (docs/ROBUSTNESS.md):
+#   {"version": 1,
+#    "checkpoints": {"7": {"files": {"<path>": "<sha256 hex>", ...}}}}
+# Keys are epoch numbers as strings; paths are as written (relative to the
+# caller's cwd, like every other prefix-derived path in this API).  The
+# manifest itself is written atomically, AFTER the checkpoint files it
+# records — it is the commit record of the save.
+
+def _manifest_path(prefix):
+    return "%s-manifest.json" % prefix
+
+
+def load_manifest(prefix):
+    """Parsed manifest dict, or None (missing / torn / unreadable)."""
+    import json
+    try:
+        with open(_manifest_path(prefix), "r") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(manifest, dict) or \
+            not isinstance(manifest.get("checkpoints"), dict):
+        return None
+    return manifest
+
+
+def record_checkpoint(prefix, epoch, files):
+    """Commit a completed checkpoint into the manifest (atomic rewrite)."""
+    import json
+    from .util import sha256_file, write_atomic
+    manifest = load_manifest(prefix) or {"version": 1, "checkpoints": {}}
+    # the read-back hash hits the page cache (the files were written
+    # microseconds ago) and keeps every writer API digest-free; it also
+    # hashes what actually LANDED on disk, which is the point
+    manifest["checkpoints"][str(int(epoch))] = {
+        "files": {f: sha256_file(f) for f in files}}
+    write_atomic(_manifest_path(prefix), json.dumps(manifest, indent=1,
+                                                    sort_keys=True))
+
+
+def checkpoint_files(prefix, epoch):
+    """Files the manifest records for ``epoch`` (dict path->sha), or None.
+
+    None means "no manifest entry" — either pre-manifest checkpoints or an
+    uncommitted save; callers treat unlisted files (e.g. a stray ``.states``
+    left by a crash) as untrusted."""
+    manifest = load_manifest(prefix)
+    if manifest is None:
+        return None
+    entry = manifest["checkpoints"].get(str(int(epoch)))
+    return None if entry is None else dict(entry.get("files", {}))
+
+
+def _checkpoint_intact(entry):
+    """Do all files a manifest entry records still exist with their hashes?"""
+    from .util import sha256_file
+    files = entry.get("files", {})
+    if not files:
+        return False
+    for path, digest in files.items():
+        try:
+            if sha256_file(path) != digest:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def latest_complete_checkpoint(prefix, allow_unverified=False):
+    """Newest epoch with a verifiably complete checkpoint, or None.
+
+    Primary path: walk the manifest newest-first and return the first epoch
+    whose recorded files all exist with matching content hashes (a crash
+    between a param write and its manifest commit, or a later torn file,
+    both skip cleanly to the previous epoch).  "Complete" strictly means
+    "committed in the manifest": with no manifest at all the default answer
+    is None, because a params file alone proves nothing about its siblings
+    (the classic case: a crash between the first params commit and the
+    first manifest commit leaves loadable params with NO optimizer state —
+    resuming from it silently diverges from the uninterrupted run).
+
+    ``allow_unverified=True`` opts into a best-effort fallback for
+    pre-manifest (legacy) checkpoints: scan ``prefix-%04d.params`` on disk
+    newest-first and return the first epoch whose params (and symbol file,
+    when present) actually parse.
+    """
+    manifest = load_manifest(prefix)
+    if manifest is not None:
+        for epoch in sorted((int(e) for e in manifest["checkpoints"]),
+                            reverse=True):
+            if _checkpoint_intact(manifest["checkpoints"][str(epoch)]):
+                return epoch
+        return None
+    if not allow_unverified:
+        return None
+    # opt-in manifest-less fallback: validate by parsing
+    import glob
+    import os
+    import re
+    pattern = re.compile(re.escape(os.path.basename(prefix)) +
+                         r"-(\d{4})\.params$")
+    epochs = []
+    for path in glob.glob("%s-*.params" % glob.escape(prefix)):
+        m = pattern.search(os.path.basename(path))
+        if m:
+            epochs.append(int(m.group(1)))
+    symbol_file = "%s-symbol.json" % prefix
+    for epoch in sorted(epochs, reverse=True):
+        try:
+            nd.load("%s-%04d.params" % (prefix, epoch))
+            if os.path.exists(symbol_file):
+                sym.load(symbol_file)
+            return epoch
+        except Exception:
+            continue
+    return None

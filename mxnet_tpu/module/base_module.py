@@ -144,7 +144,8 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
-            sparse_row_id_fn=None, prefetch_to_device=None):
+            sparse_row_id_fn=None, prefetch_to_device=None,
+            resume_from=None, auto_resume=False):
         """Train the module (reference base_module.py:410).
 
         ``prefetch_to_device`` (a Context) routes each epoch's batches
@@ -154,11 +155,53 @@ class BaseModule:
         for iterators that reuse host buffers between ``next()`` calls —
         staging copies each batch to the device before the feed advances
         the source again).
+
+        Crash recovery (docs/ROBUSTNESS.md): ``resume_from=prefix`` scans
+        ``prefix-manifest.json`` for the newest COMPLETE checkpoint (torn
+        or uncommitted saves are skipped by content hash), restores params
+        + optimizer state + epoch, and continues training from there; with
+        no complete checkpoint it raises.  ``auto_resume=True`` is the
+        opportunistic form: resume when a complete checkpoint exists, start
+        fresh otherwise — and when ``resume_from`` is not given, the prefix
+        is discovered from a ``do_checkpoint``/``module_checkpoint`` epoch
+        callback (their ``checkpoint_prefix`` attribute), so the idiom
+        ``fit(..., epoch_end_callback=do_checkpoint(p), auto_resume=True)``
+        makes a preempted-and-restarted job pick itself back up.
         """
         assert num_epoch is not None, "please specify number of epochs"
+        import os
         from ..initializer import Uniform
         if initializer is None:
             initializer = Uniform(0.01)
+
+        resume_prefix = resume_from
+        if resume_prefix is None and auto_resume and \
+                epoch_end_callback is not None:
+            for cb in _as_list(epoch_end_callback):
+                prefix = getattr(cb, "checkpoint_prefix", None)
+                if prefix:
+                    resume_prefix = prefix
+                    break
+        resume_epoch = None
+        if resume_prefix is not None:
+            from ..model import latest_complete_checkpoint, load_checkpoint
+            resume_epoch = latest_complete_checkpoint(resume_prefix)
+            if resume_epoch is None:
+                if not auto_resume:
+                    raise FileNotFoundError(
+                        "resume_from=%r: no complete checkpoint found "
+                        "(torn/partial saves are skipped via the manifest)"
+                        % resume_prefix)
+                self.logger.info("auto_resume: no complete checkpoint under "
+                                 "%r; starting fresh", resume_prefix)
+            else:
+                _, arg_params, aux_params = load_checkpoint(resume_prefix,
+                                                            resume_epoch)
+                force_init = True
+                allow_missing = False
+                begin_epoch = max(begin_epoch, resume_epoch)
+                self.logger.info("Resuming from checkpoint %r epoch %d",
+                                 resume_prefix, resume_epoch)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -170,6 +213,20 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        if resume_epoch is not None:
+            # optimizer state rides along only when the manifest committed
+            # it for that epoch — a stray .states from a torn save is not
+            # trusted (checkpoint_files returns only hash-verified entries)
+            from ..model import checkpoint_files
+            state_file = "%s-%04d.states" % (resume_prefix, resume_epoch)
+            listed = checkpoint_files(resume_prefix, resume_epoch)
+            if listed is not None and state_file in listed and \
+                    os.path.exists(state_file) and \
+                    hasattr(self, "load_optimizer_states"):
+                self.load_optimizer_states(state_file)
+                self.logger.info("Restored optimizer state from %r",
+                                 state_file)
 
         if validation_metric is None:
             validation_metric = eval_metric

@@ -77,14 +77,23 @@ class Module(BaseModule):
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
                         remove_amp_cast=True):
-        self._symbol.save("%s-symbol.json" % prefix)
+        """Crash-consistent checkpoint: symbol + params (+ optimizer states)
+        are each written atomically, then committed together as one entry in
+        ``prefix-manifest.json`` — a crash anywhere leaves the previous
+        complete checkpoint restorable (docs/ROBUSTNESS.md)."""
+        from ..model import record_checkpoint
+        symbol_file = "%s-symbol.json" % prefix
+        self._symbol.save(symbol_file)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
+        files = [symbol_file, param_name]
         logging.info("Saved checkpoint to \"%s\"", param_name)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)
+            files.append(state_name)
             logging.info("Saved optimizer state to \"%s\"", state_name)
+        record_checkpoint(prefix, epoch, files)
 
     def _reset_bind(self):
         self.binded = False
@@ -366,8 +375,8 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            from ..util import write_atomic
+            write_atomic(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

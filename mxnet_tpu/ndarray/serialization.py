@@ -180,7 +180,13 @@ def deserialize_ndarray(buf, off):
 
 
 def save_list(fname, arrays, names):
-    """Write the 0x112 list container (NDArray::Save list form)."""
+    """Write the 0x112 list container (NDArray::Save list form).
+
+    Crash-consistent: the whole container goes through
+    ``util.write_atomic`` (tmp + fsync + ``os.replace``), so an interrupted
+    save can never leave a torn ``.params`` file for ``load`` to explode on
+    — the old complete file (if any) survives instead."""
+    from ..util import write_atomic
     out = [struct.pack("<QQ", LIST_MAGIC, 0),
            struct.pack("<Q", len(arrays))]
     for a in arrays:
@@ -190,8 +196,7 @@ def save_list(fname, arrays, names):
         b = n.encode("utf-8")
         out.append(struct.pack("<Q", len(b)))
         out.append(b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    write_atomic(fname, b"".join(out))
 
 
 def load_list(buf):

@@ -56,6 +56,9 @@ def restore_sharded(path, template=None, shardings=None):
             # whole (possibly pod-sized) tree
             from etils import epath
             meta = ocp.StandardCheckpointHandler().metadata(epath.Path(path))
+            # orbax API drift: older releases wrap the metadata pytree in an
+            # object with a .tree attribute; current ones return it directly
+            meta_tree = getattr(meta, "tree", meta)
             try:
                 dev = jax.devices("cpu")[0]
             except RuntimeError:
@@ -64,7 +67,7 @@ def restore_sharded(path, template=None, shardings=None):
             template = jax.tree.map(
                 lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
                                                sharding=one_dev),
-                meta.tree, is_leaf=lambda m: hasattr(m, "shape"))
+                meta_tree, is_leaf=lambda m: hasattr(m, "shape"))
             return ckptr.restore(path, template)
         if shardings is not None:
             template = jax.tree.map(
@@ -80,37 +83,95 @@ def restore_sharded(path, template=None, shardings=None):
 class SlicedCheckpointManager:
     """Keep the latest N step checkpoints of params + optimizer state
     (the Module.save_checkpoint / do_checkpoint analog for sharded
-    training loops)."""
+    training loops).
 
-    def __init__(self, directory, max_to_keep=3):
+    ``async_save=True`` (the default) overlaps the checkpoint write with
+    the steps that follow it: ``save`` kicks off a background commit and
+    returns immediately; the write is only waited out at the *next* save
+    (so at most one checkpoint is ever in flight) and at ``close()``.  A
+    step no longer stalls behind its own checkpoint — the historical
+    ``wait_until_finished`` after every save was a full training-step
+    bubble.  Restore semantics stay crash-consistent either way:
+    latest-COMPLETE-wins (see :meth:`restore`); a process killed mid-commit
+    leaves an uncommitted step directory that orbax's atomic finalize never
+    promotes, and restore falls back to the newest step that actually
+    restores."""
+
+    def __init__(self, directory, max_to_keep=3, async_save=True):
         ocp = _ocp()
+        self._async = bool(async_save)
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(directory),
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                                 enable_async_checkpointing=False))
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=self._async))
 
     def save(self, step, params, opt_state=None):
         ocp = _ocp()
+        # settle the previous in-flight save first (bounded pipelining:
+        # step N's write may overlap steps N+1.., never a second write)
+        self._mgr.wait_until_finished()
         items = {"params": ocp.args.StandardSave(params)}
         if opt_state is not None:
             items["opt_state"] = ocp.args.StandardSave(opt_state)
         self._mgr.save(step, args=ocp.args.Composite(**items))
+        if not self._async:
+            self._mgr.wait_until_finished()
+
+    def wait_until_finished(self):
+        """Block until any in-flight async save has committed."""
         self._mgr.wait_until_finished()
 
     def latest_step(self):
         return self._mgr.latest_step()
 
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
     def restore(self, step=None, params_template=None, opt_template=None,
                 shardings=None, opt_shardings=None):
         """``shardings``/``opt_shardings`` re-lay params / optimizer state
-        onto a target mesh; each must match its own template's tree."""
+        onto a target mesh; each must match its own template's tree.
+
+        With ``step=None`` the restore is latest-COMPLETE-wins: steps are
+        tried newest-first and a step whose payload is torn or missing
+        (crash mid-commit, partial deletion) is skipped with a warning
+        instead of failing the resume — the same semantics as
+        ``fit(auto_resume=True)`` on the single-chip ``.params`` path.
+        An explicitly requested step restores strictly (errors surface)."""
+        self._mgr.wait_until_finished()
+        if step is not None:
+            return self._restore_step(step, params_template, opt_template,
+                                      shardings, opt_shardings)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(
+                "no checkpoint found in %s" % self._mgr.directory)
+        last_exc = None
+        for candidate in steps:
+            try:
+                return self._restore_step(candidate, params_template,
+                                          opt_template, shardings,
+                                          opt_shardings)
+            # only incomplete/torn-payload signatures fall back (missing
+            # item/file/array: KeyError from the composite, FileNotFoundError/
+            # OSError from tensorstore).  A template/sharding mismatch or
+            # OOM raises — silently restoring an OLDER step for those would
+            # trade a visible error for lost training progress
+            except (KeyError, FileNotFoundError, OSError) as exc:
+                import logging
+                logging.warning("checkpoint step %s is incomplete/torn (%s); "
+                                "falling back to the previous step",
+                                candidate, exc)
+                last_exc = exc
+        raise FileNotFoundError(
+            "no COMPLETE checkpoint in %s (%d candidate step(s), newest "
+            "failure: %s)" % (self._mgr.directory, len(steps), last_exc))
+
+    def _restore_step(self, step, params_template, opt_template,
+                      shardings, opt_shardings):
         import jax
         ocp = _ocp()
-        if step is None:
-            step = self._mgr.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    "no checkpoint found in %s" % self._mgr.directory)
 
         def spec(tree, shard_tree):
             if tree is None:
@@ -131,10 +192,10 @@ class SlicedCheckpointManager:
             items["opt_state"] = ocp.args.StandardRestore(
                 spec(opt_template, opt_shardings))
         if items:
-            out = self._mgr.restore(step, args=ocp.args.Composite(**items))
-        else:
-            out = self._mgr.restore(step)
-        return out
+            return self._mgr.restore(step, args=ocp.args.Composite(**items))
+        return self._mgr.restore(step)
 
     def close(self):
+        # close() commits any in-flight async save before shutting down
+        self._mgr.wait_until_finished()
         self._mgr.close()

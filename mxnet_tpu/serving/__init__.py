@@ -13,10 +13,14 @@ observability.  See docs/SERVING.md for architecture and tuning.
 """
 from .buckets import BucketLadder, shape_key
 from .batcher import MicroBatcher, Request
+from .health import CircuitBreaker, HEALTHY, DEGRADED
 from .registry import ModelRegistry, ServableModel
 from .server import (ModelServer, InferenceResult,
-                     OK, TIMEOUT, OVERLOADED, INVALID_INPUT, ERROR)
+                     OK, TIMEOUT, OVERLOADED, INVALID_INPUT, ERROR,
+                     UNAVAILABLE)
 
 __all__ = ["ModelServer", "InferenceResult", "BucketLadder", "Request",
            "MicroBatcher", "ModelRegistry", "ServableModel", "shape_key",
-           "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR"]
+           "CircuitBreaker", "HEALTHY", "DEGRADED",
+           "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR",
+           "UNAVAILABLE"]

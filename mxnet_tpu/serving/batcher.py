@@ -39,13 +39,18 @@ class Request:
     """One in-flight inference request (also the async result handle)."""
 
     __slots__ = ("inputs", "key", "t_enqueue", "deadline", "status",
-                 "outputs", "error", "latency_ms", "_event", "_done_lock")
+                 "outputs", "error", "latency_ms", "stats", "_event",
+                 "_done_lock")
 
-    def __init__(self, inputs, deadline=None):
+    def __init__(self, inputs, deadline=None, stats=None):
         self.inputs = tuple(inputs)          # per-request numpy arrays
         self.key = shape_key(self.inputs)
         self.t_enqueue = time.monotonic()
         self.deadline = deadline             # monotonic seconds or None
+        # the owning model's ModelStats, attached at submission so a
+        # claimant can keep the terminal counters conserved even after the
+        # model/server entry is torn down (result() across unload)
+        self.stats = stats
         self.status = None
         self.outputs = None
         self.error = None
@@ -104,13 +109,16 @@ class MicroBatcher:
 
     # -- client side ----------------------------------------------------
     def submit(self, request):
-        """Admit or shed.  Returns False (and counts a shed) when full."""
+        """Admit or refuse.  Returns True when admitted, else the refusal
+        reason: ``"full"`` (a shed was counted here) or ``"stopping"``
+        (lifecycle — counted by the caller as its one UNAVAILABLE, never
+        double-counted with shed)."""
         with self._cond:
             if not self._running:
-                return False
+                return "stopping"
             if len(self._queue) >= self._max_queue:
                 self._stats.on_shed()
-                return False
+                return "full"
             self._queue.append(request)
             self._stats.on_admitted()
             self._stats.on_queue_depth(len(self._queue))
@@ -127,18 +135,26 @@ class MicroBatcher:
             self._paused = False
             self._cond.notify_all()
 
+    @property
+    def running(self):
+        with self._cond:
+            return self._running
+
     def stop(self):
+        """Tear down; every queued request terminates with the retryable
+        UNAVAILABLE status (shutdown is a lifecycle event, not a model
+        error) — no waiter is ever left hanging on a dead queue."""
         with self._cond:
             self._running = False
             self._cond.notify_all()
         self._thread.join(timeout=5)
-        from .server import ERROR
+        from .server import UNAVAILABLE
         with self._cond:
             leftovers = list(self._queue)
             self._queue.clear()
         for r in leftovers:
-            if r.complete(ERROR, error="server stopped"):
-                self._stats.on_result(ERROR, r.latency_ms)
+            if r.complete(UNAVAILABLE, error="server shutting down"):
+                self._stats.on_result(UNAVAILABLE, r.latency_ms)
 
     # -- worker side ----------------------------------------------------
     def _run(self):
@@ -215,13 +231,24 @@ class MicroBatcher:
                 stacked = np.concatenate([stacked, pad])
             arrays.append(stacked)
         t0 = time.monotonic()
+        breaker = getattr(self._model, "breaker", None)
         try:
             outs = self._model.execute(arrays)
         except Exception as exc:  # model bug: fail the batch, keep serving
+            if breaker is not None:
+                breaker.on_failure()
+                self._stats.on_breaker_state(breaker.state())
             for r in batch:
                 if r.complete(ERROR, error=repr(exc)):
                     self._stats.on_result(ERROR, r.latency_ms)
             return
+        if breaker is not None:
+            # success closes a half-open breaker (the probe path) and
+            # resets the failure streak
+            was_closed = breaker.state() == "closed"
+            breaker.on_success()
+            if not was_closed:
+                self._stats.on_breaker_state(breaker.state())
         batch_ms = (time.monotonic() - t0) * 1e3
         self._stats.on_batch(n, bucket, batch_ms)
         for i, r in enumerate(batch):

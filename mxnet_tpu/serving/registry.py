@@ -19,18 +19,30 @@ import threading
 import numpy as np
 
 from .. import autograd
+from .. import faults
+from .. import util
 from ..base import MXNetError
 from .buckets import BucketLadder, normalize_shape_variants, shape_key
+from .health import CircuitBreaker
 from .stats import ModelStats
 
 __all__ = ["ServableModel", "ModelRegistry"]
 
+# retry envelope around one batch execution: transient backend faults are
+# absorbed here (docs/ROBUSTNESS.md policy table); anything that outlasts
+# the budget surfaces to the batcher as the batch failure it is
+_EXEC_ATTEMPTS = 3
+_EXEC_BACKOFF_S = 0.002
+
 
 class ServableModel:
-    """One loaded model: CachedOp + bucket menu + per-model stats."""
+    """One loaded model: CachedOp + bucket menu + per-model stats +
+    circuit breaker (health.py)."""
 
     def __init__(self, name, block, input_shapes, dtype="float32",
-                 max_batch=8, batch_ladder=None, flags=None):
+                 max_batch=8, batch_ladder=None, flags=None,
+                 breaker_threshold=5, breaker_backoff_ms=50.0,
+                 breaker_max_backoff_ms=2000.0):
         self.name = name
         self.block = block
         self.ladder = (batch_ladder if isinstance(batch_ladder, BucketLadder)
@@ -54,6 +66,13 @@ class ServableModel:
         self._cop, params = build_cached_op(block, flags)
         self._params = {n: p.data() for n, p in params.items()}
         self.stats = ModelStats(name)
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            backoff_s=breaker_backoff_ms / 1e3,
+            max_backoff_s=breaker_max_backoff_ms / 1e3)
+        self._execute_retry = util.retry(
+            attempts=_EXEC_ATTEMPTS, backoff=_EXEC_BACKOFF_S,
+            on_retry=lambda exc, i: self.stats.on_retry())(self._execute_once)
         self.warmup_report = None
         # every admissible (per-request shapes, dtypes) coalescing key
         self.allowed_keys = frozenset(
@@ -78,8 +97,18 @@ class ServableModel:
     def execute(self, batch_arrays):
         """Run one padded batch (numpy, batch-major) -> list of numpy
         outputs, still batch-major.  Inference mode regardless of the
-        caller thread's autograd state."""
+        caller thread's autograd state.
+
+        The XLA call sits behind the ``serving.predict`` fault point and a
+        transient-retry envelope (docs/ROBUSTNESS.md): a flaky backend
+        costs latency, not a failed batch.  Failures that outlast the
+        budget propagate to the batcher, which fails the batch and reports
+        to the circuit breaker."""
+        return self._execute_retry(batch_arrays)
+
+    def _execute_once(self, batch_arrays):
         from ..ndarray import NDArray
+        faults.fault_point("serving.predict", model=self.name)
         inputs = [NDArray(np.ascontiguousarray(a)) for a in batch_arrays]
         with autograd.pause():
             out = self._cop(self._params, *inputs)

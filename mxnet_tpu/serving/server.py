@@ -13,9 +13,18 @@ Request lifecycle::
 
 Every terminal state is a *status*, not an exception: TIMEOUT (deadline
 passed before execution), OVERLOADED (queue full — shed at admission),
-INVALID_INPUT (shape not in the model's bucket menu), ERROR (model raised).
-Callers distinguish outcomes without try/except around the hot path, and an
-overloaded server degrades to fast rejections instead of growing a queue.
+INVALID_INPUT (shape not in the model's bucket menu), ERROR (model raised),
+UNAVAILABLE (retryable: circuit breaker open, or the server/model is
+shutting down).  Callers distinguish outcomes without try/except around the
+hot path, and an overloaded server degrades to fast rejections instead of
+growing a queue.
+
+Self-healing (docs/ROBUSTNESS.md): each model carries a circuit breaker
+(serving/health.py).  After K consecutive batch failures the breaker opens
+and admission fast-fails with UNAVAILABLE — no queueing, no XLA call — then
+half-open probing with exponential backoff recovers the model the moment
+its backend comes back.  ``stats()`` exposes per-model ``health``
+(HEALTHY/DEGRADED/UNAVAILABLE) and the breaker counters.
 
 Quickstart (see docs/SERVING.md)::
 
@@ -34,21 +43,31 @@ import numpy as np
 
 from ..base import MXNetError
 from .batcher import MicroBatcher, Request
+from .health import PROBE, REJECT
 from .registry import ModelRegistry, ServableModel
 
 __all__ = ["ModelServer", "InferenceResult",
-           "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR"]
+           "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR",
+           "UNAVAILABLE"]
 
 OK = "OK"
 TIMEOUT = "TIMEOUT"
 OVERLOADED = "OVERLOADED"
 INVALID_INPUT = "INVALID_INPUT"
 ERROR = "ERROR"
+# retryable terminal state: breaker open or server/model shutting down —
+# the caller should back off and try again (or another replica), unlike
+# ERROR which means THIS request's execution failed
+UNAVAILABLE = "UNAVAILABLE"
 
 # extra client-side wait beyond the deadline before declaring TIMEOUT
 # locally (covers worker wakeup jitter; the completion race is settled by
 # Request.complete's first-wins lock either way)
 _WAIT_GRACE_S = 0.25
+# how long result() waits on a deadline-less request whose model is being
+# torn down before claiming UNAVAILABLE itself: must exceed the batcher's
+# stop() join timeout (5 s) so the drain normally wins the claim
+_TEARDOWN_WAIT_S = 6.0
 
 
 class InferenceResult:
@@ -86,15 +105,27 @@ class _Entry:
 
 class ModelServer:
     def __init__(self):
+        import threading
         self._registry = ModelRegistry()
-        self._entries = {}           # name -> _Entry (guarded by registry)
         self._t_start = time.time()
+        self._lifecycle_lock = threading.Lock()
+        # guarded by _lifecycle_lock: name -> _Entry map, the closed flag,
+        # and the set of names that were EVER loaded (so result() can tell
+        # "model torn down mid-flight" from a caller's typo'd name)
+        self._entries = {}
+        self._closed = False
+        self._ever_loaded = set()
+
+    def _is_closed(self):
+        with self._lifecycle_lock:
+            return self._closed
 
     # -- model management ----------------------------------------------
     def load_model(self, name, block, input_shapes, dtype="float32",
                    max_batch=8, batch_ladder=None, max_queue=64,
                    linger_ms=2.0, default_timeout_ms=None, warmup=True,
-                   flags=None):
+                   flags=None, breaker_threshold=5, breaker_backoff_ms=50.0,
+                   breaker_max_backoff_ms=2000.0):
         """Load a Gluon block (hybridizable or plain) for serving.
 
         ``input_shapes`` is the complete menu of admissible per-request
@@ -104,24 +135,42 @@ class ModelServer:
         Outputs must be batch-major (row i of every output belongs to
         request i) — true of standard inference-mode networks.
         """
-        if name in self._entries:
+        with self._lifecycle_lock:
+            if self._closed:
+                raise MXNetError("server is stopped; create a new "
+                                 "ModelServer")
+            duplicate = name in self._entries
+        if duplicate:
             # cheap early duplicate check so a name collision fails before
             # the model build + whole-bucket-menu warmup compile; the
             # registry.add below is the authoritative (locked) check
             raise MXNetError("model %r is already loaded" % name)
         model = ServableModel(name, block, input_shapes, dtype=dtype,
                               max_batch=max_batch, batch_ladder=batch_ladder,
-                              flags=flags)
+                              flags=flags, breaker_threshold=breaker_threshold,
+                              breaker_backoff_ms=breaker_backoff_ms,
+                              breaker_max_backoff_ms=breaker_max_backoff_ms)
         if warmup:
             model.warmup()
         self._registry.add(model)
+        entry = None
         try:
             entry = _Entry(model, MicroBatcher(model, max_queue=max_queue,
                                                linger_ms=linger_ms),
                            default_timeout_ms)
-            self._entries[name] = entry
+            # final registration re-checks closed under the lifecycle lock:
+            # a stop() that raced the (slow) build + warmup above must not
+            # end up with a live batcher thread on a stopped server
+            with self._lifecycle_lock:
+                if self._closed:
+                    raise MXNetError("server stopped while loading %r"
+                                     % name)
+                self._entries[name] = entry
+                self._ever_loaded.add(name)
         except Exception:
             self._registry.remove(name)
+            if entry is not None:
+                entry.batcher.stop()
             raise
         return model
 
@@ -140,7 +189,8 @@ class ModelServer:
         # registry first: concurrent predicts turn into unknown-model errors
         # for the whole teardown window (the reverse of load_model's order)
         self._registry.remove(name)
-        entry = self._entries.pop(name)
+        with self._lifecycle_lock:
+            entry = self._entries.pop(name)
         entry.batcher.stop()
 
     def models(self):
@@ -158,8 +208,21 @@ class ModelServer:
     def predict_async(self, name, data, timeout_ms=None):
         """Submit one request; returns a Request handle (``wait()`` then
         read status/outputs) or an InferenceResult for immediate
-        rejections (shed / invalid shape)."""
-        entry = self._entry(name)
+        rejections (shed / invalid shape / breaker open / shutting down)."""
+        if self._is_closed():
+            # a closed server is a lifecycle condition, not a caller error:
+            # clean retryable status instead of raising at every call site
+            return InferenceResult(UNAVAILABLE, latency_ms=0.0,
+                                   error="server stopped")
+        try:
+            entry = self._entry(name)
+        except MXNetError:
+            if self._is_closed() or name in self._registry.names():
+                # closing, or caught mid load/unload transition
+                return InferenceResult(UNAVAILABLE, latency_ms=0.0,
+                                       error="model %r is mid load/unload "
+                                             "or shutting down; retry" % name)
+            raise   # genuinely unknown model: keep the helpful error
         model = entry.model
         try:
             inputs = self._coerce(model, data)
@@ -177,12 +240,42 @@ class ModelServer:
                 % ([tuple(a.shape) for a in inputs],
                    sorted(tuple(s for s, _ in k)
                           for k in model.allowed_keys)))
+        # breaker admission runs AFTER validation, immediately before the
+        # queue: a request that can never execute (invalid shape, malformed
+        # payload) must not consume the half-open probe slot, or junk
+        # traffic could starve recovery indefinitely
+        decision = model.breaker.admit()
+        if decision == REJECT:
+            # fast retryable rejection: the breaker is open — no queueing,
+            # no batcher wakeup, no XLA call (the self-healing fast path)
+            model.stats.on_unavailable(rejected=True)
+            snap = model.breaker.snapshot()
+            return InferenceResult(
+                UNAVAILABLE, latency_ms=0.0,
+                error="circuit open after %d consecutive failure(s); "
+                      "retry in <= %.0f ms"
+                      % (snap["consecutive_failures"],
+                         snap["backoff_s"] * 1e3))
         if timeout_ms is None:
             timeout_ms = entry.default_timeout_ms
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
-        request = Request(inputs, deadline=deadline)
-        if not entry.batcher.submit(request):
+        request = Request(inputs, deadline=deadline, stats=model.stats)
+        admitted = entry.batcher.submit(request)
+        if admitted is not True:
+            if decision == PROBE:
+                # THIS request held the half-open probe slot and never
+                # reached the worker: hand the slot back (releasing
+                # unconditionally could cancel another request's live probe
+                # window and break the single-probe invariant)
+                model.breaker.release_probe()
+            if admitted == "stopping":
+                # the batcher itself reports WHY it refused, so exactly one
+                # outcome is counted: a shutdown refusal is UNAVAILABLE
+                # (counted here), a full queue already counted its shed
+                model.stats.on_unavailable(rejected=True)
+                return InferenceResult(UNAVAILABLE, latency_ms=0.0,
+                                       error="server shutting down")
             return InferenceResult(OVERLOADED, latency_ms=0.0,
                                    error="admission queue full")
         return request
@@ -195,8 +288,26 @@ class ModelServer:
         return self.result(name, handle)
 
     def result(self, name, request):
-        """Wait a submitted Request out and convert it to a result."""
-        entry = self._entry(name)
+        """Wait a submitted Request out and convert it to a result.
+
+        Safe against teardown races: if the model was unloaded (or the
+        server stopped) while the request was in flight, the batcher's
+        stop() has completed — or is about to complete — every queued
+        request with UNAVAILABLE, so this never hangs on a dead queue and
+        never raises KeyError; worst case it claims UNAVAILABLE itself
+        after a bounded wait, counting the terminal through the stats
+        handle the request carries (conservation survives teardown).  A
+        name that was NEVER loaded still raises the unknown-model error —
+        a typo must not clobber a live request on a healthy server."""
+        try:
+            entry = self._entry(name)
+        except MXNetError:
+            with self._lifecycle_lock:
+                known = name in self._ever_loaded
+            if not known and not self._is_closed():
+                raise
+            entry = None   # unloaded/closing mid-flight; see docstring
+        stats = entry.model.stats if entry is not None else request.stats
         if request.deadline is not None:
             request.wait(request.deadline - time.monotonic() + _WAIT_GRACE_S)
             # complete() is the atomic claim: if the worker's completion is
@@ -205,18 +316,35 @@ class ModelServer:
             # `status is None` pre-check could pair our TIMEOUT with the
             # worker's outputs
             if request.complete(TIMEOUT):
-                entry.model.stats.on_result(TIMEOUT, request.latency_ms)
-        else:
+                if stats is not None:
+                    stats.on_result(TIMEOUT, request.latency_ms)
+        elif entry is not None:
             request.wait()
+        else:
+            # no deadline and the model is gone: the teardown drain
+            # completes every queued request, but its batcher join can
+            # take up to its 5 s timeout with a wedged batch — wait that
+            # out before claiming UNAVAILABLE ourselves (counted through
+            # the carried stats so the admitted request still reaches
+            # exactly one terminal counter)
+            if not request.wait(_TEARDOWN_WAIT_S):
+                if request.complete(UNAVAILABLE,
+                                    error="server shutting down"):
+                    if stats is not None:
+                        stats.on_result(UNAVAILABLE, request.latency_ms)
         status, outputs, latency_ms, error = request.snapshot()
         return InferenceResult(status, outputs, latency_ms, error)
 
     # -- observability --------------------------------------------------
     def stats(self):
-        """Snapshot: per-model counters + compile-cache + warmup report."""
+        """Snapshot: per-model counters + compile-cache + warmup report +
+        health/breaker state (health.py)."""
         models = {}
         for name in self._registry.names():
-            model = self._registry.get(name)
+            try:
+                model = self._registry.get(name)
+            except MXNetError:
+                continue   # unloaded between names() and get()
             snap = model.stats.snapshot()
             cache = model.cache_stats()
             snap["cache"] = {"hits": cache["hits"],
@@ -224,12 +352,23 @@ class ModelServer:
                              "recompiles": cache["recompiles"],
                              "signatures": len(cache["signatures"])}
             snap["warmup"] = model.warmup_report
+            snap["health"] = model.breaker.health()
+            snap["breaker"] = model.breaker.snapshot()
+            # convenience alias; the breaker snapshot is the single source
+            snap["breaker_opens"] = snap["breaker"]["opens"]
             models[name] = snap
         return {"uptime_s": time.time() - self._t_start, "models": models}
 
+    def health(self, name):
+        """HEALTHY / DEGRADED / UNAVAILABLE for one model."""
+        return self._entry(name).model.breaker.health()
+
     # -- lifecycle ------------------------------------------------------
     def stop(self):
-        for name in list(self._entries):
+        with self._lifecycle_lock:
+            self._closed = True
+            names = list(self._entries)
+        for name in names:
             self.unload(name)
 
     def __enter__(self):
@@ -241,7 +380,8 @@ class ModelServer:
     # -- internals ------------------------------------------------------
     def _entry(self, name):
         self._registry.get(name)       # raises the helpful unknown-model error
-        entry = self._entries.get(name)
+        with self._lifecycle_lock:
+            entry = self._entries.get(name)
         if entry is None:
             # registry row exists but the entry doesn't: caller raced a
             # load/unload transition — a clean retryable error, not KeyError

@@ -55,6 +55,15 @@ class ModelStats:
         self.shed = 0            # rejected: queue full
         self.invalid = 0         # rejected: shape not in the bucket menu
         self.errors = 0
+        # UNAVAILABLE is split like shed/invalid vs the terminal counters:
+        # `unavailable` counts ADMITTED requests drained at teardown (they
+        # are part of `requests`, so conservation reads requests == ok +
+        # timeouts + errors + unavailable); `unavailable_rejected` counts
+        # fast admission rejections (breaker open / shutting down), which
+        # — like shed — never enter `requests`
+        self.unavailable = 0
+        self.unavailable_rejected = 0
+        self.retries = 0         # transient execute failures absorbed
         self.batches = 0
         self.batched_requests = 0   # real rows executed
         self.padded_rows = 0        # ladder pad rows executed
@@ -65,6 +74,11 @@ class ModelStats:
         self._c_queue = domain.new_counter("%s:queue_depth" % model_name)
         self._c_batch_ms = domain.new_counter("%s:batch_ms" % model_name)
         self._c_shed = domain.new_counter("%s:shed" % model_name)
+        # breaker/health on the same trace timeline: 0 closed, 1 half-open,
+        # 2 open — a dump shows exactly when the model went dark and came
+        # back, next to the queue-depth/batch-latency collapse that caused it
+        self._c_breaker = domain.new_counter("%s:breaker_state" % model_name)
+        self._c_unavail = domain.new_counter("%s:unavailable" % model_name)
 
     # -- event hooks ----------------------------------------------------
     def on_queue_depth(self, depth):
@@ -88,6 +102,33 @@ class ModelStats:
         with self._lock:
             self.invalid += 1
 
+    def on_unavailable(self, rejected=False):
+        """An UNAVAILABLE outcome.  ``rejected=True`` for fast admission
+        rejections (breaker open / shutting down — the request never
+        entered the queue); False for an admitted request terminated by
+        teardown."""
+        with self._lock:
+            if rejected:
+                self.unavailable_rejected += 1
+            else:
+                self.unavailable += 1
+            count = self.unavailable + self.unavailable_rejected
+        if profiler.profiling_active():
+            self._c_unavail.set_value(count)
+
+    def on_retry(self):
+        """One transient execute failure absorbed by the retry envelope."""
+        with self._lock:
+            self.retries += 1
+
+    def on_breaker_state(self, state):
+        """Emit a breaker transition onto the profiler timeline (the
+        authoritative open/rejection counts live in the breaker's own
+        snapshot — one source, no second copy to drift)."""
+        if profiler.profiling_active():
+            self._c_breaker.set_value(
+                {"closed": 0, "half_open": 1, "open": 2}.get(state, 0))
+
     def on_batch(self, n_real, bucket, latency_ms):
         with self._lock:
             self.batches += 1
@@ -98,7 +139,13 @@ class ModelStats:
             self._c_batch_ms.set_value(latency_ms)
 
     def on_result(self, status, latency_ms=None):
-        from .server import OK, TIMEOUT, ERROR
+        from .server import OK, TIMEOUT, ERROR, UNAVAILABLE
+        if status == UNAVAILABLE:
+            self.on_unavailable()
+            with self._lock:
+                if latency_ms is not None:
+                    self._req_lat.add(latency_ms)
+            return
         with self._lock:
             if status == OK:
                 self.ok += 1
@@ -120,6 +167,9 @@ class ModelStats:
                 "shed": self.shed,
                 "invalid": self.invalid,
                 "errors": self.errors,
+                "unavailable": self.unavailable,
+                "unavailable_rejected": self.unavailable_rejected,
+                "retries": self.retries,
                 "batches": self.batches,
                 "batched_requests": self.batched_requests,
                 "avg_batch": (self.batched_requests / self.batches

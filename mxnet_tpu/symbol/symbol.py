@@ -303,8 +303,10 @@ class Symbol:
                            "attrs": {"mxnet_version": ["int", 10300]}}, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # atomic (tmp + os.replace): a crash mid-save must not leave a torn
+        # -symbol.json next to a valid .params (docs/ROBUSTNESS.md)
+        from ..util import write_atomic
+        write_atomic(fname, self.tojson())
 
     # ------------------------------------------------------------------
     # evaluation / binding

@@ -22,7 +22,12 @@ from mxnet_tpu.serving.batcher import Request
 # ---------------------------------------------------------------------------
 
 def test_stress_smoke_25_seeds_zero_violations():
-    report = schedule.stress(seeds=schedule.SMOKE_SEEDS)
+    # the five concurrency scenarios; the fault-injection pair ("faults",
+    # "crash") has its own tier-1 gate in tests/test_faults.py so the two
+    # smokes stay independently budgeted
+    report = schedule.stress(seeds=schedule.SMOKE_SEEDS,
+                             scenarios=("serving", "registry", "cache",
+                                        "bulk", "feed"))
     flat = ["seed %s [%s] %s" % (seed, scen, v)
             for seed, per_seed in report["seeds"].items()
             for scen, violations in per_seed.items()

@@ -1,0 +1,254 @@
+"""Round-5 REG106 burn-down: optimizer-state kernels + samplers.
+
+Every op here was in the .mxlint-baseline.json REG106 untested set before
+this round; each test exercises the op against a reference so its baseline
+entry could be deleted (44 -> 30).  The framing matches this PR's
+crash-consistent checkpoint/resume work: the fused optimizer-update kernels
+are exactly the state that ``fit(auto_resume=True)`` must restore bit-exact
+(``rmsprop_update``/``rmspropalex_update``/``ftrl_update``/``ftml_update``/
+``signsgd_update``/``signum_update``/``mp_sgd_update``/``mp_sgd_mom_update``/
+``_sparse_adagrad_update``), and the parametric samplers
+(``_random_exponential``/``_random_poisson``/``_random_gamma``/
+``_random_negative_binomial``/``_random_generalized_negative_binomial``)
+are the framework-RNG streams whose reproducibility under ``mx.random.seed``
+makes chaos runs and resumed epochs replayable.
+
+Reference-semantics notes asserted below: signum folds weight decay into
+the momentum (optimizer_op-inl.h SignumKernel), ftrl thresholds on |z|
+against lamda1, sparse-adagrad keeps epsilon INSIDE the sqrt
+(AdagradDnsRspDnsKernel), and the mp_* multi-precision pair updates the
+fp32 master weights and casts back to the fp16 working copy.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _arr(values, dtype=np.float32):
+    return nd.array(np.asarray(values, dtype))
+
+
+_RNG = np.random.RandomState(7)
+_W = _RNG.randn(3, 4).astype(np.float32)
+_G = _RNG.randn(3, 4).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# optimizer-state kernels (two chained steps each: state must thread)
+# ---------------------------------------------------------------------------
+
+def test_rmsprop_update_matches_reference_math():
+    lr, gamma1, eps, wd = 0.05, 0.9, 1e-8, 0.01
+    w, n = _W.copy(), np.zeros_like(_W)
+    w_nd, n_nd = _arr(w), _arr(n)
+    for _ in range(2):
+        w_nd, n_nd = nd.rmsprop_update(w_nd, _arr(_G), n_nd, lr=lr,
+                                       gamma1=gamma1, epsilon=eps, wd=wd)
+        g = _G + wd * w
+        n = (1 - gamma1) * np.square(g) + gamma1 * n
+        w = w - lr * g / np.sqrt(n + eps)
+    np.testing.assert_allclose(w_nd.asnumpy(), w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(n_nd.asnumpy(), n, rtol=1e-5, atol=1e-6)
+
+
+def test_rmspropalex_update_centered_variant():
+    lr, gamma1, gamma2, eps = 0.05, 0.9, 0.85, 1e-8
+    w = _W.copy()
+    n = np.zeros_like(w)
+    g_st = np.zeros_like(w)
+    delta = np.zeros_like(w)
+    w_nd, n_nd, g_nd, d_nd = _arr(w), _arr(n), _arr(g_st), _arr(delta)
+    for _ in range(2):
+        w_nd, n_nd, g_nd, d_nd = nd.rmspropalex_update(
+            w_nd, _arr(_G), n_nd, g_nd, d_nd, lr=lr, gamma1=gamma1,
+            gamma2=gamma2, epsilon=eps, wd=0.0)
+        n = (1 - gamma1) * np.square(_G) + gamma1 * n
+        g_st = (1 - gamma1) * _G + gamma1 * g_st
+        delta = gamma2 * delta - lr * _G / np.sqrt(n - np.square(g_st) + eps)
+        w = w + delta
+    np.testing.assert_allclose(w_nd.asnumpy(), w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(d_nd.asnumpy(), delta, rtol=1e-5, atol=1e-6)
+
+
+def test_ftrl_update_sparsifies_small_weights():
+    lr, lamda1, beta = 0.1, 0.05, 1.0
+    w = _W.copy()
+    z = np.zeros_like(w)
+    n = np.zeros_like(w)
+    w_nd, z_nd, n_nd = _arr(w), _arr(z), _arr(n)
+    for _ in range(2):
+        w_nd, z_nd, n_nd = nd.ftrl_update(w_nd, _arr(_G), z_nd, n_nd, lr=lr,
+                                          lamda1=lamda1, beta=beta, wd=0.0)
+        sigma = (np.sqrt(n + np.square(_G)) - np.sqrt(n)) / lr
+        z = z + _G - sigma * w
+        n = n + np.square(_G)
+        w = np.where(np.abs(z) > lamda1,
+                     -(z - np.sign(z) * lamda1)
+                     / ((beta + np.sqrt(n)) / lr),
+                     0.0).astype(np.float32)
+    np.testing.assert_allclose(w_nd.asnumpy(), w, rtol=1e-5, atol=1e-6)
+    # the L1 threshold actually produces exact zeros where |z| <= lamda1
+    assert np.array_equal(w_nd.asnumpy() == 0.0, np.abs(z) <= lamda1)
+
+
+def test_signsgd_update_steps_by_sign_only():
+    lr = 0.125
+    out = nd.signsgd_update(_arr(_W), _arr(_G), lr=lr, wd=0.0)
+    np.testing.assert_allclose(out.asnumpy(), _W - lr * np.sign(_G),
+                               rtol=1e-6, atol=1e-7)
+    # magnitude of every step is exactly lr: gradient scale is discarded
+    big = nd.signsgd_update(_arr(_W), _arr(_G * 1e6), lr=lr, wd=0.0)
+    np.testing.assert_allclose(big.asnumpy(), out.asnumpy(),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_signum_update_folds_wd_into_momentum():
+    lr, momentum, wd = 0.1, 0.9, 0.05
+    w, m = _W.copy(), np.zeros_like(_W)
+    w_nd, m_nd = _arr(w), _arr(m)
+    for _ in range(2):
+        w_nd, m_nd = nd.signum_update(w_nd, _arr(_G), m_nd, lr=lr,
+                                      momentum=momentum, wd=wd)
+        # reference SignumKernel: wd decays THROUGH the momentum term
+        m = momentum * m - (1 - momentum) * wd * w - (1 - momentum) * _G
+        w = w + lr * np.sign(m)
+    np.testing.assert_allclose(w_nd.asnumpy(), w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m_nd.asnumpy(), m, rtol=1e-5, atol=1e-6)
+
+
+def test_ftml_update_with_traced_step_counter():
+    lr, beta1, beta2, eps = 0.05, 0.6, 0.999, 1e-8
+    w = _W.copy()
+    d = np.zeros_like(w)
+    v = np.zeros_like(w)
+    z = np.zeros_like(w)
+    w_nd, d_nd, v_nd, z_nd = _arr(w), _arr(d), _arr(v), _arr(z)
+    for t in (1, 2):   # t is a real per-step input (dynamic attr)
+        w_nd, d_nd, v_nd, z_nd = nd.ftml_update(
+            w_nd, _arr(_G), d_nd, v_nd, z_nd, lr=lr, beta1=beta1,
+            beta2=beta2, epsilon=eps, t=t, wd=0.0)
+        v = beta2 * v + (1 - beta2) * np.square(_G)
+        d_new = (1 - beta1 ** t) / lr * (np.sqrt(v / (1 - beta2 ** t)) + eps)
+        sigma = d_new - beta1 * d
+        z = beta1 * z + (1 - beta1) * _G - sigma * w
+        d = d_new
+        w = -z / d
+    np.testing.assert_allclose(w_nd.asnumpy(), w, rtol=1e-5, atol=1e-6)
+
+
+def test_mp_sgd_update_keeps_fp32_master_weights():
+    lr = 0.1
+    w16 = _W.astype(np.float16)
+    w32 = _W.copy()
+    g16 = _G.astype(np.float16)
+    w_nd = nd.array(w16, dtype=np.float16)
+    w32_nd = _arr(w32)
+    for _ in range(2):
+        w_nd, w32_nd = nd.mp_sgd_update(w_nd, nd.array(g16, dtype=np.float16),
+                                        w32_nd, lr=lr, wd=0.0)
+        w32 = w32 - lr * g16.astype(np.float32)
+    assert w_nd.asnumpy().dtype == np.float16
+    np.testing.assert_allclose(w32_nd.asnumpy(), w32, rtol=1e-6, atol=1e-7)
+    # the fp16 copy is the CAST of the master, not an independently
+    # accumulated fp16 value (multi-precision contract)
+    np.testing.assert_array_equal(w_nd.asnumpy(), w32.astype(np.float16))
+
+
+def test_mp_sgd_mom_update_momentum_in_fp32():
+    lr, momentum = 0.1, 0.9
+    w32 = _W.copy()
+    mom = np.zeros_like(w32)
+    g16 = _G.astype(np.float16)
+    w_nd = nd.array(w32.astype(np.float16), dtype=np.float16)
+    m_nd = _arr(mom)
+    w32_nd = _arr(w32)
+    for _ in range(2):
+        w_nd, m_nd, w32_nd = nd.mp_sgd_mom_update(
+            w_nd, nd.array(g16, dtype=np.float16), m_nd, w32_nd,
+            lr=lr, momentum=momentum, wd=0.0)
+        mom = momentum * mom - lr * g16.astype(np.float32)
+        w32 = w32 + mom
+    assert w_nd.asnumpy().dtype == np.float16
+    np.testing.assert_allclose(w32_nd.asnumpy(), w32, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(m_nd.asnumpy(), mom, rtol=1e-6, atol=1e-7)
+
+
+def test_sparse_adagrad_update_epsilon_inside_sqrt():
+    lr, eps = 0.1, 1e-7
+    w, h = _W.copy(), np.zeros_like(_W)
+    w_nd, h_nd = _arr(w), _arr(h)
+    for _ in range(2):
+        w_nd, h_nd = nd._sparse_adagrad_update(w_nd, _arr(_G), h_nd, lr=lr,
+                                               epsilon=eps, wd=0.0)
+        h = h + np.square(_G)
+        # reference AdagradDnsRspDnsKernel: sqrt(h + eps), not sqrt(h)+eps
+        w = w - lr * _G / np.sqrt(h + eps)
+    np.testing.assert_allclose(w_nd.asnumpy(), w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_nd.asnumpy(), h, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# parametric samplers: framework-RNG stream, seeded reproducibility
+# ---------------------------------------------------------------------------
+
+def _seeded_draw(op, **attrs):
+    mx.random.seed(321)
+    return op(shape=(4000,), **attrs).asnumpy()
+
+
+def test_random_exponential_rate_and_reproducibility():
+    lam = 2.5
+    a = _seeded_draw(nd._random_exponential, lam=lam)
+    b = _seeded_draw(nd._random_exponential, lam=lam)
+    np.testing.assert_array_equal(a, b)   # same seed, same stream
+    assert a.shape == (4000,) and a.dtype == np.float32
+    assert np.all(a >= 0)
+    np.testing.assert_allclose(a.mean(), 1.0 / lam, rtol=0.1)
+
+
+def test_random_poisson_counts():
+    lam = 4.0
+    a = _seeded_draw(nd._random_poisson, lam=lam)
+    b = _seeded_draw(nd._random_poisson, lam=lam)
+    np.testing.assert_array_equal(a, b)
+    assert np.all(a >= 0) and np.all(a == np.round(a))   # integer counts
+    np.testing.assert_allclose(a.mean(), lam, rtol=0.1)
+    np.testing.assert_allclose(a.var(), lam, rtol=0.2)
+
+
+def test_random_gamma_shape_scale():
+    alpha, beta = 3.0, 2.0   # mean = alpha*beta, var = alpha*beta^2
+    a = _seeded_draw(nd._random_gamma, alpha=alpha, beta=beta)
+    b = _seeded_draw(nd._random_gamma, alpha=alpha, beta=beta)
+    np.testing.assert_array_equal(a, b)
+    assert np.all(a > 0)
+    np.testing.assert_allclose(a.mean(), alpha * beta, rtol=0.1)
+    np.testing.assert_allclose(a.var(), alpha * beta ** 2, rtol=0.25)
+
+
+def test_random_negative_binomial_moments():
+    k, p = 5.0, 0.4   # mean = k(1-p)/p, var = k(1-p)/p^2
+    a = _seeded_draw(nd._random_negative_binomial, k=k, p=p)
+    b = _seeded_draw(nd._random_negative_binomial, k=k, p=p)
+    np.testing.assert_array_equal(a, b)
+    assert np.all(a >= 0) and np.all(a == np.round(a))
+    np.testing.assert_allclose(a.mean(), k * (1 - p) / p, rtol=0.1)
+    np.testing.assert_allclose(a.var(), k * (1 - p) / p ** 2, rtol=0.25)
+
+
+def test_random_generalized_negative_binomial_mu_alpha():
+    mu, alpha = 3.0, 0.4   # mean = mu, var = mu + alpha*mu^2
+    a = _seeded_draw(nd._random_generalized_negative_binomial,
+                     mu=mu, alpha=alpha)
+    b = _seeded_draw(nd._random_generalized_negative_binomial,
+                     mu=mu, alpha=alpha)
+    np.testing.assert_array_equal(a, b)
+    assert np.all(a >= 0) and np.all(a == np.round(a))
+    np.testing.assert_allclose(a.mean(), mu, rtol=0.1)
+    np.testing.assert_allclose(a.var(), mu + alpha * mu ** 2, rtol=0.25)
+    # different seeds give different draws (the stream is really seeded)
+    mx.random.seed(9)
+    c = nd._random_generalized_negative_binomial(
+        shape=(4000,), mu=mu, alpha=alpha).asnumpy()
+    assert not np.array_equal(a, c)

@@ -345,6 +345,84 @@ def test_multi_input_model_batches_all_inputs():
 
 
 # ---------------------------------------------------------------------------
+# lifecycle: shutdown with requests in flight (docs/ROBUSTNESS.md)
+# ---------------------------------------------------------------------------
+
+def test_shutdown_during_inflight_requests_is_clean_unavailable():
+    """Requests caught by server.stop() terminate with the retryable
+    UNAVAILABLE status — nobody hangs on a dead batcher queue, nothing
+    raises KeyError, and post-stop calls get the same clean status."""
+    net = _make_net()
+    server = serving.ModelServer()
+    model = server.load_model("m", net, input_shapes=[(4, 8)], max_batch=4,
+                              max_queue=64, linger_ms=1.0, warmup=True)
+    # pause dispatch so submitted requests are guaranteed still queued
+    # when stop() lands
+    server.pause("m")
+    x = np.ones((4, 8), np.float32)
+    handles = [server.predict_async("m", x) for _ in range(6)]
+    assert all(not isinstance(h, serving.InferenceResult) for h in handles)
+
+    resolved = {}
+    threads = []
+
+    def waiter(i, h):
+        resolved[i] = server.result("m", h)
+
+    for i, h in enumerate(handles[:3]):   # some clients already waiting...
+        t = threading.Thread(target=waiter, args=(i, h))
+        t.start()
+        threads.append(t)
+    time.sleep(0.05)
+    server.stop()                          # ...when the server goes down
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "result() hung across shutdown"
+    for i, h in enumerate(handles[3:], start=3):   # ...and some after
+        resolved[i] = server.result("m", h)
+    assert len(resolved) == len(handles)
+    for i, res in resolved.items():
+        assert res.status == serving.UNAVAILABLE, (i, res)
+        assert res.outputs is None
+    # post-stop predict: clean terminal status, not an exception
+    res = server.predict("m", x, timeout_ms=50)
+    assert res.status == serving.UNAVAILABLE
+    # teardown accounting conserves: every ADMITTED request reached exactly
+    # one terminal counter — the drained ones land in `unavailable`, so
+    # requests == ok + timeouts + errors + unavailable holds across stop()
+    snap = model.stats.snapshot()
+    assert snap["requests"] == len(handles)
+    assert snap["unavailable"] == len(handles)
+    assert snap["requests"] == (snap["ok"] + snap["timeouts"]
+                                + snap["errors"] + snap["unavailable"])
+
+
+def test_result_with_never_loaded_name_raises_not_clobbers():
+    """A typo'd model name in result() must raise the unknown-model error —
+    not silently claim a live request UNAVAILABLE on a healthy server."""
+    net = _make_net()
+    server = serving.ModelServer()
+    server.load_model("m", net, input_shapes=[(4, 8)], max_batch=4,
+                      linger_ms=1.0, warmup=True)
+    try:
+        handle = server.predict_async("m", np.ones((4, 8), np.float32))
+        with pytest.raises(mx.MXNetError):
+            server.result("nope", handle)
+        # the request itself is untouched and resolves normally
+        res = server.result("m", handle)
+        assert res.status == serving.OK
+    finally:
+        server.stop()
+
+
+def test_stopped_server_refuses_new_loads():
+    server = serving.ModelServer()
+    server.stop()
+    with pytest.raises(mx.MXNetError):
+        server.load_model("m", _make_net(), input_shapes=[(4, 8)])
+
+
+# ---------------------------------------------------------------------------
 # serve_bench smoke (the tier-1 wiring for tools/serve_bench.py)
 # ---------------------------------------------------------------------------
 

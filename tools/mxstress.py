@@ -7,7 +7,13 @@ batcher, registry load/unload, CachedOp cache-stats, engine.bulk, and
 DeviceFeed input-pipeline paths, and an invariant suite (no lost requests
 or batches, no torn results, monotonic counters, zero steady-state
 recompiles, clean mid-epoch shutdown, no deadlock) must hold under every
-seed.  Exit code is non-zero iff any seed violated any invariant.
+seed.  The ``faults`` and ``crash`` scenarios add seeded FAILURE injection
+on top (mxnet_tpu.faults; docs/ROBUSTNESS.md): serving storms under
+transient/fatal predict faults (request conservation incl. UNAVAILABLE,
+breaker opens and re-closes) and checkpoint saves killed at every write/
+replace/manifest fault point (restore always finds the newest complete
+checkpoint, bit-exact).  Exit code is non-zero iff any seed violated any
+invariant.
 
 Usage:
   python tools/mxstress.py --smoke              # 25 fixed seeds, <=10 s
