@@ -40,17 +40,20 @@ IMG = int(os.environ.get("BENCH_IMG", "224"))
 # | pipeline (END-TO-END input pipeline: synthetic decode -> DataLoader ->
 # DeviceFeed -> fused train step; reports e2e vs compute-only img/s and
 # overlap efficiency — tools/input_bench.py, artifact BENCH_PIPELINE.json)
+# | fused_fit (compiled fit() vs eager fit() end-to-end: the default
+# CompiledTrainStep path — tools/fit_bench.py, artifact BENCH_FUSED_FIT.json)
 MODE = os.environ.get("BENCH_MODE", "train")
 # BENCH_LAYOUT=auto (default: measure NCHW first, then NHWC, report the
 # faster — settles SURVEY §7(f) with data in every driver capture) |
 # NCHW (reference layout) | NHWC (channels-last only)
 LAYOUT = os.environ.get("BENCH_LAYOUT", "auto").upper()
-if MODE not in ("train", "inference", "transformer", "int8", "pipeline"):
+if MODE not in ("train", "inference", "transformer", "int8", "pipeline",
+                "fused_fit"):
     # still honor the one-JSON-line-on-stdout contract
     print(json.dumps({"metric": "invalid_bench_mode", "value": None,
                       "unit": None, "vs_baseline": None,
-                      "error": "unknown BENCH_MODE=%r "
-                               "(train|inference|transformer|int8|pipeline)"
+                      "error": "unknown BENCH_MODE=%r (train|inference|"
+                               "transformer|int8|pipeline|fused_fit)"
                                % MODE}))
     sys.exit(1)
 if LAYOUT not in ("AUTO", "NCHW", "NHWC"):
@@ -78,6 +81,11 @@ elif MODE == "pipeline":
     # BENCH_PIPELINE.json the artifact (config via BENCH_PIPE_*)
     METRIC = ("pipeline_train_imgs_per_sec_bs%s"
               % os.environ.get("BENCH_PIPE_BATCH", "32"))
+elif MODE == "fused_fit":
+    # compiled-vs-eager fit(): tools/fit_bench.py, BENCH_FUSED_FIT.json
+    # artifact (config via BENCH_FIT_*)
+    METRIC = ("fused_fit_imgs_per_sec_bs%s"
+              % os.environ.get("BENCH_FIT_BATCH", "32"))
 else:
     _KIND = "train" if MODE == "train" else "infer"
     METRIC = ("resnet50_%s_imgs_per_sec_bs%d" % (_KIND, BATCH) if IS_HEADLINE
@@ -473,6 +481,12 @@ def main():
         import input_bench
         input_bench.run(out_path=os.path.join(repo, "BENCH_PIPELINE.json"))
         return
+    if MODE == "fused_fit":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import fit_bench
+        fit_bench.run(out_path=os.path.join(repo, "BENCH_FUSED_FIT.json"))
+        return
 
     layouts = ("NCHW", "NHWC") if LAYOUT == "AUTO" else (LAYOUT,)
     results = {}
@@ -535,6 +549,44 @@ def _probe_backend(timeout_s):
     return None
 
 
+def _fail_artifact_path():
+    return os.environ.get(
+        "BENCH_FAIL_ARTIFACT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_FAILURE.json"))
+
+
+def _write_fail_artifact(record):
+    """Persist the structured failure record (BENCH_FAILURE.json).
+
+    BENCH_r05 burned its whole budget on 13 failed probes and left only
+    log lines behind; the artifact makes a down relay diagnosable offline:
+    probe/attempt counts, the last error, and the last platform string any
+    probe reported."""
+    try:
+        with open(_fail_artifact_path(), "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    except OSError as exc:
+        print("could not write failure artifact: %s" % exc, file=sys.stderr)
+
+
+def _clear_fail_artifact():
+    """A successful run removes any stale failure artifact so the pair of
+    files can't tell contradictory stories."""
+    try:
+        os.remove(_fail_artifact_path())
+    except OSError:
+        pass
+
+
+# probe-failure log discipline: a relay that stays down for the whole
+# budget would otherwise print one line per probe (13 lines in BENCH_r05);
+# log the first few, then only every LOG_EVERYth
+_PROBE_LOG_HEAD = 5
+_PROBE_LOG_EVERY = 5
+
+
 def _watchdog():
     """Run the benchmark in a child process under a budgeted retry loop.
 
@@ -544,8 +596,12 @@ def _watchdog():
     separate process.  Round 2 lost its number to a single 1500 s hang with
     no retry; now a ~30 s probe gates each attempt, so a down relay costs a
     probe + backoff (not a full attempt timeout), and retries continue until
-    BENCH_BUDGET is spent.  The parent ALWAYS prints exactly one JSON line
-    on stdout."""
+    BENCH_BUDGET is spent.  The backoff is jittered (round-5 hardening:
+    synchronized drivers re-probing a recovering relay in lockstep can keep
+    knocking it over), repeated probe-failure log lines are capped, and a
+    spent budget always leaves a structured BENCH_FAILURE.json behind.  The
+    parent ALWAYS prints exactly one JSON line on stdout."""
+    import random
     import subprocess
 
     budget_s = float(os.environ.get("BENCH_BUDGET", "1400"))
@@ -566,6 +622,7 @@ def _watchdog():
 
     probes = failed_probes = attempts = 0
     last_err = "no attempt made"
+    last_platform = None
     backoff = delay
     while attempts < max_attempts:
         if remaining() < probe_timeout + min_attempt_s:
@@ -576,12 +633,21 @@ def _watchdog():
             failed_probes += 1
             last_err = ("backend probe hung/failed (relay down?), "
                         "%d/%d probes failed" % (failed_probes, probes))
-            print("probe %d failed; backing off %gs" % (probes, backoff),
-                  file=sys.stderr)
-            time.sleep(min(backoff, max(remaining(), 0)))
+            # jitter (0.5x-1.5x) decorrelates retry storms across drivers
+            sleep_s = min(backoff * random.uniform(0.5, 1.5),
+                          max(remaining(), 0))
+            if failed_probes <= _PROBE_LOG_HEAD or \
+                    failed_probes % _PROBE_LOG_EVERY == 0:
+                print("probe %d failed; backing off %.1fs%s"
+                      % (probes, sleep_s,
+                         "" if failed_probes <= _PROBE_LOG_HEAD else
+                         " (logging every %d)" % _PROBE_LOG_EVERY),
+                      file=sys.stderr)
+            time.sleep(sleep_s)
             backoff = min(backoff * 2, 60)
             continue
         backoff = delay
+        last_platform = platform
         print("probe ok (%s); starting attempt" % platform, file=sys.stderr)
         if remaining() < min_attempt_s:
             break
@@ -610,6 +676,7 @@ def _watchdog():
                 except ValueError:
                     continue
                 if parsed.get("value") is not None:
+                    _clear_fail_artifact()
                     print(line)
                     return 0
                 last_err = parsed.get("error", "child reported no value")
@@ -622,11 +689,38 @@ def _watchdog():
         if remaining() > delay:
             time.sleep(delay)
     elapsed = time.monotonic() - t_start
+    # a prior run's committed success artifact may still sit next to this
+    # failure record; cross-reference it (path + mtime) so an offline
+    # reader can tell which story is current instead of guessing
+    stale = None
+    for name in ("BENCH_FUSED_FIT.json", "BENCH_PIPELINE.json",
+                 "BENCH_LIVE.json"):
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+        if os.path.exists(path):
+            stale = {"path": name,
+                     "mtime": round(os.path.getmtime(path), 1)}
+            break
+    _write_fail_artifact({
+        "ts": round(time.time(), 1),
+        "stale_success_artifact": stale,
+        "metric": METRIC,
+        "value": None,
+        "unit": "tokens/sec" if MODE == "transformer" else "images/sec",
+        "vs_baseline": None,
+        "mode": MODE,
+        "error": last_err,
+        "probes": probes,
+        "failed_probes": failed_probes,
+        "attempts": attempts,
+        "platform": last_platform,
+        "budget_s": budget_s,
+        "elapsed_s": round(elapsed, 1),
+    })
     print(_error_line(
         "%d attempt(s), %d probe(s) (%d failed) over %.0fs; last: %s"
         % (attempts, probes, failed_probes, elapsed, last_err),
         attempts=attempts, probes=probes, failed_probes=failed_probes,
-        elapsed_s=round(elapsed, 1)))
+        platform=last_platform, elapsed_s=round(elapsed, 1)))
     return 1
 
 

@@ -170,7 +170,20 @@ class CachedOp:
         fn = self._jitted.get(training)
         if fn is None:
             import jax
-            fn = jax.jit(self._make_lowerable(training))
+            kwargs = {}
+            if self._flags.get("donate_params"):
+                # donate the aux-listed parameter buffers: every aux entry is
+                # written back after the call (its input buffer is dead the
+                # moment the XLA program consumes it), so XLA may alias the
+                # input allocation to the matching output — in-place
+                # param/momentum update at the buffer level, the analog of
+                # the reference's shared-memory-pool trick
+                # (graph_executor.cc:927).  Non-aux params are NOT donated:
+                # their handles keep pointing at the input buffer.
+                aux = set(self._aux_names)
+                kwargs["donate_argnums"] = tuple(
+                    i for i, n in enumerate(self._param_names) if n in aux)
+            fn = jax.jit(self._make_lowerable(training), **kwargs)
             self._jitted[training] = fn
         return fn
 
@@ -199,6 +212,13 @@ class CachedOp:
 
         training = autograd.is_training()
         recording = autograd.is_recording()
+        if recording and self._flags.get("donate_params"):
+            # the recorded vjp replays the saved input values at backward
+            # time, but donation has already invalidated those buffers
+            raise MXNetError(
+                "CachedOp(donate_params=True) cannot run under "
+                "autograd.record(): donated input buffers are dead by "
+                "backward time — rebuild without donation to record")
         param_handles = [param_dict[n] for n in self._param_names]
         param_vals = [p._data for p in param_handles]
         input_vals = [x._data for x in inputs]

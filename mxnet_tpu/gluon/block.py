@@ -586,6 +586,21 @@ def functional_call(block, param_vals, *input_vals, training=False, rng_key=None
     return tuple(o._data for o in outs), aux
 
 
+def split_param_names(block):
+    """(trainable, frozen) parameter-name split for whole-block capture.
+
+    ``frozen`` is every ``grad_req == 'null'`` parameter (BatchNorm running
+    stats and explicitly frozen weights): whole-program train steps
+    (module.compiled_step, bench.py) thread those through the trace
+    unchanged/functionally while differentiating only the trainable set.
+    Both lists are sorted for a stable trace signature."""
+    params = block.collect_params()
+    frozen = sorted(n for n, p in params.items() if p.grad_req == "null")
+    frozen_set = set(frozen)
+    train = sorted(n for n in params if n not in frozen_set)
+    return train, frozen
+
+
 def param_values(block, dtype=None):
     """Extract {name: jax array} from an initialized Block."""
     import jax.numpy as jnp

@@ -100,6 +100,24 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError()
 
+    # --- device-side accumulation (compiled train step) -------------------
+    # ``traced_update(label_vals, pred_vals) -> (stat, count)`` is the
+    # jax-traceable twin of update(): it computes this batch's (sum_metric,
+    # num_inst) DELTA from raw jax values, so the compiled fit path can
+    # accumulate metrics on-device and fetch them only at metric_interval
+    # boundaries (module/compiled_step.py).  None means "no device twin":
+    # fit(compiled=...) falls back to the eager loop for such metrics.
+    traced_update = None
+
+    def supports_device_update(self):
+        return callable(getattr(self, "traced_update", None))
+
+    def _device_accumulate(self, stat, count):
+        """Fold a fetched on-device (stat, count) delta into the metric —
+        the host half of the traced_update contract."""
+        self.sum_metric += float(stat)
+        self.num_inst += int(round(float(count)))
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
@@ -132,6 +150,27 @@ class _ScalarMetric(EvalMetric):
 
     def _batch_stat(self, label, pred):
         raise NotImplementedError()
+
+    # device twin of _batch_stat, over jax values; None = unsupported
+    traced_batch_stat = None
+
+    def supports_device_update(self):
+        return getattr(type(self), "traced_batch_stat", None) is not None
+
+    def traced_update(self, label_vals, pred_vals):
+        """Sum traced_batch_stat over (label, pred) pairs (jax-traceable)."""
+        import jax.numpy as jnp
+        if len(label_vals) != len(pred_vals):
+            raise ValueError("Shape of labels %d does not match shape of "
+                             "predictions %d" % (len(label_vals),
+                                                 len(pred_vals)))
+        stat = jnp.float32(0.0)
+        count = jnp.float32(0.0)
+        for label, pred in zip(label_vals, pred_vals):
+            s, c = self.traced_batch_stat(label, pred)
+            stat = stat + jnp.asarray(s, jnp.float32)
+            count = count + jnp.asarray(c, jnp.float32)
+        return stat, count
 
 
 def create(metric, *args, **kwargs):
@@ -221,6 +260,14 @@ class Accuracy(_ScalarMetric):
             self.sum_metric += int(hits.sum())
             self.num_inst += hits.size
 
+    def traced_batch_stat(self, label, pred):
+        import jax.numpy as jnp
+        if pred.shape != label.shape:
+            pred = jnp.argmax(pred, axis=self.axis)
+        hits = (pred.astype(jnp.int32).ravel()
+                == label.astype(jnp.int32).ravel())
+        return jnp.sum(hits).astype(jnp.float32), float(hits.size)
+
 
 @_alias("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(_ScalarMetric):
@@ -252,6 +299,17 @@ class TopKAccuracy(_ScalarMetric):
             top = numpy.argpartition(pred.astype("float32"), -k, axis=1)[:, -k:]
         member = (top == label.astype("int64")[:, None]).any(axis=1)
         return int(member.sum()), label.shape[0]
+
+    def traced_batch_stat(self, label, pred):
+        import jax
+        import jax.numpy as jnp
+        if pred.ndim == 1:
+            hits = jnp.sum(pred.astype(jnp.int64) == label.astype(jnp.int64))
+            return hits.astype(jnp.float32), float(label.shape[0])
+        k = min(self.top_k, pred.shape[1])
+        _, top = jax.lax.top_k(pred.astype(jnp.float32), k)
+        member = jnp.any(top == label.astype(jnp.int32)[:, None], axis=1)
+        return jnp.sum(member).astype(jnp.float32), float(label.shape[0])
 
 
 class _ConfusionCounts:
@@ -410,6 +468,23 @@ class Perplexity(EvalMetric):
                 -numpy.log(numpy.maximum(picked[keep], 1e-10)).sum())
             self.num_inst += int(keep.sum())
 
+    def traced_update(self, label_vals, pred_vals):
+        import jax.numpy as jnp
+        stat = jnp.float32(0.0)
+        count = jnp.float32(0.0)
+        for label, pred in zip(label_vals, pred_vals):
+            flat = label.ravel().astype(jnp.int32)
+            picked = jnp.take_along_axis(
+                pred.reshape(-1, pred.shape[-1]),
+                flat[:, None], axis=self.axis)[:, 0]
+            keep = jnp.ones_like(picked, dtype=bool) \
+                if self.ignore_label is None \
+                else flat != int(self.ignore_label)
+            stat = stat - jnp.sum(
+                jnp.where(keep, jnp.log(jnp.maximum(picked, 1e-10)), 0.0))
+            count = count + jnp.sum(keep).astype(jnp.float32)
+        return stat, count
+
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
@@ -429,6 +504,10 @@ class MAE(_ScalarMetric):
     def _batch_stat(self, label, pred):
         return numpy.abs(_as_2d(label) - _as_2d(pred)).mean(), 1
 
+    def traced_batch_stat(self, label, pred):
+        import jax.numpy as jnp
+        return jnp.mean(jnp.abs(_as_2d(label) - _as_2d(pred))), 1.0
+
 
 @register
 class MSE(_ScalarMetric):
@@ -439,6 +518,10 @@ class MSE(_ScalarMetric):
     def _batch_stat(self, label, pred):
         return numpy.square(_as_2d(label) - _as_2d(pred)).mean(), 1
 
+    def traced_batch_stat(self, label, pred):
+        import jax.numpy as jnp
+        return jnp.mean(jnp.square(_as_2d(label) - _as_2d(pred))), 1.0
+
 
 @register
 class RMSE(_ScalarMetric):
@@ -448,6 +531,10 @@ class RMSE(_ScalarMetric):
 
     def _batch_stat(self, label, pred):
         return math.sqrt(numpy.square(_as_2d(label) - _as_2d(pred)).mean()), 1
+
+    def traced_batch_stat(self, label, pred):
+        import jax.numpy as jnp
+        return jnp.sqrt(jnp.mean(jnp.square(_as_2d(label) - _as_2d(pred)))), 1.0
 
 
 class _LabelProbMetric(_ScalarMetric):
@@ -465,6 +552,14 @@ class _LabelProbMetric(_ScalarMetric):
             raise AssertionError((idx.shape[0], pred.shape[0]))
         p_label = pred[numpy.arange(pred.shape[0]), idx]
         return float(-numpy.log(p_label + self.eps).sum()), pred.shape[0]
+
+    def traced_batch_stat(self, label, pred):
+        import jax.numpy as jnp
+        idx = label.ravel().astype(jnp.int32)
+        if idx.shape[0] != pred.shape[0]:
+            raise AssertionError((idx.shape[0], pred.shape[0]))
+        p_label = jnp.take_along_axis(pred, idx[:, None], axis=1)[:, 0]
+        return -jnp.sum(jnp.log(p_label + self.eps)), float(pred.shape[0])
 
 
 @_alias("ce")
@@ -510,6 +605,15 @@ class Loss(EvalMetric):
         for pred in preds:
             self.sum_metric += float(ndarray.sum(pred).asscalar())
             self.num_inst += pred.size
+
+    def traced_update(self, label_vals, pred_vals):
+        import jax.numpy as jnp
+        stat = jnp.float32(0.0)
+        count = 0.0
+        for pred in pred_vals:
+            stat = stat + jnp.sum(pred).astype(jnp.float32)
+            count += float(pred.size)
+        return stat, jnp.float32(count)
 
 
 @register

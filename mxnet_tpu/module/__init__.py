@@ -4,3 +4,4 @@ from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule, PythonLossModule
 from .executor_group import DataParallelExecutorGroup
+from .compiled_step import CompiledTrainStep, CompiledStepUnsupported

@@ -145,8 +145,24 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
             sparse_row_id_fn=None, prefetch_to_device=None,
-            resume_from=None, auto_resume=False):
+            resume_from=None, auto_resume=False, compiled=None,
+            steps_per_call=1, metric_interval=None, donate="auto"):
         """Train the module (reference base_module.py:410).
+
+        Compiled training (default ON, docs/PERF.md "Compiled training
+        step"): ``compiled=None``/``True`` captures forward + backward +
+        optimizer update as ONE CachedOp via
+        :class:`~mxnet_tpu.module.compiled_step.CompiledTrainStep` —
+        params/optimizer state update in place on device, metrics accumulate
+        on-device, and the host fetches them only every ``metric_interval``
+        batches (``None`` = at epoch end only), so the per-batch host
+        barrier of the eager loop is gone.  ``steps_per_call=N`` scans a
+        window of N batches per dispatch.  Configurations the capture cannot
+        express (multi-context binds, kvstore updates, non-trace_safe
+        optimizers, metrics with no device twin, monitors) fall back to the
+        eager loop with a one-line warning; ``compiled=False`` forces eager.
+        Under the compiled path, callbacks observe metric values that lag by
+        up to ``metric_interval`` batches.
 
         ``prefetch_to_device`` (a Context) routes each epoch's batches
         through an ``io.DeviceFeed``: a background thread stays up to two
@@ -233,6 +249,28 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        compiled_step = None
+        if compiled is None or compiled:
+            from .compiled_step import (CompiledTrainStep,
+                                        CompiledStepUnsupported)
+            reason = None
+            if monitor is not None:
+                reason = "a monitor needs per-op eager dispatch"
+            elif sparse_row_id_fn is not None:
+                reason = "sparse_row_id_fn prefetch is an eager-loop hook"
+            else:
+                try:
+                    compiled_step = CompiledTrainStep.from_module(
+                        self, eval_metric=eval_metric,
+                        steps_per_call=steps_per_call, donate=donate)
+                except CompiledStepUnsupported as exc:
+                    reason = str(exc)
+            if compiled_step is None:
+                self.logger.warning(
+                    "fit(compiled=%s): falling back to the eager loop: %s",
+                    compiled, reason)
+        self._compiled_step = compiled_step
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -246,8 +284,14 @@ class BaseModule:
             else:
                 batches = iter(train_data)
             try:
-                data_batch = next(batches, _NO_BATCH)
-                nbatch = 0
+                if compiled_step is not None:
+                    nbatch, eval_name_vals = self._fit_compiled_epoch(
+                        compiled_step, batches, eval_metric, epoch,
+                        batch_end_callback, metric_interval)
+                    data_batch = _NO_BATCH
+                else:
+                    data_batch = next(batches, _NO_BATCH)
+                    nbatch = 0
                 while data_batch is not _NO_BATCH:
                     if monitor is not None:
                         monitor.tic()
@@ -282,7 +326,14 @@ class BaseModule:
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
             arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+            if compiled_step is None:
+                # multi-device sync-back: each replica gets the averaged
+                # params.  The compiled path is single-device and its state
+                # handles ARE the canonical buffers — writing the same
+                # values back would only swap committed jit-output buffers
+                # for fresh copies and silently flip the step's jit cache
+                # key (one stealth recompile per epoch).
+                self.set_params(arg_params_, aux_params_)
             _fire(epoch_end_callback, epoch, self.symbol, arg_params_, aux_params_)
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
@@ -292,6 +343,49 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
             train_data.reset()
+
+    def _fit_compiled_epoch(self, cstep, batches, eval_metric, epoch,
+                            batch_end_callback, metric_interval):
+        """One epoch through the compiled train step (docs/PERF.md).
+
+        Batches group into windows of ``cstep.steps_per_call`` (the epoch
+        tail dispatches as a shorter window — one extra compiled signature,
+        stable across epochs); each window is ONE CachedOp dispatch with no
+        host fetch.  Metrics sync from the device accumulators only every
+        ``metric_interval`` batches and at epoch end, so callbacks observe
+        values that lag up to one interval."""
+        nbatch = 0
+        eval_name_vals = []
+        window = []
+        data_batch = next(batches, _NO_BATCH)
+        while data_batch is not _NO_BATCH:
+            if isinstance(data_batch, list):
+                raise ValueError("pre-sliced multi-device batches reach the "
+                                 "compiled path only through a bug: "
+                                 "multi-context binds fall back to eager")
+            window.append(data_batch)
+            upcoming = next(batches, _NO_BATCH)
+            if len(window) == cstep.steps_per_call or upcoming is _NO_BATCH:
+                cstep.run_window([tuple(b.data) + tuple(b.label or ())
+                                  for b in window])
+                last_in_epoch = upcoming is _NO_BATCH
+                for i in range(len(window)):
+                    done = nbatch + 1
+                    is_final = last_in_epoch and i == len(window) - 1
+                    if is_final or (metric_interval
+                                    and done % metric_interval == 0):
+                        cstep.sync_metric()
+                    if is_final:
+                        # snapshot before callbacks may auto-reset the metric
+                        eval_name_vals = eval_metric.get_name_value()
+                    _fire(batch_end_callback,
+                          BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                        eval_metric=eval_metric,
+                                        locals=locals()))
+                    nbatch = done
+                window = []
+            data_batch = upcoming
+        return nbatch, eval_name_vals
 
     # ------------------------------------------------------------------
     # abstract interface

@@ -1,0 +1,566 @@
+"""CompiledTrainStep: the whole training iteration as ONE CachedOp.
+
+The eager ``fit()`` loop dispatches forward, backward, one optimizer-update
+kernel per parameter, and a metric fetch — with a host sync on every batch.
+The live TPU capture (BENCH_LIVE.json) shows what that costs: ResNet-50 at
+MFU 0.178, the hardware ~5x underused.  This module promotes the fused step
+that tools/input_bench.py proved in miniature (one XLA module per iteration,
+1.56x end-to-end) to a first-class citizen of the module layer — the
+"compile the whole program, not ops" thesis of the Julia->TPU paper
+(arxiv 1810.09868), with the dataflow-step discipline of TensorFlow
+(arxiv 1605.08695):
+
+* forward + backward + the optimizer update of EVERY parameter are captured
+  as one :class:`~mxnet_tpu.cached_op.CachedOp`; all mutable training state
+  (params, BatchNorm running stats, optimizer slots, metric accumulators)
+  rides as CachedOp aux and is written back in place after each dispatch;
+* buffer donation (``CachedOp(flags={'donate_params': True})``) lets XLA
+  alias each state input's allocation to its output — true in-place update;
+  on CPU backends donation is a no-op, so ``donate='auto'`` only requests it
+  off-CPU;
+* ``steps_per_call=N`` wraps the step in ``jax.lax.scan`` over a
+  device-resident window of N microbatches, so N optimizer steps cost ONE
+  dispatch (and one host->device transfer of the stacked window);
+* metrics accumulate ON DEVICE through each metric's ``traced_update`` twin
+  (metric.py); the host fetches the (sum, count) scalars only at
+  ``metric_interval`` boundaries or at epoch end — the per-step host
+  barrier is gone;
+* per-step hyperparameters (the step count ``t`` and the scheduler-resolved
+  base learning rate) enter the trace as scalar INPUTS, so lr schedules and
+  t-dependent optimizers (Adam bias correction, FTML) run compiled without
+  per-step recompiles.
+
+Two frontends share the machinery:
+
+* :meth:`CompiledTrainStep.from_module` — a bound symbolic ``Module`` with
+  its initialized optimizer; the step is built over the executor's traced
+  graph (grads = vjp with ones cotangents, the ``backward()`` contract) and
+  the optimizer's own ``update_multi_precision`` traced through NDArray
+  tracer handles, so the compiled and eager paths run the SAME update
+  kernels.  This is what ``BaseModule.fit(compiled=True)`` uses.
+* :meth:`CompiledTrainStep.from_block` — a gluon block + explicit loss;
+  used by tools/input_bench.py and bench.py so the benches and ``fit()``
+  exercise one code path.
+
+Limitations become :class:`CompiledStepUnsupported` (the caller falls back
+to the eager loop with a one-line warning): multi-context binds, kvstore
+updates, non-``trace_safe`` optimizers, metrics with no device twin.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as _np
+
+from .. import autograd
+from ..base import MXNetError
+from ..cached_op import CachedOp
+from ..ndarray import NDArray, _wrap
+
+__all__ = ["CompiledTrainStep", "CompiledStepUnsupported"]
+
+
+class CompiledStepUnsupported(MXNetError):
+    """This configuration cannot be captured as a single compiled step;
+    the message says why.  Callers fall back to the eager loop."""
+
+
+# ---------------------------------------------------------------------------
+# optimizer capture helpers
+# ---------------------------------------------------------------------------
+
+_MISSING = object()
+
+
+@contextlib.contextmanager
+def _step_hyperparams(opt, lr_val, t_val):
+    """Route the optimizer's per-step hyperparameters through traced scalars
+    for the duration of one traced update.
+
+    ``_get_lr`` returns ``lr_val`` (the host-resolved base lr for this
+    microstep, scheduler already applied) times the static per-param
+    multiplier, and ``_index_update_count[...]`` reads as ``t_val`` — so
+    t-dependent math (Adam bias correction, FTML) stays correct across steps
+    of one compiled executable.  Count WRITES are discarded: the host
+    advances the real counters after the dispatch
+    (CompiledTrainStep._advance_counts)."""
+
+    class _Counts(dict):
+        def __missing__(self, key):
+            return t_val
+
+        def __setitem__(self, key, value):
+            pass
+
+    saved = {name: opt.__dict__.get(name, _MISSING)
+             for name in ("_get_lr", "_update_count", "_index_update_count")}
+    opt._get_lr = lambda index: lr_val * opt._index_mult(
+        index, opt.lr_mult, "lr_mult")
+    opt._update_count = lambda index: None
+    opt._index_update_count = _Counts()
+    try:
+        yield
+    finally:
+        for name, value in saved.items():
+            if value is _MISSING:
+                del opt.__dict__[name]
+            else:
+                setattr(opt, name, value)
+
+
+def _state_leaf_nds(state):
+    """NDArray leaves of an optimizer-state structure, depth-first."""
+    if isinstance(state, NDArray):
+        return [state]
+    if isinstance(state, (list, tuple)):
+        return [leaf for part in state for leaf in _state_leaf_nds(part)]
+    return []   # None / plain scalars carry no device state
+
+
+def _rebuild_state(template, leaf_iter):
+    """The template structure with NDArray leaves drawn from ``leaf_iter``."""
+    if isinstance(template, NDArray):
+        return next(leaf_iter)
+    if isinstance(template, (list, tuple)):
+        return type(template)(_rebuild_state(part, leaf_iter)
+                              for part in template)
+    return template
+
+
+def _check_optimizer(opt):
+    if not getattr(opt, "trace_safe", False):
+        raise CompiledStepUnsupported(
+            "optimizer %s is not marked trace_safe (its update cannot be "
+            "captured in a fixed trace)" % type(opt).__name__)
+
+
+def _metric_leaves(metric):
+    """Flatten a metric (possibly composite) into device-updatable leaves."""
+    from .. import metric as metric_mod
+    if metric is None:
+        return []
+    if isinstance(metric, metric_mod.CompositeEvalMetric):
+        leaves = []
+        for child in metric.metrics:
+            leaves.extend(_metric_leaves(child))
+        return leaves
+    if not metric.supports_device_update():
+        raise CompiledStepUnsupported(
+            "metric %s (%s) has no traced_update device twin"
+            % (metric.name, type(metric).__name__))
+    return [metric]
+
+
+def _resolve_donate(donate, ctx):
+    if donate != "auto":
+        return bool(donate)
+    # CPU XLA cannot alias donated buffers — requesting donation there only
+    # produces a "donated buffers were not usable" warning per compile.
+    # Key on the STEP's device, not jax.default_backend(): a cpu-bound
+    # module in a TPU-backed process must not request donation either.
+    if ctx is not None:
+        try:
+            return ctx.jax_device().platform != "cpu"
+        except Exception:
+            pass
+    import jax
+    return jax.default_backend() != "cpu"
+
+
+# ---------------------------------------------------------------------------
+# the step
+# ---------------------------------------------------------------------------
+
+class CompiledTrainStep:
+    """One-dispatch training over a window of ``steps_per_call`` batches.
+
+    Construction is via :meth:`from_module` / :meth:`from_block`.  The
+    instance owns a flat ``state`` dict of NDArray handles (``p:`` params,
+    ``a:`` executor aux, ``o:`` optimizer-state leaves, ``m:`` metric
+    accumulators) — the SAME handles the module/block reads — all registered
+    as CachedOp aux, so every dispatch writes the new values back in place.
+    """
+
+    def __init__(self, microstep, state_nd, optimizer, opt_bindings,
+                 opt_indices, metrics, metric_keys, n_inputs, keys_per_step,
+                 steps_per_call, ctx, donate, owner=None):
+        if steps_per_call < 1:
+            raise ValueError("steps_per_call must be >= 1")
+        self._microstep = microstep
+        self.state = state_nd
+        self._state_names = sorted(state_nd)
+        self._optimizer = optimizer
+        self._opt_bindings = opt_bindings
+        self._opt_indices = opt_indices
+        self._metrics = metrics
+        self._metric_keys = metric_keys
+        self._n_inputs = n_inputs
+        self._keys_per_step = max(1, keys_per_step)
+        self.steps_per_call = steps_per_call
+        self._ctx = ctx
+        self._owner = owner
+        flags = {"donate_params": True} if _resolve_donate(donate, ctx) \
+            else {}
+        self.cached_op = CachedOp(self._make_forward_fn(), state_nd,
+                                  aux_names=tuple(state_nd), flags=flags)
+
+    # -- trace ----------------------------------------------------------
+    def _make_forward_fn(self):
+        microstep = self._microstep
+        state_names = self._state_names
+        opt_bindings = self._opt_bindings
+        metrics = self._metrics
+        metric_keys = self._metric_keys
+        opt = self._optimizer
+        n_keys = self._keys_per_step
+
+        def apply_optimizer(carry, new_carry, grads, lr_t, t_t):
+            """Run the optimizer's own (traced) update kernels over NDArray
+            wrappers of the carry values; harvest the mutated handles."""
+            staged = []
+            for index, pkey, template, leaf_keys in opt_bindings:
+                weight = NDArray(new_carry.get(pkey, carry[pkey]))
+                grad = NDArray(grads[pkey])
+                leaves = iter([NDArray(carry[k]) for k in leaf_keys])
+                state = _rebuild_state(template, leaves)
+                staged.append((index, pkey, weight, grad, state, leaf_keys))
+            with _step_hyperparams(opt, lr_t, t_t):
+                for index, pkey, weight, grad, state, leaf_keys in staged:
+                    opt.update_multi_precision(index, weight, grad, state)
+            for index, pkey, weight, grad, state, leaf_keys in staged:
+                new_carry[pkey] = weight._data
+                for key, leaf in zip(leaf_keys, _state_leaf_nds(state)):
+                    new_carry[key] = leaf._data
+
+        def body(carry, xs):
+            import jax.numpy as jnp
+            t_t, lr_t, keys_t = xs["t"], xs["lr"], xs["keys"]
+            grads, updates, preds, labels, extra = microstep(
+                carry, xs["in"], keys_t)
+            new_carry = dict(carry)
+            new_carry.update(updates)
+            apply_optimizer(carry, new_carry, grads, lr_t, t_t)
+            deltas = []
+            for m, (skey, ckey) in zip(metrics, metric_keys):
+                stat, count = m.traced_update(labels, preds)
+                new_carry[skey] = carry[skey] + stat
+                new_carry[ckey] = carry[ckey] + count
+                deltas += [stat, count]
+            if extra is not None:
+                y = extra
+            elif deltas:
+                y = jnp.stack([jnp.asarray(d, jnp.float32) for d in deltas])
+            else:
+                y = jnp.float32(0.0)
+            return new_carry, y
+
+        def forward_fn(p, t_nd, lr_nd, *input_nds):
+            import jax
+            import jax.numpy as jnp
+            from .. import random as _random
+
+            window = int(t_nd.shape[0])
+            carry = {k: p[k]._data for k in state_names}
+            in_vals = [x._data for x in input_nds]
+            # one key row per (microstep, rng site), all derived from the
+            # CachedOp's per-call key input (random.key_override is active)
+            keys = jnp.stack([
+                jnp.stack([_random.next_key() for _ in range(n_keys)])
+                for _ in range(window)])
+            if window == 1:
+                carry, y = body(carry, {
+                    "t": t_nd._data[0], "lr": lr_nd._data[0],
+                    "keys": keys[0], "in": [v[0] for v in in_vals]})
+                ys = jnp.asarray(y)[None]
+            else:
+                carry, ys = jax.lax.scan(body, carry, {
+                    "t": t_nd._data, "lr": lr_nd._data, "keys": keys,
+                    "in": in_vals})
+            for k in state_names:
+                p[k]._set_data(carry[k])
+            return NDArray(ys)
+
+        return forward_fn
+
+    # -- dispatch -------------------------------------------------------
+    def _hyper_vectors(self, window):
+        opt = self._optimizer
+        base = opt.num_update
+        ts, lrs = [], []
+        for k in range(1, window + 1):
+            t = base + k
+            ts.append(float(t))
+            lrs.append(float(opt.lr_scheduler(t))
+                       if opt.lr_scheduler is not None else float(opt.lr))
+        from ..ndarray import array
+        return (array(_np.asarray(ts, _np.float32), ctx=self._ctx),
+                array(_np.asarray(lrs, _np.float32), ctx=self._ctx))
+
+    def _advance_counts(self, window):
+        opt = self._optimizer
+        for index in self._opt_indices:
+            count = opt._index_update_count.get(
+                index, opt.begin_num_update) + window
+            opt._index_update_count[index] = count
+            opt.num_update = max(count, opt.num_update)
+
+    def run_window(self, batches_io):
+        """Train on a window of 1..steps_per_call batches in ONE dispatch.
+
+        ``batches_io``: one tuple of input NDArrays per batch, in the
+        step's input order (data..., then labels...).  Returns the step's
+        per-microstep output array WITHOUT fetching it (shape [W] losses
+        for from_block steps, [W, 2*n_metrics] accumulator deltas for
+        from_module steps)."""
+        import jax.numpy as jnp
+        window = len(batches_io)
+        if not 1 <= window <= self.steps_per_call:
+            raise ValueError("window of %d batches vs steps_per_call=%d"
+                             % (window, self.steps_per_call))
+        if self._n_inputs is not None and \
+                len(batches_io[0]) != self._n_inputs:
+            raise ValueError("batch provides %d inputs, step expects %d"
+                             % (len(batches_io[0]), self._n_inputs))
+        t_nd, lr_nd = self._hyper_vectors(window)
+        stacked = []
+        for j in range(len(batches_io[0])):
+            vals = [b[j]._data for b in batches_io]
+            stacked.append(_wrap(jnp.stack(vals), ctx=self._ctx))
+        with autograd.train_mode():
+            out = self.cached_op(self.state, t_nd, lr_nd, *stacked)
+        self._advance_counts(window)
+        if self._owner is not None:
+            self._owner._params_dirty = True
+        return out
+
+    def step(self, *inputs):
+        """Single-batch convenience over :meth:`run_window`."""
+        return self.run_window([tuple(inputs)])
+
+    def sync_metric(self):
+        """Fetch the on-device metric accumulators into their EvalMetric
+        objects and zero them.  This is a host sync — the ONLY one the
+        compiled path performs — so call it at metric_interval boundaries
+        or epoch end, never per batch."""
+        for m, (skey, ckey) in zip(self._metrics, self._metric_keys):
+            stat = float(_np.asarray(self.state[skey].asnumpy()))
+            count = float(_np.asarray(self.state[ckey].asnumpy()))
+            if stat or count:
+                m._device_accumulate(stat, count)
+            with autograd.pause():
+                # one fresh buffer per slot: sharing one zero across slots
+                # would alias state entries and break buffer donation
+                # ("attempt to donate the same buffer twice")
+                self.state[skey]._set_data(self._committed_zero())
+                self.state[ckey]._set_data(self._committed_zero())
+
+    def _committed_zero(self):
+        """A device-committed f32 scalar zero.  The steady-state accumulator
+        buffers are jit outputs (committed to their device); resetting with
+        an UNcommitted constant would flip the jit cache key and silently
+        recompile the whole step on the next window."""
+        import jax
+        dev = self._ctx.jax_device() if self._ctx is not None \
+            else jax.devices()[0]
+        # a fresh numpy scalar per call: jnp constants can be cached, and a
+        # shared buffer across state slots would defeat per-slot donation
+        return jax.device_put(_np.zeros((), _np.float32), dev)
+
+    def cache_stats(self):
+        """The underlying CachedOp's per-signature compile counters."""
+        return self.cached_op.cache_stats()
+
+    # ------------------------------------------------------------------
+    # frontends
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_module(cls, module, eval_metric=None, steps_per_call=1,
+                    donate="auto"):
+        """Capture a bound Module's forward+backward+update as one CachedOp.
+
+        State handles are the executor's own ``arg_dict``/``aux_dict``
+        entries and the updater's state arrays — so ``get_params()``,
+        ``save_optimizer_states()`` and crash-resume (docs/ROBUSTNESS.md)
+        see exactly what the step trains, and a run killed mid-epoch
+        resumes bitwise like the eager path."""
+        handles_fn = getattr(module, "_compiled_step_handles", None)
+        if handles_fn is None:
+            raise CompiledStepUnsupported(
+                "%s has no compiled-step support" % type(module).__name__)
+        h = handles_fn()
+        exe = h["executor"]
+        opt = h["optimizer"]
+        updater = h["updater"]
+        if updater is None:
+            raise CompiledStepUnsupported("no local updater")
+        _check_optimizer(opt)
+        metrics = _metric_leaves(eval_metric)
+
+        param_names = [n for n in h["param_names"] if n in exe.arg_names]
+        input_names = list(h["data_names"]) + list(h["label_names"])
+        for req_name in h["data_names"]:
+            if req_name not in exe.arg_names:
+                raise CompiledStepUnsupported(
+                    "data input %r is not a graph argument" % req_name)
+        wrt_names = [n for n in param_names
+                     if exe.grad_req.get(n, "null") not in ("null",)]
+        for n in wrt_names:
+            if exe.grad_req[n] != "write":
+                raise CompiledStepUnsupported(
+                    "grad_req=%r for %r (only 'write' is capturable)"
+                    % (exe.grad_req[n], n))
+        if not wrt_names:
+            raise CompiledStepUnsupported("no trainable parameters")
+
+        fn = exe._build_fn(True)
+        n_rng = exe._n_rng
+        aux_update_names = list(exe._aux_update_names)
+        aux_names = list(exe.aux_names)
+        arg_names = list(exe.arg_names)
+
+        # ensure optimizer state exists under the eager updater's indices so
+        # save/load_optimizer_states and resume interoperate unchanged
+        name_to_index = {n: i for i, n in enumerate(param_names)}
+        for n in wrt_names:
+            index = name_to_index[n]
+            if index not in updater.states:
+                updater.states[index] = \
+                    opt.create_state_multi_precision(index, exe.arg_dict[n])
+                updater.states_synced[index] = True
+            elif not updater.states_synced.get(index, True):
+                updater.states[index] = updater._to_nd(
+                    updater.states[index], exe.arg_dict[n].context)
+                updater.states_synced[index] = True
+
+        state_nd = {}
+        for n in param_names:
+            state_nd["p:" + n] = exe.arg_dict[n]
+        for n in aux_names:
+            state_nd["a:" + n] = exe.aux_dict[n]
+        opt_bindings = []
+        opt_indices = []
+        for n in wrt_names:
+            index = name_to_index[n]
+            template = updater.states[index]
+            leaf_keys = ["o:%s:%d" % (n, i)
+                         for i in range(len(_state_leaf_nds(template)))]
+            for key, leaf in zip(leaf_keys, _state_leaf_nds(template)):
+                state_nd[key] = leaf
+            opt_bindings.append((index, "p:" + n, template, leaf_keys))
+            opt_indices.append(index)
+        metric_keys = cls._metric_state(state_nd, metrics, h["context"])
+
+        input_pos = {n: i for i, n in enumerate(input_names)}
+        label_idx = [input_pos[n] for n in h["label_names"]]
+        wrt_pos = {n: i for i, n in enumerate(wrt_names)}
+
+        def microstep(carry, batch_vals, keys_t):
+            import jax
+            import jax.numpy as jnp
+            aux_vals = [carry["a:" + n] for n in aux_names]
+
+            def arg_vals(wrt_vals):
+                vals = []
+                for n in arg_names:
+                    if n in input_pos:
+                        vals.append(batch_vals[input_pos[n]])
+                    elif n in wrt_pos:
+                        vals.append(wrt_vals[wrt_pos[n]])
+                    else:
+                        vals.append(carry["p:" + n])
+                return vals
+
+            def f_wrt(*wv):
+                return tuple(fn(arg_vals(wv), aux_vals, keys_t))
+
+            outs, vjp = jax.vjp(f_wrt, *[carry["p:" + n] for n in wrt_names])
+            n_graph = len(outs) - len(aux_update_names)
+            # the fit loop's backward() contract: ones cotangents on every
+            # graph output, zeros on the appended BN running-stat tail
+            cts = tuple(jnp.ones_like(o) for o in outs[:n_graph]) + \
+                tuple(jnp.zeros_like(o) for o in outs[n_graph:])
+            grad_vals = vjp(cts)
+            grads = {"p:" + n: g for n, g in zip(wrt_names, grad_vals)}
+            updates = {"a:" + n: v
+                       for n, v in zip(aux_update_names, outs[n_graph:])}
+            preds = list(outs[:n_graph])
+            labels = [batch_vals[i] for i in label_idx]
+            return grads, updates, preds, labels, None
+
+        return cls(microstep, state_nd, opt, opt_bindings, opt_indices,
+                   metrics, metric_keys, len(input_names), n_rng,
+                   steps_per_call, h["context"], donate, owner=module)
+
+    @classmethod
+    def from_block(cls, block, loss_fn, optimizer, n_inputs=1,
+                   eval_metric=None, steps_per_call=1, donate="auto"):
+        """Capture a gluon block + explicit loss + optimizer as one CachedOp.
+
+        ``loss_fn(outputs, *labels) -> scalar NDArray`` over the block's
+        outputs; ``n_inputs`` leading step inputs feed the block, the rest
+        go to the loss (and metrics) as labels.  Parameter/optimizer state
+        is updated in place in the block's own Parameter storage."""
+        from ..gluon.block import split_param_names
+        _check_optimizer(optimizer)
+        metrics = _metric_leaves(eval_metric)
+        params = {p.name: p for p in block.collect_params().values()}
+        train_names, frozen_names = split_param_names(block)
+        param_nd = {n: params[n].data() for n in params}
+        ctx = next(iter(param_nd.values())).context if param_nd else None
+
+        state_nd = {"p:" + n: param_nd[n] for n in params}
+        opt_bindings = []
+        for n in train_names:
+            template = optimizer.create_state_multi_precision(n, param_nd[n])
+            leaf_keys = ["o:%s:%d" % (n, i)
+                         for i in range(len(_state_leaf_nds(template)))]
+            for key, leaf in zip(leaf_keys, _state_leaf_nds(template)):
+                state_nd[key] = leaf
+            opt_bindings.append((n, "p:" + n, template, leaf_keys))
+        metric_keys = cls._metric_state(state_nd, metrics, ctx)
+
+        def microstep(carry, batch_vals, keys_t):
+            import jax
+            from ..gluon.block import functional_call
+            x_vals = batch_vals[:n_inputs]
+            label_vals = batch_vals[n_inputs:]
+            frozen_vals = {n: carry["p:" + n] for n in frozen_names}
+
+            def loss_of(train_vals):
+                full = dict(frozen_vals)
+                full.update(train_vals)
+                outs, new_aux = functional_call(block, full, *x_vals,
+                                                training=True,
+                                                rng_key=keys_t[0])
+                loss = loss_fn([NDArray(o) for o in outs],
+                               *[NDArray(v) for v in label_vals])
+                # mxnet reductions keep a (1,) shape; grad needs a scalar
+                return loss._data.reshape(()), (new_aux, outs)
+
+            (loss, (new_aux, outs)), grad_vals = jax.value_and_grad(
+                loss_of, has_aux=True)({n: carry["p:" + n]
+                                        for n in train_names})
+            grads = {"p:" + n: grad_vals[n] for n in train_names}
+            updates = {"p:" + n: v for n, v in new_aux.items()}
+            return grads, updates, list(outs), list(label_vals), loss
+
+        return cls(microstep, state_nd, optimizer, opt_bindings,
+                   list(train_names), metrics, metric_keys, None,
+                   1, steps_per_call, ctx, donate)
+
+    @staticmethod
+    def _metric_state(state_nd, metrics, ctx):
+        """Allocate the (sum, count) scalar accumulator pair per metric
+        (device-committed, matching the steady-state jit-output buffers —
+        see _committed_zero)."""
+        import jax
+        from ..ndarray import from_jax
+        metric_keys = []
+        dev = ctx.jax_device() if ctx is not None else jax.devices()[0]
+        for j, _m in enumerate(metrics):
+            skey, ckey = "m:%d:s" % j, "m:%d:n" % j
+            for key in (skey, ckey):
+                state_nd[key] = from_jax(
+                    jax.device_put(_np.zeros((), _np.float32), dev), ctx=ctx)
+            metric_keys.append((skey, ckey))
+        return metric_keys

@@ -188,6 +188,20 @@ class DataParallelExecutorGroup:
         self.aux_arrays = [[exec_.aux_dict[name] for exec_ in self.execs]
                            for name in self.aux_names]
 
+    def single_executor(self):
+        """The one executor of a single-context bind.
+
+        Whole-program capture (module/compiled_step.py) compiles forward +
+        backward + update over ONE executor's arg/aux handles; the
+        replica-per-device layout of a multi-context bind has no single
+        set of handles to capture, so it raises instead."""
+        if len(self.execs) != 1:
+            raise MXNetError(
+                "single_executor(): bound over %d contexts; whole-program "
+                "capture needs a single-device bind (use parallel/ for the "
+                "sharded path)" % len(self.execs))
+        return self.execs[0]
+
     def set_params(self, arg_params, aux_params, allow_extra=False):
         for exec_ in self.execs:
             exec_.copy_params_from(arg_params, aux_params,

@@ -390,6 +390,51 @@ class Module(BaseModule):
         assert self.binded
         self._exec_group.install_monitor(mon)
 
+    def _compiled_step_handles(self):
+        """Everything CompiledTrainStep.from_module needs to capture this
+        module's whole training iteration as one CachedOp, or raise
+        CompiledStepUnsupported with the reason the eager loop must run
+        (module/compiled_step.py owns the traceability checks on top)."""
+        from .compiled_step import CompiledStepUnsupported
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            raise CompiledStepUnsupported(
+                "module must be bound/initialized with an optimizer")
+        if len(self._context) != 1:
+            raise CompiledStepUnsupported(
+                "multi-context bind (%d devices); the compiled step needs a "
+                "single-device executor" % len(self._context))
+        if self._kvstore is not None or self._update_on_kvstore:
+            raise CompiledStepUnsupported(
+                "kvstore-backed update; the compiled step needs the local "
+                "updater path")
+        if self._state_names:
+            raise CompiledStepUnsupported(
+                "state_names carry mutable module state across steps")
+        if self._group2ctxs:
+            raise CompiledStepUnsupported(
+                "group2ctxs model parallelism pins ops to devices, which "
+                "needs eager dispatch")
+        if self.inputs_need_grad:
+            raise CompiledStepUnsupported(
+                "inputs_need_grad: input gradients are not materialized by "
+                "the fused step")
+        return {
+            "executor": self._exec_group.single_executor(),
+            "optimizer": self._optimizer,
+            "updater": self._updater,
+            "param_names": list(self._param_names),
+            # bound-shape order, NOT self._data_names order: batch.data
+            # arrives in the iterator's provide_data order, and the eager
+            # scatter (executor_group.forward) matches positionally against
+            # data_shapes — the compiled step must bind the same way or a
+            # provide order differing from data_names order would silently
+            # swap same-shaped inputs
+            "data_names": [d.name for d in self._data_shapes],
+            "label_names": [l.name for l in (self._label_shapes or [])],
+            "context": self._context[0],
+        }
+
     def prepare(self, data_batch, sparse_row_id_fn=None):
         assert self.binded
 

@@ -294,9 +294,15 @@ class NDArray:
             else:
                 new = jnp.broadcast_to(jnp.asarray(v, dtype=self._data.dtype),
                                        self.shape).astype(self._data.dtype)
-            if getattr(self._data, "committed", False):
+            import jax.core as _jcore
+            if not isinstance(self._data, _jcore.Tracer) and \
+                    getattr(self._data, "committed", False):
                 # in-place writes keep the array on its device (the reference
-                # NDArray's context is sticky; matters for group2ctx)
+                # NDArray's context is sticky; matters for group2ctx).
+                # Tracers (whole-step capture: compiled_step traces python
+                # optimizers through here) have no .committed — probing it
+                # raises ConcretizationTypeError, and inside a trace XLA
+                # owns placement anyway.
                 import jax
                 new = jax.device_put(new, list(self._data.devices())[0])
         else:
@@ -315,6 +321,13 @@ class NDArray:
             return invoke(op_scalar, [self], {"scalar": float(other), "reverse": reverse})
         if isinstance(other, _np.ndarray):
             return self._binop(array(other, ctx=self._ctx, dtype=other.dtype), op_arr, op_scalar, reverse)
+        if _is_jax_value(other):
+            # raw jax arrays/tracers mix with NDArrays during whole-step
+            # capture (compiled_step threads lr/t as traced scalars through
+            # python optimizer math like ``lr * state``): python dispatches
+            # to our reflected op after the tracer's returns NotImplemented
+            return self._binop(_wrap(other, ctx=self._ctx), op_arr,
+                               op_scalar, reverse)
         return NotImplemented
 
     def __add__(self, o):  return self._binop(o, "broadcast_add", "_plus_scalar")
@@ -499,6 +512,12 @@ import weakref as _weakref
 
 # live-array registry for waitall's WaitForAll semantics
 _LIVE_ARRAYS = _weakref.WeakSet()
+
+
+def _is_jax_value(obj):
+    """Is ``obj`` a raw jax array or tracer (not an NDArray/numpy/scalar)?"""
+    import jax
+    return isinstance(obj, (jax.Array, jax.core.Tracer))
 
 
 def _wrap(jax_value, ctx=None):

@@ -81,26 +81,26 @@ class SyntheticDecodeDataset:
         return img, self._labels[i]
 
 
-def _build_trainer(batch, img, channels, classes=10):
+def _build_trainer(batch, img, channels, classes=10, lr=None, momentum=None):
     """-> (fused-step CachedOp, step fn): the whole training iteration —
-    forward + backward + SGD-momentum update — as ONE CachedOp, the same
-    one-XLA-module step discipline as the headline bench (BENCH_LIVE.json).
+    forward + backward + SGD-momentum update — as ONE CachedOp, via the
+    SAME ``CompiledTrainStep`` machinery that powers the default
+    ``fit(compiled=True)`` path (module/compiled_step.py), so this bench
+    and the fit loop exercise one code path.
 
     All state (params + momenta) rides as CachedOp aux, so each call
     writes the updated values back in place; the per-step host fetch of
     the loss therefore waits for the ENTIRE step — one clean barrier per
     batch, which is exactly the regime where a synchronous input pipeline
     costs its full decode+transfer time and an async feed hides it.
+    ``lr``/``momentum`` come from BENCH_PIPE_LR / BENCH_PIPE_MOMENTUM
+    (defaults 0.05 / 0.9) unless given explicitly.
     """
-    import jax
-    import jax.numpy as jnp
-
     import mxnet_tpu as mx
-    from mxnet_tpu import autograd, nd
-    from mxnet_tpu.cached_op import CachedOp
+    from mxnet_tpu import nd
+    from mxnet_tpu import optimizer as opt_mod
     from mxnet_tpu.gluon import nn
-    from mxnet_tpu.gluon.block import functional_call, param_values
-    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.module.compiled_step import CompiledTrainStep
 
     net = nn.HybridSequential()
     with net.name_scope():
@@ -111,53 +111,33 @@ def _build_trainer(batch, img, channels, classes=10):
         net.add(nn.Dense(classes))
     net.initialize(mx.init.Xavier())
     net(nd.zeros((1, 3, img, img)))   # materialize deferred shapes
-    params = param_values(net)
-    bn_aux = {n for n, p in net.collect_params().items()
-              if p.grad_req == "null"}
-    train_names = sorted(n for n in params if n not in bn_aux)
-    lr, momentum = 0.05, 0.9
 
-    state_nd = {n: nd.from_jax(v) for n, v in params.items()}
-    state_nd.update({"mom:" + n: nd.from_jax(jnp.zeros_like(params[n]))
-                     for n in train_names})
+    if lr is None:
+        lr = float(os.environ.get("BENCH_PIPE_LR", "0.05"))
+    if momentum is None:
+        momentum = float(os.environ.get("BENCH_PIPE_MOMENTUM", "0.9"))
+    optimizer = opt_mod.SGD(learning_rate=lr, momentum=momentum,
+                            rescale_grad=1.0)
 
-    def fused_step(p, x, y):
-        pv = {n: p[n]._data for n in params}
+    def ce_loss(outs, y):
+        logp = nd.log_softmax(outs[0])
+        picked = nd.pick(logp, y.astype("int32"), axis=1)
+        return -nd.mean(picked)
 
-        def loss_f(tp, xv, yv):
-            full = {n: pv[n] for n in bn_aux}
-            full.update(tp)
-            outs, new_aux = functional_call(net, full, xv, training=True)
-            logp = jax.nn.log_softmax(outs[0])
-            loss = -jnp.mean(jnp.take_along_axis(
-                logp, yv[:, None].astype(jnp.int32), axis=1))
-            return loss, new_aux
-
-        (loss, new_aux), grads = jax.value_and_grad(loss_f, has_aux=True)(
-            {n: pv[n] for n in train_names}, x._data, y._data)
-        for n in train_names:
-            m = momentum * p["mom:" + n]._data + grads[n]
-            p["mom:" + n]._data = m
-            p[n]._data = pv[n] - lr * m
-        for n, v in new_aux.items():
-            p[n]._data = v
-        return NDArray(loss)
-
-    cop = CachedOp(fused_step, state_nd, aux_names=tuple(state_nd))
+    trainer = CompiledTrainStep.from_block(net, ce_loss, optimizer)
 
     def step(xb, yb):
-        with autograd.train_mode():
-            loss = cop(state_nd, xb, yb)
+        loss = trainer.step(xb, yb)   # [1]-shaped: one loss per microstep
         # the loss is one output of the single fused XLA module, so this
         # host fetch is a full-step barrier — the honest per-batch sync
-        return float(np.asarray(loss.asnumpy()))
+        return float(np.asarray(loss.asnumpy())[0])
 
     # absorb the compile before anything is timed
     x = nd.array(np.zeros((batch, 3, img, img), np.float32))
     y = nd.array(np.zeros((batch,), np.float32))
     for _ in range(3):
         step(x, y)
-    return cop, step
+    return trainer.cached_op, step
 
 
 def _timed_epoch(batch_iter, step, batch, n_batches, warm=1):

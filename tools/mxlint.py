@@ -81,10 +81,18 @@ def main(argv=None):
 
     if args.update_baseline:
         bl = common.Baseline.from_findings(findings)
+        previous = common.load_baseline(args.baseline).entries
+        # carried-over keys keep their original reason text — the reason is
+        # the per-entry fix instruction (e.g. "add a test exercising the op
+        # and delete this entry"), and flattening it to the generic default
+        # on every regeneration would erase the burn-down guidance
+        for key in bl.entries:
+            if key in previous:
+                bl.entries[key] = previous[key]
         if set(passes) != set(PASSES):
             # partial run: an unscanned pass produced no findings, which
             # must not read as "all fixed" — carry its entries over
-            for k, reason in common.load_baseline(args.baseline).entries.items():
+            for k, reason in previous.items():
                 if common.pass_of_key(k) not in passes:
                     bl.entries.setdefault(k, reason)
         bl.save(args.baseline)
