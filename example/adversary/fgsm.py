@@ -80,13 +80,27 @@ def main():
     parser.add_argument("--steps", type=int, default=150)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--eps", type=float, default=0.25)
-    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=None,
+                        help="net-init seed; defaults to MXNET_TEST_SEED "
+                             "(else 0)")
     args = parser.parse_args()
 
-    # deterministic end to end: the net init previously drew from the
-    # global mx RNG, so the collapse margin depended on the harness seed
-    # (reproduced red at MXNET_TEST_SEED=871536002); seed explicitly so
-    # every run — any MXNET_TEST_SEED — is the same run
+    # Root cause of the round-5 "flakiness" story, in two layers.  Layer 1
+    # (fixed in r5): initializers drew from numpy's GLOBAL RNG, so
+    # mx.random.seed never controlled net init and the collapse margin
+    # changed between *identical* invocations (red at
+    # MXNET_TEST_SEED=871536002).  Layer 2 (fixed here): the r5 fix pinned
+    # --seed 0, which MASKED the knob instead of testing it —
+    # FLAKINESS_FGSM_r05.txt ran "100 seeds" through
+    # tools/flakiness_checker.py, but every trial was bit-for-bit the same
+    # run, so 0/100 proved determinism, not seed-robustness.  The seed now
+    # defaults to MXNET_TEST_SEED so the checker's knob really varies the
+    # trained net + attack; the exit gates hold across seeds by MARGIN
+    # (measured over seeds 1-16: clean 1.000, fgsm 0.15-0.43 vs the 0.70
+    # bound, random-sign 1.000 vs the 0.85 bound), not by pinning.  Data
+    # RNGs stay fixed so the classification task itself is constant.
+    if args.seed is None:
+        args.seed = int(os.environ.get("MXNET_TEST_SEED", "0"))
     mx.random.seed(args.seed)
     rng = np.random.RandomState(3)
     net = build_net()

@@ -55,6 +55,12 @@ Invariants asserted (per seed)
   returns a checkpoint whose files load bit-exact (see ``crash_sweep``;
   the fit-level twin — resume to the uninterrupted run's exact params —
   lives in tests/test_faults.py).
+* **decode streams** (``decode``) — continuous-batching token streams
+  through the DecodeEngine under chaos: stream-count conservation, OK
+  streams bitwise-equal to their own greedy reference (partial streams a
+  strict prefix — no torn or cross-contaminated token streams), KV block
+  accounting whole after the drain (allocated == freed), zero
+  steady-state recompiles, no deadlock (see ``decode_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
@@ -839,11 +845,188 @@ def crash_sweep(seed):
 
 
 # ---------------------------------------------------------------------------
+# scenario 8: continuous-batching decode engine storm
+# ---------------------------------------------------------------------------
+
+# decode engines compile a prefill+width signature menu at load, so the
+# fixture is built once (lazily) and shared across seeds like the server
+_DECODE_PROMPTS = ((3,), (1, 2), (5, 4, 3, 2), (7, 6, 5, 4, 3, 2, 1),
+                   (2, 2, 2), (9, 8))
+_DECODE_MAX_NEW = 6
+
+
+def _build_decode_fixture():
+    """-> (engine, prompts, per-prompt greedy reference token lists)."""
+    from ..serving.decode import DecodeEngine, TinyCausalLM
+
+    model = TinyCausalLM(vocab_size=24, hidden=16, num_layers=1,
+                         num_heads=2, max_len=32, seed=11)
+    # deliberately tight: 3 slots, a 2-deep queue and a 7-block pool so
+    # seeded storms actually exercise OVERLOADED shedding and join-time
+    # block reservation, not just the happy path
+    engine = DecodeEngine(model, name="stress-decode", max_slots=3,
+                          block_size=4, num_blocks=8, max_prompt_len=8,
+                          max_new_tokens=_DECODE_MAX_NEW, max_queue=2,
+                          breaker_threshold=4, breaker_backoff_ms=15.0)
+    refs = [engine.generate_reference(p, _DECODE_MAX_NEW).tolist()
+            for p in _DECODE_PROMPTS]
+    return engine, list(_DECODE_PROMPTS), refs
+
+
+def decode_storm(engine, prompts, refs, seed, n_clients=4, per_client=2):
+    """Concurrent token streams under chaos (the ``decode`` scenario).
+
+    Invariants:
+    * **stream conservation** — every submitted stream reaches exactly one
+      terminal status from {OK, TIMEOUT, OVERLOADED, INVALID_INPUT,
+      UNAVAILABLE} (ERROR would mean the engine failed a batch with no
+      faults injected), and the engine's counters conserve:
+      ``requests == ok + timeouts + errors + unavailable`` with every
+      per-status delta matching the client tally;
+    * **no torn/cross-contaminated streams** — an OK stream's tokens equal
+      the greedy reference for ITS OWN prompt bitwise; a TIMEOUT or
+      UNAVAILABLE stream's partial tokens are a strict prefix of that
+      reference (iteration-level join/leave must never leak another
+      slot's tokens or KV pages into a stream);
+    * **KV block accounting** — after the drain the pool is whole again:
+      ``used == 0``, ``reserved == 0`` and ``allocated_total ==
+      freed_total`` (leaked pages would starve future admissions);
+    * **no deadlock** — every client joins in time; every stream's wait()
+      resolves.
+    """
+    from ..serving import server as srv
+
+    terminal = {srv.OK, srv.TIMEOUT, srv.OVERLOADED, srv.INVALID_INPUT,
+                srv.UNAVAILABLE}
+    rng = random.Random(seed ^ 0xDEC0DE)
+    violations = []
+    before = engine.stats_snapshot()
+    plans = []
+    for c in range(n_clients):
+        plan = []
+        for _ in range(per_client):
+            roll = rng.random()
+            if roll < 0.15:
+                plan.append(("invalid", None))              # bad token ids
+            elif roll < 0.35:
+                plan.append(("tiny", rng.uniform(0.2, 2.0)))  # likely TIMEOUT
+            else:
+                plan.append(("ok", None))                   # no deadline
+            plan[-1] = plan[-1] + (rng.randrange(len(prompts)),)
+        plans.append(plan)
+    results = [[] for _ in range(n_clients)]
+
+    def client(c):
+        for kind, tmo, pi in plans[c]:
+            if kind == "invalid":
+                prompt = [999, -3]                          # outside vocab
+            else:
+                prompt = list(prompts[pi])
+            stream = engine.submit(prompt, max_new_tokens=_DECODE_MAX_NEW,
+                                   timeout_ms=tmo)
+            if not stream.wait(_JOIN_TIMEOUT_S):
+                violations.append("stream of client %d never terminated" % c)
+            results[c].append((kind, pi, stream))
+
+    violations.extend(_spawn([lambda c=c: client(c)
+                              for c in range(n_clients)]))
+
+    tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "OVERLOADED": 0,
+             "INVALID_INPUT": 0, "ERROR": 0, "UNAVAILABLE": 0}
+    for c in range(n_clients):
+        for kind, pi, stream in results[c]:
+            status, tokens, _, _, _ = stream.snapshot()
+            if status not in terminal:
+                violations.append("client %d stream ended %r (kind %s)"
+                                  % (c, status, kind))
+                continue
+            tally[status] = tally.get(status, 0) + 1
+            if stream.admitted:
+                tally["admitted"] += 1
+            if kind == "invalid":
+                if status != srv.INVALID_INPUT:
+                    violations.append("invalid prompt got %s" % status)
+                continue
+            ref = refs[pi]
+            if status == srv.OK and list(tokens) != ref:
+                violations.append(
+                    "torn stream: client %d OK tokens %s != reference %s"
+                    % (c, list(tokens), ref))
+            elif status in (srv.TIMEOUT, srv.UNAVAILABLE) and \
+                    list(tokens) != ref[:len(tokens)]:
+                violations.append(
+                    "contaminated partial stream: client %d %s tokens %s "
+                    "not a prefix of %s" % (c, status, list(tokens), ref))
+
+    # conservation: same settle discipline as _settle_and_check (the
+    # completion event fires before the stats bump under chaos locks)
+    keys = ("requests", "ok", "timeouts", "errors", "unavailable", "shed",
+            "invalid", "unavailable_rejected")
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = engine.stats_snapshot()
+        d = {k: after[k] - before[k] for k in keys}
+        terminal_sum = (d["ok"] + d["timeouts"] + d["errors"]
+                        + d["unavailable"])
+        if d["requests"] == terminal_sum or time.monotonic() >= settle_until:
+            break
+        time.sleep(0.005)
+    if d["requests"] != tally["admitted"]:
+        violations.append("decode: admission mismatch: engine %d vs "
+                          "clients %d" % (d["requests"], tally["admitted"]))
+    if d["requests"] != terminal_sum:
+        violations.append("decode: lost streams: %d admitted, %d terminal"
+                          % (d["requests"], terminal_sum))
+    if d["ok"] != tally["OK"]:
+        violations.append("decode: ok mismatch: engine %d vs clients %d"
+                          % (d["ok"], tally["OK"]))
+    if d["timeouts"] != tally["TIMEOUT"]:
+        violations.append("decode: timeout mismatch: engine %d vs clients %d"
+                          % (d["timeouts"], tally["TIMEOUT"]))
+    if d["shed"] != tally["OVERLOADED"]:
+        violations.append("decode: shed mismatch: engine %d vs clients %d"
+                          % (d["shed"], tally["OVERLOADED"]))
+    if d["invalid"] != tally["INVALID_INPUT"]:
+        violations.append("decode: invalid mismatch: engine %d vs clients %d"
+                          % (d["invalid"], tally["INVALID_INPUT"]))
+    if d["unavailable"] + d["unavailable_rejected"] != tally["UNAVAILABLE"]:
+        violations.append("decode: unavailable mismatch: engine %d+%d vs "
+                          "clients %d" % (d["unavailable"],
+                                          d["unavailable_rejected"],
+                                          tally["UNAVAILABLE"]))
+    if d["errors"] or tally["ERROR"]:
+        violations.append("decode: ERROR with no faults injected "
+                          "(engine %d, clients %d)"
+                          % (d["errors"], tally["ERROR"]))
+
+    # KV block accounting: the pool must be whole after the drain
+    deadline = time.monotonic() + 5.0
+    while True:
+        kv = engine.kv_stats()
+        if (kv["used"] == 0 and kv["reserved"] == 0
+                and kv["live_sequences"] == 0) \
+                or time.monotonic() >= deadline:
+            break
+        time.sleep(0.005)
+    if kv["used"] != 0 or kv["reserved"] != 0 or kv["live_sequences"] != 0:
+        violations.append("decode: KV pool not whole after drain: %r" % kv)
+    if kv["allocated_total"] != kv["freed_total"]:
+        violations.append("decode: KV leak: allocated %d != freed %d"
+                          % (kv["allocated_total"], kv["freed_total"]))
+    # zero steady-state recompiles under contention
+    cb, ca = before["cache"], after["cache"]
+    if ca["recompiles"] != cb["recompiles"]:
+        violations.append("decode: steady-state recompile under chaos: "
+                          "%d -> %d" % (cb["recompiles"], ca["recompiles"]))
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
-             "crash")
+             "crash", "decode")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -857,8 +1040,16 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
     report = {"seeds": {}, "violations": 0, "preemptions": 0}
     t0 = time.monotonic()
     with chaos(sched):
-        server, name, net, inputs, expected = _build_fixture(
-            n_clients, max_queue)
+        # fixtures are warmup-compiled, so each is built only when a
+        # requested scenario actually drives it
+        needs_server = bool({"serving", "registry", "cache", "faults"}
+                            & set(scenarios))
+        server = name = net = inputs = expected = None
+        if needs_server:
+            server, name, net, inputs, expected = _build_fixture(
+                n_clients, max_queue)
+        decode_fixture = (_build_decode_fixture()
+                          if "decode" in scenarios else None)
         try:
             for seed in seeds:
                 sched.reseed(seed)
@@ -883,6 +1074,10 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                         per_client=per_client)
                 if "crash" in scenarios:
                     per_seed["crash"] = crash_sweep(seed)
+                if decode_fixture is not None:
+                    per_seed["decode"] = decode_storm(
+                        decode_fixture[0], decode_fixture[1],
+                        decode_fixture[2], seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n
@@ -892,7 +1087,10 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                            sched.preemptions))
         finally:
             sched.enabled = False
-            server.stop()
+            if server is not None:
+                server.stop()
+            if decode_fixture is not None:
+                decode_fixture[0].stop()
     report["preemptions"] = sched.preemptions
     report["elapsed_s"] = time.monotonic() - t0
     return report

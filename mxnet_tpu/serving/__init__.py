@@ -18,9 +18,10 @@ from .registry import ModelRegistry, ServableModel
 from .server import (ModelServer, InferenceResult,
                      OK, TIMEOUT, OVERLOADED, INVALID_INPUT, ERROR,
                      UNAVAILABLE)
+from . import decode
 
 __all__ = ["ModelServer", "InferenceResult", "BucketLadder", "Request",
            "MicroBatcher", "ModelRegistry", "ServableModel", "shape_key",
-           "CircuitBreaker", "HEALTHY", "DEGRADED",
+           "CircuitBreaker", "HEALTHY", "DEGRADED", "decode",
            "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR",
            "UNAVAILABLE"]

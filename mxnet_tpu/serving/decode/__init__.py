@@ -1,0 +1,25 @@
+"""mxnet_tpu.serving.decode — autoregressive decode engine.
+
+Continuous (iteration-level) batching + a paged KV cache on top of the
+CachedOp compile cache: finished sequences leave the fixed-shape decode
+step and queued requests join it every iteration, KV memory is a shared
+block pool whose usage scales with live tokens, prompts run separately
+through a prefill bucket ladder, and tokens stream back per-request with
+the serving tier's deadline/backpressure/breaker machinery applied
+per-stream.  See docs/SERVING.md#autoregressive-decode.
+
+    from mxnet_tpu.serving.decode import DecodeEngine, TinyCausalLM
+    engine = DecodeEngine(TinyCausalLM(), max_slots=8)
+    stream = engine.submit([3, 1, 4], max_new_tokens=16, timeout_ms=5000)
+    for token in stream:
+        ...                       # tokens arrive as they are decoded
+    assert stream.status == "OK"
+    engine.stop()
+"""
+from .engine import DecodeEngine, DecodeStream
+from .kv_cache import PagedKVCache
+from .model import TinyCausalLM
+from .stats import DecodeStats
+
+__all__ = ["DecodeEngine", "DecodeStream", "PagedKVCache", "TinyCausalLM",
+           "DecodeStats"]

@@ -1,0 +1,804 @@
+"""DecodeEngine: continuous batching for autoregressive generation.
+
+The MicroBatcher (batcher.py) batches at *request* granularity — right for
+one-shot inference, wrong for generation, where requests are hundreds of
+decode steps long and finish at different times: request-level batching
+leaves slots idle from each sequence's last token until the batch's last.
+This engine schedules at **iteration** granularity: every decode step,
+finished sequences leave their slot and queued requests join, so the
+fixed-shape step stays as full as admission allows (the TensorFlow paper's
+production lesson — the serving runtime, not the model, decides whether the
+hardware stays busy).
+
+Fixed shapes, zero steady-state recompiles (the XLA contract, same as the
+bucket ladder in buckets.py):
+
+* the decode step is always ``[max_slots]`` wide — join/leave changes slot
+  *contents*, never the signature; dead slots compute garbage against the
+  trash block and are masked host-side;
+* the attention width (page-table columns) is bucketed: the scheduler picks
+  the smallest precompiled width covering the longest live sequence, so
+  signatures = width buckets, all warmed at load;
+* prefill runs separately through a prompt-length bucket ladder
+  (``buckets.BucketLadder`` reuse) — one ``[1, Lb]`` causal pass per
+  joining request that populates its KV pages and yields the first token
+  (the TTFT token), keeping long-prompt compute out of the per-token step.
+
+KV memory is a paged block pool (kv_cache.py): admission reserves the
+worst-case block count (shedding OVERLOADED when the pool cannot honor
+it), blocks are allocated lazily as sequences grow and freed the moment a
+sequence finishes.
+
+Every request is a :class:`DecodeStream` — tokens stream out as they are
+produced (iterator and/or ``on_token`` callback), and the terminal state
+is a status, never an exception: the same vocabulary as server.py
+(OK / TIMEOUT / OVERLOADED / INVALID_INPUT / ERROR / UNAVAILABLE), with
+the deadline, bounded-admission, and circuit-breaker machinery
+(health.py) applied per-stream.  docs/SERVING.md#autoregressive-decode
+has the operator's view.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ... import autograd
+from ... import faults
+from ... import util
+from ...base import MXNetError
+from ...cached_op import CachedOp
+from ..buckets import BucketLadder
+from ..health import CircuitBreaker, PROBE, REJECT
+from ..server import (OK, TIMEOUT, OVERLOADED, INVALID_INPUT, ERROR,
+                      UNAVAILABLE)
+from .kv_cache import PagedKVCache
+from .stats import DecodeStats
+
+__all__ = ["DecodeEngine", "DecodeStream"]
+
+# transient-retry envelope around one prefill/decode execution, matching
+# ServableModel's policy (docs/ROBUSTNESS.md)
+_EXEC_ATTEMPTS = 3
+_EXEC_BACKOFF_S = 0.002
+
+
+class DecodeStream:
+    """One autoregressive request: async handle + incremental token stream.
+
+    Tokens arrive via :meth:`tokens` / iteration / the ``on_token``
+    callback as the engine produces them; ``wait()`` blocks until the
+    terminal status is set.  Because a stream is incremental, a TIMEOUT
+    or UNAVAILABLE terminal keeps the tokens already emitted — the status
+    says why the stream *ended*, not that its prefix is invalid.
+    """
+
+    def __init__(self, prompt, max_new_tokens, deadline=None, stats=None,
+                 on_token=None):
+        self.prompt = prompt                 # int32 numpy copy
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline             # monotonic seconds or None
+        self.stats = stats                   # engine DecodeStats handle
+        self.seq_id = None                   # assigned at submission
+        self.admitted = False
+        self.t_submit = time.monotonic()
+        self._on_token = on_token
+        self._cond = threading.Condition()
+        self._tokens = []
+        self.status = None
+        self.error = None
+        self.ttft_ms = None
+        self.latency_ms = None
+
+    def expired(self, now=None):
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= self.deadline)
+
+    # -- engine side ----------------------------------------------------
+    def _emit(self, token):
+        with self._cond:
+            if self.status is not None:
+                return          # terminal already claimed; drop the token
+            if self.ttft_ms is None:
+                self.ttft_ms = (time.monotonic() - self.t_submit) * 1e3
+            self._tokens.append(int(token))
+            self._cond.notify_all()
+        cb = self._on_token
+        if cb is not None:
+            # outside the lock: user code must not block token delivery or
+            # nest our cond; a raising callback is disabled (the stream
+            # keeps generating — delivery is best-effort, wait()/tokens()
+            # stay authoritative)
+            try:
+                cb(int(token))
+            except Exception:
+                self._on_token = None
+
+    def complete(self, status, error=None):
+        """First completion wins (engine finish vs teardown vs expiry)."""
+        with self._cond:
+            if self.status is not None:
+                return False
+            self.error = error
+            self.latency_ms = (time.monotonic() - self.t_submit) * 1e3
+            # status last: it is the done flag every reader keys on
+            self.status = status
+            self._cond.notify_all()
+        return True
+
+    # -- client side ----------------------------------------------------
+    def tokens(self):
+        """Snapshot of the tokens emitted so far."""
+        with self._cond:
+            return list(self._tokens)
+
+    def wait(self, timeout=None):
+        """Block until terminal; returns True when a status is set."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self.status is not None,
+                                       timeout)
+
+    def result(self):
+        """Wait the stream out and return it (fluent blocking read)."""
+        self.wait()
+        return self
+
+    def snapshot(self):
+        """Atomic (status, tokens, ttft_ms, latency_ms, error)."""
+        with self._cond:
+            return (self.status, tuple(self._tokens), self.ttft_ms,
+                    self.latency_ms, self.error)
+
+    def __iter__(self):
+        """Yield tokens as they arrive; stops when the stream is terminal
+        and drained.  Check ``status`` afterwards for why it ended."""
+        i = 0
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: len(self._tokens) > i or self.status is not None)
+                if len(self._tokens) <= i:
+                    return
+                tok = self._tokens[i]
+            i += 1
+            yield tok
+
+    def __repr__(self):
+        status, toks, ttft, lat, err = self.snapshot()
+        return ("DecodeStream(status=%s, tokens=%d%s%s)"
+                % (status, len(toks),
+                   ", ttft_ms=%.2f" % ttft if ttft is not None else "",
+                   ", error=%r" % err if err else ""))
+
+
+class _Seq:
+    """Engine-private per-slot state for one live sequence."""
+
+    __slots__ = ("stream", "seq_id", "position", "cur_token", "generated")
+
+    def __init__(self, stream):
+        self.stream = stream
+        self.seq_id = stream.seq_id
+        self.position = 0       # cache index the next K/V write lands at
+        self.cur_token = 0      # last emitted token (next step's input)
+        self.generated = 0
+
+
+class DecodeEngine:
+    """Continuous-batching decode loop over one decode-capable model."""
+
+    def __init__(self, model, name="decode", max_slots=8, block_size=8,
+                 num_blocks=None, max_prompt_len=16, max_new_tokens=32,
+                 max_queue=64, scheduling="continuous", width_blocks=None,
+                 warmup=True, breaker_threshold=5, breaker_backoff_ms=50.0,
+                 breaker_max_backoff_ms=2000.0):
+        if scheduling not in ("continuous", "static"):
+            raise ValueError("scheduling must be 'continuous' or 'static'")
+        self.name = name
+        self.model = model
+        self.scheduling = scheduling
+        self.max_slots = int(max_slots)
+        self.max_prompt_len = int(max_prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self._max_queue = int(max_queue)
+        max_total = self.max_prompt_len + self.max_new_tokens
+        if max_total > model.max_len:
+            raise ValueError(
+                "max_prompt_len + max_new_tokens = %d exceeds the model's "
+                "max_len %d" % (max_total, model.max_len))
+        # width ladder: page-table columns per decode signature.
+        # ``width_blocks`` overrides the powers-of-2 default — e.g.
+        # ``[engine.worst_case_width(...)]`` trades the narrow-width fast
+        # path for a single decode signature (and a scheduler-independent
+        # per-step cost; tools/serve_bench.py does exactly that)
+        max_width = self.worst_case_width(self.max_prompt_len,
+                                          self.max_new_tokens, block_size)
+        self._width_ladder = BucketLadder(max_width, width_blocks)
+        if self._width_ladder.max_batch < max_width:
+            raise ValueError("width_blocks %r cannot cover a worst-case "
+                             "sequence (%d blocks)"
+                             % (width_blocks, max_width))
+        self._prompt_ladder = BucketLadder(self.max_prompt_len)
+        if num_blocks is None:
+            # full occupancy at worst case: admission is then slot-bound
+            num_blocks = self.max_slots * max_width + 1
+        self._cache = PagedKVCache(model.num_layers, num_blocks, block_size,
+                                   model.num_heads, model.head_dim)
+        self._params = model.param_dict()
+        self.stats = DecodeStats(name)
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            backoff_s=breaker_backoff_ms / 1e3,
+            max_backoff_s=breaker_max_backoff_ms / 1e3)
+        self._prefill_cop = CachedOp(self._prefill_forward, self._params)
+        self._decode_cop = CachedOp(self._decode_forward, self._params)
+        retry = util.retry(attempts=_EXEC_ATTEMPTS, backoff=_EXEC_BACKOFF_S,
+                           on_retry=lambda exc, i: self.stats.on_retry())
+        self._prefill_exec = retry(self._prefill_once)
+        self._decode_exec = retry(self._decode_once)
+        self.warmup_report = None
+        if warmup:
+            self.warmup()
+        self._cond = threading.Condition()
+        # guarded by _cond: queue, slots, lifecycle flags; seq ids come
+        # from an itertools.count (atomic at the C level, no lock needed)
+        self._queue = deque()
+        self._slots = [None] * self.max_slots
+        self._running = True
+        self._closed = False
+        self._seq_counter = itertools.count()
+        self._thread = threading.Thread(
+            target=self._run, name="mx-decode-%s" % name, daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def worst_case_width(max_prompt_len, max_new_tokens, block_size):
+        """Page-table width (blocks) covering a worst-case sequence plus
+        the one-block write slack: a finished sequence's last token is
+        never written, but a mid-stream one landing exactly on a block
+        boundary needs the next block before its attention window does."""
+        return -(-(int(max_prompt_len) + int(max_new_tokens))
+                 // int(block_size)) + 1
+
+    # -- CachedOp forwards (NDArray in/out; pure jnp inside) -------------
+    def _prefill_forward(self, params, tokens, length, table, k_pool,
+                         v_pool):
+        from ...ndarray import NDArray
+        p = {n: a._data for n, a in params.items()}
+        logits, kp, vp = self.model.prefill_fn(
+            p, tokens._data, length._data, table._data, k_pool._data,
+            v_pool._data)
+        return [NDArray(logits), NDArray(kp), NDArray(vp)]
+
+    def _decode_forward(self, params, tokens, positions, tables, k_pool,
+                        v_pool):
+        from ...ndarray import NDArray
+        p = {n: a._data for n, a in params.items()}
+        logits, kp, vp = self.model.decode_fn(
+            p, tokens._data, positions._data, tables._data, k_pool._data,
+            v_pool._data)
+        return [NDArray(logits), NDArray(kp), NDArray(vp)]
+
+    # -- execution (retry envelope + fault point, like ServableModel) ---
+    def _prefill_once(self, tokens, length, table, k_pool, v_pool):
+        from ... import ndarray as nd
+        faults.fault_point("serving.predict", model=self.name)
+        with autograd.pause():
+            return self._prefill_cop(
+                self._params, nd.array(tokens, dtype="int32"),
+                nd.array(length, dtype="int32"),
+                nd.array(table, dtype="int32"), k_pool, v_pool)
+
+    def _decode_once(self, tokens, positions, tables, k_pool, v_pool):
+        from ... import ndarray as nd
+        faults.fault_point("serving.predict", model=self.name)
+        with autograd.pause():
+            return self._decode_cop(
+                self._params, nd.array(tokens, dtype="int32"),
+                nd.array(positions, dtype="int32"),
+                nd.array(tables, dtype="int32"), k_pool, v_pool)
+
+    # -- warmup ----------------------------------------------------------
+    def warmup(self):
+        """Precompile every prefill (prompt bucket) and decode (width
+        bucket) signature against throwaway pools.  Steady-state traffic
+        then never misses: ``cache_stats()`` must stay flat."""
+        before = self.cache_stats()["misses"]
+        k_pool, v_pool = self._cache.init_pools()
+        max_w = self._width_ladder.max_batch
+        n = 0
+        for lb in self._prompt_ladder:
+            toks = np.zeros((1, lb), np.int32)
+            outs = self._prefill_exec(toks, np.ones((1,), np.int32),
+                                      np.zeros((1, max_w), np.int32),
+                                      k_pool, v_pool)
+            k_pool, v_pool = outs[1], outs[2]
+            n += 1
+        for w in self._width_ladder:
+            outs = self._decode_exec(np.zeros((self.max_slots,), np.int32),
+                                     np.zeros((self.max_slots,), np.int32),
+                                     np.zeros((self.max_slots, w), np.int32),
+                                     k_pool, v_pool)
+            k_pool, v_pool = outs[1], outs[2]
+            n += 1
+        after = self.cache_stats()
+        self.warmup_report = {
+            "signatures": n,
+            "compiles": after["misses"] - before,
+            "cache": {"hits": after["hits"], "misses": after["misses"]},
+        }
+        return self.warmup_report
+
+    # -- admission (client threads) --------------------------------------
+    def submit(self, prompt, max_new_tokens=None, timeout_ms=None,
+               on_token=None):
+        """Submit one generation request; always returns a DecodeStream.
+
+        Rejections come back already terminal (OVERLOADED when the queue
+        or the KV block pool cannot take the stream, INVALID_INPUT for a
+        prompt outside the menu, UNAVAILABLE when the breaker is open or
+        the engine is stopped) — callers branch on ``status``, never on
+        exceptions, exactly like ModelServer.predict."""
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_tokens
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        try:
+            prompt = self._coerce_prompt(prompt)
+        except (TypeError, ValueError) as exc:
+            stream = DecodeStream(None, max_new_tokens, deadline,
+                                  stats=self.stats, on_token=on_token)
+            self.stats.on_invalid()
+            stream.complete(INVALID_INPUT, error=str(exc))
+            return stream
+        stream = DecodeStream(prompt, int(max_new_tokens), deadline,
+                              stats=self.stats, on_token=on_token)
+        with self._cond:
+            closed = self._closed
+        if closed:
+            self.stats.on_unavailable_rejected()
+            stream.complete(UNAVAILABLE, error="engine stopped")
+            return stream
+        problem = self._validate(prompt, int(max_new_tokens))
+        if problem is not None:
+            self.stats.on_invalid()
+            stream.complete(INVALID_INPUT, error=problem)
+            return stream
+        # breaker admission after validation (a request that can never
+        # execute must not consume the half-open probe slot)
+        decision = self.breaker.admit()
+        if decision == REJECT:
+            self.stats.on_unavailable_rejected()
+            snap = self.breaker.snapshot()
+            stream.complete(
+                UNAVAILABLE,
+                error="circuit open after %d consecutive failure(s); "
+                      "retry in <= %.0f ms" % (snap["consecutive_failures"],
+                                               snap["backoff_s"] * 1e3))
+            return stream
+        # KV admission: a stream's worst-case block count is reserved at
+        # JOIN time (so an admitted-to-a-slot sequence can always grow to
+        # completion — no mid-stream OOM, no eviction); admission itself
+        # sheds fast when the pool is exhausted (nothing free and
+        # unpromised: queueing more work could not make progress sooner)
+        stream.seq_id = next(self._seq_counter)
+        if self._cache.available_unreserved() <= 0:
+            admitted = "no-blocks"
+        else:
+            with self._cond:
+                if not self._running:
+                    admitted = "stopping"
+                elif len(self._queue) >= self._max_queue:
+                    admitted = "full"
+                else:
+                    self._queue.append(stream)
+                    self._cond.notify_all()
+                    admitted = True
+        if admitted is not True:
+            if decision == PROBE:
+                self.breaker.release_probe()
+            if admitted == "stopping":
+                self.stats.on_unavailable_rejected()
+                stream.complete(UNAVAILABLE, error="engine shutting down")
+            else:
+                self.stats.on_shed()
+                stream.complete(
+                    OVERLOADED,
+                    error=("admission queue full" if admitted == "full"
+                           else "no free KV blocks"))
+            return stream
+        stream.admitted = True
+        self.stats.on_admitted()
+        return stream
+
+    def generate(self, prompt, max_new_tokens=None, timeout_ms=None):
+        """Blocking convenience: submit + wait; returns the stream."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           timeout_ms=timeout_ms).result()
+
+    def _validate(self, prompt, max_new_tokens):
+        if not 1 <= len(prompt) <= self.max_prompt_len:
+            return ("prompt length %d outside [1, %d]"
+                    % (len(prompt), self.max_prompt_len))
+        if not 1 <= max_new_tokens <= self.max_new_tokens:
+            return ("max_new_tokens %d outside [1, %d]"
+                    % (max_new_tokens, self.max_new_tokens))
+        if prompt.min() < 0 or prompt.max() >= self.model.vocab_size:
+            return ("prompt token ids outside [0, %d)"
+                    % self.model.vocab_size)
+        need = self._cache.blocks_for_tokens(len(prompt) + max_new_tokens)
+        if need > self._cache.capacity():
+            # could NEVER join: reject now instead of starving in the queue
+            return ("stream needs %d KV blocks but the pool only has %d"
+                    % (need, self._cache.capacity()))
+        return None
+
+    @staticmethod
+    def _coerce_prompt(prompt):
+        arr = np.asarray(prompt)
+        if arr.ndim != 1 or arr.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token id "
+                             "sequence, got shape %s" % (arr.shape,))
+        if not np.issubdtype(arr.dtype, np.integer):
+            if not np.all(arr == np.floor(arr)):
+                raise ValueError("prompt token ids must be integers")
+        return arr.astype(np.int32)
+
+    # -- scheduler loop (worker thread) ----------------------------------
+    def _run(self):
+        try:
+            self._run_loop()
+        except BaseException as exc:
+            # the scheduler must never die silently: an exception escaping
+            # the narrow per-execution guards (a failed device fetch, a
+            # SimulatedCrash BaseException from a fault plan) would leave
+            # _running True and every waiter blocked forever, violating
+            # the "terminal state is a status, never a hang" contract.
+            # Close the engine, drain everything with the retryable
+            # status, then re-raise so the death stays observable — UNLESS
+            # stop() already closed and drained us: a worker tripping over
+            # its own freed KV state after a timed-out shutdown join is
+            # routine teardown, not news worth a thread traceback.
+            with self._cond:
+                already_closed = self._closed
+                self._closed = True
+                self._running = False
+            self._drain(error="decode worker died: %r" % (exc,))
+            if not already_closed:
+                raise
+
+    def _run_loop(self):
+        k_pool, v_pool = self._cache.init_pools()
+        while True:
+            with self._cond:
+                # idle only when queue AND slots are empty — nothing whose
+                # deadline could expire — and submit()/stop() both notify,
+                # so the timeout is pure liveness insurance, kept long to
+                # avoid burning 20 wakeups/s per idle engine
+                while self._running and not self._queue \
+                        and not any(self._slots):
+                    self._cond.wait(0.5)
+                if not self._running:
+                    return
+            self._expire()
+            for stream in self._claim_joiners():
+                k_pool, v_pool = self._prefill(stream, k_pool, v_pool)
+            with self._cond:
+                has_live = any(self._slots)
+            if has_live:
+                k_pool, v_pool = self._step(k_pool, v_pool)
+
+    def _expire(self):
+        """TIMEOUT queued and live streams whose deadline passed."""
+        now = time.monotonic()
+        with self._cond:
+            expired_q = [s for s in self._queue if s.expired(now)]
+            if expired_q:
+                self._queue = deque(s for s in self._queue
+                                    if not s.expired(now))
+            expired_live = [(i, seq) for i, seq in enumerate(self._slots)
+                            if seq is not None
+                            and seq.stream.expired(now)]
+            for i, _ in expired_live:
+                self._slots[i] = None
+        for s in expired_q:
+            self._cache.release(s.seq_id)
+            if s.complete(TIMEOUT, error="deadline before prefill"):
+                self.stats.on_result(TIMEOUT)
+        for _, seq in expired_live:
+            self._cache.free_seq(seq.seq_id)
+            if seq.stream.complete(TIMEOUT, error="deadline mid-stream"):
+                self.stats.on_result(TIMEOUT)
+
+    def _claim_joiners(self):
+        """Move queued streams into free slots (iteration-level join).
+
+        A stream joins only when its worst-case KV block count can be
+        reserved — a stream in a slot can then ALWAYS grow to completion
+        (no mid-stream OOM, no eviction).  Joins are strict FIFO: when the
+        head cannot reserve, nothing behind it jumps the line, so a big
+        request cannot be starved by a stream of small ones.  ``static``
+        scheduling (the bench baseline) only admits into an EMPTY batch
+        and then runs it to completion — the run-to-completion discipline
+        continuous batching replaces."""
+        with self._cond:
+            if self.scheduling == "static" and any(self._slots):
+                return []       # a static batch runs to completion first
+        joined = []
+        while True:
+            with self._cond:
+                free_slot = next((i for i in range(self.max_slots)
+                                  if self._slots[i] is None), None)
+                if free_slot is None or not self._queue:
+                    break
+                stream = self._queue[0]
+                blocks = self._cache.blocks_for_tokens(
+                    len(stream.prompt) + stream.max_new_tokens)
+                if not self._cache.reserve(stream.seq_id, blocks):
+                    break       # head waits for finishing sequences' blocks
+                self._queue.popleft()
+                self._slots[free_slot] = _Seq(stream)
+            joined.append(stream)
+        return joined
+
+    def _vacate(self, seq, status, error=None):
+        """Free the sequence's pages and complete its stream (the slot
+        entry was already cleared by the caller under ``_cond``)."""
+        self._cache.free_seq(seq.seq_id)
+        if seq.stream.complete(status, error=error):
+            self.stats.on_result(status)
+
+    def _fail_all(self, exc):
+        """A batch execution failed beyond the retry budget: fail every
+        live stream (the per-stream view of MicroBatcher's batch ERROR)."""
+        with self._cond:
+            live = [(i, seq) for i, seq in enumerate(self._slots)
+                    if seq is not None]
+            for i, _ in live:
+                self._slots[i] = None
+        for _, seq in live:
+            self._vacate(seq, ERROR, error=repr(exc))
+
+    def _prefill(self, stream, k_pool, v_pool):
+        """Run one joining request's prompt and emit its first token."""
+        seq = None
+        with self._cond:
+            for cand in self._slots:
+                if cand is not None and cand.stream is stream:
+                    seq = cand
+                    break
+        if seq is None:          # vacated between join and prefill
+            return k_pool, v_pool
+        prompt = stream.prompt
+        self._cache.ensure_capacity(seq.seq_id, len(prompt))
+        lb = self._prompt_ladder.bucket(len(prompt))
+        toks = np.zeros((1, lb), np.int32)
+        toks[0, :len(prompt)] = prompt
+        table = np.asarray(
+            [self._cache.table(seq.seq_id, self._width_ladder.max_batch)],
+            np.int32)
+        try:
+            outs = self._prefill_exec(toks,
+                                      np.asarray([len(prompt)], np.int32),
+                                      table, k_pool, v_pool)
+        except Exception as exc:
+            self.breaker.on_failure()
+            with self._cond:
+                for i, cand in enumerate(self._slots):
+                    if cand is seq:
+                        self._slots[i] = None
+            self._vacate(seq, ERROR, error=repr(exc))
+            return k_pool, v_pool
+        self.breaker.on_success()
+        logits = outs[0].asnumpy()[0]
+        token = int(np.argmax(logits))
+        seq.position = len(prompt)
+        seq.cur_token = token
+        seq.generated = 1
+        stream._emit(token)
+        # TTFT from SUBMISSION (queue wait included — the number a client
+        # experiences), taken from the stream's own record so snapshot and
+        # bench artifact report the same sample, not two timestamps
+        _, _, ttft, _, _ = stream.snapshot()
+        if ttft is None:        # emit raced a terminal claim
+            ttft = (time.monotonic() - stream.t_submit) * 1e3
+        self.stats.on_prefill(ttft)
+        self.stats.on_tokens(1)
+        self._maybe_finish(seq, token)
+        self.stats.on_idle(self._live_count(), self._cache.used())
+        return outs[1], outs[2]
+
+    def _maybe_finish(self, seq, token):
+        """OK-complete a sequence that hit EOS or its token budget."""
+        eos = getattr(self.model, "eos_id", None)
+        if seq.generated >= seq.stream.max_new_tokens or \
+                (eos is not None and token == eos):
+            with self._cond:
+                for i, cand in enumerate(self._slots):
+                    if cand is seq:
+                        self._slots[i] = None
+            self._vacate(seq, OK)
+            return True
+        return False
+
+    def _live_count(self):
+        with self._cond:
+            return sum(1 for s in self._slots if s is not None)
+
+    def _step(self, k_pool, v_pool):
+        """One fixed-shape decode iteration over every live slot."""
+        with self._cond:
+            slots = list(self._slots)
+        live = [seq for seq in slots if seq is not None]
+        if not live:
+            return k_pool, v_pool
+        # lazily grow page tables to cover this step's write index, then
+        # pick the smallest precompiled width covering the longest one
+        for seq in live:
+            self._cache.ensure_capacity(seq.seq_id, seq.position + 1)
+        max_tokens = max(seq.position + 1 for seq in live)
+        width = self._width_ladder.bucket(
+            self._cache.blocks_for_tokens(max_tokens))
+        tokens = np.zeros((self.max_slots,), np.int32)
+        positions = np.zeros((self.max_slots,), np.int32)
+        tables = np.zeros((self.max_slots, width), np.int32)
+        for i, seq in enumerate(slots):
+            if seq is None:
+                continue
+            tokens[i] = seq.cur_token
+            positions[i] = seq.position
+            tables[i] = self._cache.table(seq.seq_id, width)
+        t0 = time.monotonic()
+        try:
+            outs = self._decode_exec(tokens, positions, tables, k_pool,
+                                     v_pool)
+        except Exception as exc:
+            self.breaker.on_failure()
+            self._fail_all(exc)
+            return k_pool, v_pool
+        self.breaker.on_success()
+        logits = outs[0].asnumpy()
+        emitted = 0
+        for i, seq in enumerate(slots):
+            if seq is None:
+                continue
+            with self._cond:
+                if self._slots[i] is not seq:
+                    continue     # vacated mid-step (teardown race)
+            token = int(np.argmax(logits[i]))
+            seq.position += 1
+            seq.cur_token = token
+            seq.generated += 1
+            seq.stream._emit(token)
+            emitted += 1
+            self._maybe_finish(seq, token)
+        self.stats.on_step(len(live), emitted,
+                           (time.monotonic() - t0) * 1e3,
+                           self._cache.used())
+        return outs[1], outs[2]
+
+    # -- reference path ---------------------------------------------------
+    def generate_reference(self, prompt, max_new_tokens=None):
+        """Greedy-decode ``prompt`` one-request-at-a-time, bypassing the
+        scheduler: fresh private pools, the same CachedOp signatures the
+        live engine dispatches (batch ``[max_slots]`` with one live slot,
+        per-length width buckets).  This is the bitwise reference the
+        acceptance gate compares continuous-batched outputs against."""
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_tokens
+        prompt = self._coerce_prompt(prompt)
+        problem = self._validate(prompt, int(max_new_tokens))
+        if problem is not None:
+            raise MXNetError(problem)
+        bs = self._cache.block_size
+        k_pool, v_pool = self._cache.init_pools()
+        blocks = list(range(1, 1 + self._cache.blocks_for_tokens(
+            len(prompt) + int(max_new_tokens))))
+        have = self._cache.blocks_for_tokens(len(prompt))
+        lb = self._prompt_ladder.bucket(len(prompt))
+        toks = np.zeros((1, lb), np.int32)
+        toks[0, :len(prompt)] = prompt
+        max_w = self._width_ladder.max_batch
+        table = np.zeros((1, max_w), np.int32)
+        table[0, :have] = blocks[:have]
+        outs = self._prefill_exec(toks, np.asarray([len(prompt)], np.int32),
+                                  table, k_pool, v_pool)
+        k_pool, v_pool = outs[1], outs[2]
+        token = int(np.argmax(outs[0].asnumpy()[0]))
+        out_tokens = [token]
+        position = len(prompt)
+        eos = getattr(self.model, "eos_id", None)
+        while len(out_tokens) < int(max_new_tokens) and token != eos:
+            need = self._cache.blocks_for_tokens(position + 1)
+            have = max(have, need)
+            width = self._width_ladder.bucket(need)
+            tokens = np.zeros((self.max_slots,), np.int32)
+            positions = np.zeros((self.max_slots,), np.int32)
+            tables = np.zeros((self.max_slots, width), np.int32)
+            tokens[0] = token
+            positions[0] = position
+            tables[0, :have] = blocks[:have]
+            outs = self._decode_exec(tokens, positions, tables, k_pool,
+                                     v_pool)
+            k_pool, v_pool = outs[1], outs[2]
+            token = int(np.argmax(outs[0].asnumpy()[0]))
+            out_tokens.append(token)
+            position += 1
+        return np.asarray(out_tokens, np.int32)
+
+    # -- observability ----------------------------------------------------
+    def cache_stats(self):
+        """Merged per-signature compile-cache counters of the prefill and
+        decode CachedOps (``prefill|``/``decode|`` key prefixes)."""
+        merged = {}
+        hits = misses = 0
+        for prefix, cop in (("prefill", self._prefill_cop),
+                            ("decode", self._decode_cop)):
+            st = cop.cache_stats()
+            for sig, rec in st["signatures"].items():
+                merged["%s|%s" % (prefix, sig)] = dict(rec)
+            hits += st["hits"]
+            misses += st["misses"]
+        return {"signatures": merged, "hits": hits, "misses": misses,
+                "recompiles": misses}
+
+    def kv_stats(self):
+        return self._cache.stats()
+
+    def health(self):
+        return self.breaker.health()
+
+    def stats_snapshot(self):
+        """Full engine snapshot (the ``ModelServer.stats()`` analog)."""
+        snap = self.stats.snapshot()
+        cache = self.cache_stats()
+        snap["cache"] = {"hits": cache["hits"], "misses": cache["misses"],
+                         "recompiles": cache["recompiles"],
+                         "signatures": len(cache["signatures"])}
+        snap["warmup"] = self.warmup_report
+        snap["kv"] = self.kv_stats()
+        snap["health"] = self.breaker.health()
+        snap["breaker"] = self.breaker.snapshot()
+        with self._cond:
+            snap["queue_depth"] = len(self._queue)
+            snap["slots_live"] = sum(1 for s in self._slots if s is not None)
+        snap["scheduling"] = self.scheduling
+        return snap
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self):
+        """Tear down; every queued or live stream terminates with the
+        retryable UNAVAILABLE status and every KV block returns to the
+        pool — no waiter left hanging, allocated == freed after drain."""
+        with self._cond:
+            self._closed = True
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout=5)
+        self._drain(error="engine shutting down")
+
+    def _drain(self, error):
+        """Terminate every queued and live stream with UNAVAILABLE and
+        return their KV blocks; idempotent (first completion wins,
+        freeing an already-freed sequence is a no-op)."""
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            live = [seq for seq in self._slots if seq is not None]
+            self._slots = [None] * self.max_slots
+        for s in leftovers:
+            self._cache.release(s.seq_id)
+            if s.complete(UNAVAILABLE, error=error):
+                self.stats.on_result(UNAVAILABLE)
+        for seq in live:
+            self._vacate(seq, UNAVAILABLE, error=error)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

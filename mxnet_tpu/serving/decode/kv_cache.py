@@ -1,0 +1,181 @@
+"""Paged KV cache: a device-resident block pool + per-sequence page tables.
+
+The whole point of continuous batching collapses if KV memory is laid out
+``[max_slots, max_len, ...]``: every slot then pays for the longest possible
+sequence whether or not anything lives there, and the slot count — not the
+token count — caps concurrency.  Instead the cache is a flat pool of
+fixed-size **blocks** (``block_size`` tokens each), shared by every live
+sequence, with a per-sequence **page table** mapping logical token index
+``j`` to physical block ``table[j // block_size]``.  Memory then scales with
+*live tokens*: a 3-token sequence next to a 100-token one holds 1 block, not
+a max-length row.
+
+Two-level accounting (all host-side, one lock):
+
+* **reservation** — at admission the engine reserves the worst-case block
+  count for the whole stream (``prompt + max_new`` tokens).  ``reserve()``
+  refuses when the pool cannot cover every outstanding promise
+  (``free < reserved + n``) and the engine sheds the request with
+  OVERLOADED — the "no blocks free" admission check.  Reserving up front
+  means a sequence admitted once can ALWAYS grow: there is no mid-stream
+  out-of-memory, no eviction, no deadlock between growing sequences.
+* **allocation** — blocks are taken lazily (``grow()``), one at a time, as
+  generation actually crosses block boundaries, so ``used`` tracks live
+  tokens while the reservation only bounds the worst case.
+
+Block 0 is the **trash block**: dead decode slots in the fixed-shape step
+still execute and still scatter their (garbage) K/V somewhere — they all
+point at block 0, which is never allocated to a sequence, so a dead slot can
+never contaminate a live stream's pages.
+
+The device half (``init_pools``) is a pair of zeros arrays
+``[num_layers, num_blocks, block_size, num_heads, head_dim]`` for K and V.
+The pools are threaded *functionally* through the decode CachedOps (inputs
+-> updated outputs) and the engine worker swaps the handles each step; this
+object never holds them, so the accounting lock is never held across an XLA
+call.  Thread-safe: every mutable field is guarded by ``_lock``
+(docs/CONCURRENCY.md).
+"""
+from __future__ import annotations
+
+import threading
+
+from ...base import MXNetError
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    def __init__(self, num_layers, num_blocks, block_size, num_heads,
+                 head_dim, dtype="float32"):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the trash block)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        self._lock = threading.Lock()
+        # LIFO free list over allocatable ids 1..num_blocks-1 (0 = trash)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._tables = {}        # seq_id -> [block ids, logical order]
+        self._reservations = {}  # seq_id -> blocks promised but not taken
+        self._reserved = 0       # sum of _reservations values
+        self._allocated_total = 0
+        self._freed_total = 0
+        self._peak_used = 0
+
+    # -- device half ----------------------------------------------------
+    def pool_shape(self):
+        return (self.num_layers, self.num_blocks, self.block_size,
+                self.num_heads, self.head_dim)
+
+    def init_pools(self):
+        """Fresh zeroed (k_pool, v_pool) NDArray pair."""
+        from ... import ndarray as nd
+        shape = self.pool_shape()
+        return nd.zeros(shape, dtype=self.dtype), \
+            nd.zeros(shape, dtype=self.dtype)
+
+    # -- host accounting ------------------------------------------------
+    def blocks_for_tokens(self, n_tokens):
+        """Blocks covering ``n_tokens`` logical positions."""
+        return max(1, -(-int(n_tokens) // self.block_size))
+
+    def reserve(self, seq_id, n_blocks):
+        """Promise ``n_blocks`` to ``seq_id``; False when the pool cannot
+        honor every outstanding promise (the admission shed signal)."""
+        n_blocks = int(n_blocks)
+        with self._lock:
+            if seq_id in self._reservations or seq_id in self._tables:
+                raise MXNetError("sequence %r already holds KV state"
+                                 % (seq_id,))
+            if len(self._free) - self._reserved < n_blocks:
+                return False
+            self._reservations[seq_id] = n_blocks
+            self._reserved += n_blocks
+            return True
+
+    def grow(self, seq_id):
+        """Convert one reserved block into an allocated page; returns the
+        block id (appended to the sequence's page table)."""
+        with self._lock:
+            remaining = self._reservations.get(seq_id, 0)
+            if remaining < 1:
+                raise MXNetError("sequence %r grew past its reservation"
+                                 % (seq_id,))
+            block = self._free.pop()
+            self._reservations[seq_id] = remaining - 1
+            self._reserved -= 1
+            self._tables.setdefault(seq_id, []).append(block)
+            self._allocated_total += 1
+            used = (self.num_blocks - 1) - len(self._free)
+            if used > self._peak_used:
+                self._peak_used = used
+            return block
+
+    def ensure_capacity(self, seq_id, n_tokens):
+        """Grow ``seq_id`` until its table covers ``n_tokens`` positions."""
+        need = self.blocks_for_tokens(n_tokens)
+        with self._lock:
+            have = len(self._tables.get(seq_id, ()))
+        while have < need:
+            self.grow(seq_id)
+            have += 1
+
+    def release(self, seq_id):
+        """Drop the unconverted remainder of a reservation (request never
+        joined, or finished early)."""
+        with self._lock:
+            self._reserved -= self._reservations.pop(seq_id, 0)
+
+    def free_seq(self, seq_id):
+        """Return every block of ``seq_id`` to the pool and drop any
+        remaining reservation; returns the number of blocks freed."""
+        with self._lock:
+            blocks = self._tables.pop(seq_id, [])
+            self._free.extend(reversed(blocks))
+            self._freed_total += len(blocks)
+            self._reserved -= self._reservations.pop(seq_id, 0)
+            return len(blocks)
+
+    def table(self, seq_id, width):
+        """The sequence's page table padded to ``width`` entries with the
+        trash block (0); entries past the live length are never unmasked."""
+        with self._lock:
+            blocks = list(self._tables.get(seq_id, ()))
+        if len(blocks) > width:
+            raise MXNetError("page table of %r (%d blocks) exceeds width %d"
+                             % (seq_id, len(blocks), width))
+        return blocks + [0] * (width - len(blocks))
+
+    def used(self):
+        with self._lock:
+            return (self.num_blocks - 1) - len(self._free)
+
+    def available_unreserved(self):
+        """Blocks neither allocated nor promised (the admission signal)."""
+        with self._lock:
+            return len(self._free) - self._reserved
+
+    def capacity(self):
+        """Total allocatable blocks (trash block excluded)."""
+        return self.num_blocks - 1
+
+    def stats(self):
+        with self._lock:
+            used = (self.num_blocks - 1) - len(self._free)
+            return {
+                "num_blocks": self.num_blocks - 1,   # allocatable
+                "block_size": self.block_size,
+                "used": used,
+                "free": len(self._free),
+                "reserved": self._reserved,
+                "live_sequences": len(self._tables),
+                "allocated_total": self._allocated_total,
+                "freed_total": self._freed_total,
+                "peak_used": self._peak_used,
+            }

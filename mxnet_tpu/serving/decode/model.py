@@ -1,0 +1,171 @@
+"""Decode-capable model contract + a self-contained reference LM.
+
+The decode engine does not wrap arbitrary Gluon blocks: an autoregressive
+step needs the model to read and write *paged* KV state, which is a
+different calling convention from a stateless batch forward.  A decode
+model is any object exposing:
+
+* ``vocab_size`` / ``num_layers`` / ``num_heads`` / ``head_dim`` /
+  ``max_len`` attributes (the KV pool geometry comes from these);
+* ``param_dict()`` -> ``{name: NDArray}`` — live parameter handles, passed
+  straight into the engine's CachedOps;
+* ``prefill_fn(params, tokens, length, table, k_pool, v_pool)`` — jax
+  arrays in, jax arrays out: tokens ``[1, Lb]`` int32 (padded to a prompt
+  bucket), length ``[1]`` int32 (the real prompt length), table ``[1, W]``
+  int32 page table.  Runs the whole prompt in one causal pass, scatters
+  every position's K/V into the sequence's pages, and returns
+  ``(logits [1, V] for position length-1, k_pool', v_pool')``;
+* ``decode_fn(params, tokens, positions, tables, k_pool, v_pool)`` — one
+  token per slot: tokens ``[S]`` int32, positions ``[S]`` int32 (the cache
+  index the new token's K/V lands at), tables ``[S, W]`` int32.  Returns
+  ``(logits [S, V], k_pool', v_pool')``.
+
+Both functions must be jax-traceable with **shape-only** signatures (no
+data-dependent Python control flow): the engine compiles one CachedOp
+signature per (prompt bucket) and per (table width bucket) and steady-state
+traffic must never add another.
+
+Exactness contract (the bitwise gate in tests/test_decode.py leans on it):
+dead slots and page-table padding use masks whose excluded weights are
+EXACTLY zero (``exp(-inf) == 0``), and every per-slot computation is
+row-independent — so a slot's logits are bit-identical whether its
+neighbors are live, dead, or absent, and whatever table width bucket the
+scheduler picked.  ``TinyCausalLM`` is the in-tree reference
+implementation: a small pre-norm transformer (learned positions, weight-
+tied unembedding) used by the tests, the chaos scenarios, and
+``tools/serve_bench.py --profile decode``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["TinyCausalLM"]
+
+
+def _rms(x):
+    import jax.numpy as jnp
+    return x / jnp.sqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+                        + 1e-6)
+
+
+class TinyCausalLM:
+    """Small causal transformer LM with paged-KV prefill/decode kernels."""
+
+    def __init__(self, vocab_size=48, hidden=32, num_layers=2, num_heads=2,
+                 max_len=128, seed=0, eos_id=None):
+        if hidden % num_heads:
+            raise ValueError("hidden must divide into num_heads")
+        self.vocab_size = int(vocab_size)
+        self.hidden = int(hidden)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.hidden // self.num_heads
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        from ... import ndarray as nd
+        rng = np.random.RandomState(seed)
+        scale = 1.0 / np.sqrt(self.hidden)
+
+        def w(*shape):
+            return nd.array(rng.randn(*shape).astype(np.float32) * scale)
+
+        params = {"embed": w(self.vocab_size, self.hidden),
+                  "pos": w(self.max_len, self.hidden)}
+        for l in range(self.num_layers):
+            params["l%d_wq" % l] = w(self.hidden, self.hidden)
+            params["l%d_wk" % l] = w(self.hidden, self.hidden)
+            params["l%d_wv" % l] = w(self.hidden, self.hidden)
+            params["l%d_wo" % l] = w(self.hidden, self.hidden)
+            params["l%d_w1" % l] = w(self.hidden, 2 * self.hidden)
+            params["l%d_w2" % l] = w(2 * self.hidden, self.hidden)
+        self._params = params
+
+    def param_dict(self):
+        return dict(self._params)
+
+    # ------------------------------------------------------------------
+    def _qkv(self, p, l, x, n_rows):
+        h, d = self.num_heads, self.head_dim
+        q = (x @ p["l%d_wq" % l]).reshape(n_rows, h, d)
+        k = (x @ p["l%d_wk" % l]).reshape(n_rows, h, d)
+        v = (x @ p["l%d_wv" % l]).reshape(n_rows, h, d)
+        return q, k, v
+
+    def _mlp(self, p, l, h):
+        import jax
+        return h + jax.nn.gelu(_rms(h) @ p["l%d_w1" % l]) @ p["l%d_w2" % l]
+
+    def prefill_fn(self, p, tokens, length, table, k_pool, v_pool):
+        """Causal pass over one padded prompt; scatters K/V into pages."""
+        import jax.numpy as jnp
+        bs = k_pool.shape[2]
+        L = tokens.shape[1]
+        t = tokens[0]
+        h = p["embed"][t] + p["pos"][:L]                       # [L, H]
+        idx = jnp.arange(L)
+        blk = table[0, idx // bs]
+        off = idx % bs
+        # causal mask: position i attends j <= i; prompt padding sits at
+        # j >= length > i for every real row, so it is excluded for free
+        causal = idx[None, :] <= idx[:, None]                  # [L, L]
+        for l in range(self.num_layers):
+            q, k, v = self._qkv(p, l, _rms(h), L)
+            # pad-row K/V lands in the trash block / the tail of the
+            # sequence's own last block — positions the attention mask
+            # never admits before a decode write overwrites them
+            k_pool = k_pool.at[l, blk, off].set(k)
+            v_pool = v_pool.at[l, blk, off].set(v)
+            scores = jnp.einsum("ihd,jhd->hij", q, k) \
+                / jnp.sqrt(float(self.head_dim)).astype(q.dtype)
+            scores = jnp.where(causal[None], scores, -jnp.inf)
+            w = _softmax(scores)
+            att = jnp.einsum("hij,jhd->ihd", w, v).reshape(L, self.hidden)
+            h = h + att @ p["l%d_wo" % l]
+            h = self._mlp(p, l, h)
+        last = _rms(h[length[0] - 1])
+        logits = last @ p["embed"].T
+        return logits[None], k_pool, v_pool
+
+    def decode_fn(self, p, tokens, positions, tables, k_pool, v_pool):
+        """One fixed-shape decode step for every slot (live or dead)."""
+        import jax.numpy as jnp
+        bs = k_pool.shape[2]
+        S = tokens.shape[0]
+        W = tables.shape[1]
+        T = W * bs
+        srow = jnp.arange(S)
+        h = p["embed"][tokens] + p["pos"][positions]           # [S, H]
+        blk = tables[srow, positions // bs]
+        off = positions % bs
+        # valid cache positions: 0..positions[s] inclusive (the new token
+        # attends to itself); excluded weights are EXACTLY zero, so table
+        # padding and stale pool contents cannot perturb live slots
+        mask = jnp.arange(T)[None, :] <= positions[:, None]    # [S, T]
+        for l in range(self.num_layers):
+            q, k, v = self._qkv(p, l, _rms(h), S)
+            k_pool = k_pool.at[l, blk, off].set(k)
+            v_pool = v_pool.at[l, blk, off].set(v)
+            kseq = k_pool[l][tables].reshape(S, T, self.num_heads,
+                                             self.head_dim)
+            vseq = v_pool[l][tables].reshape(S, T, self.num_heads,
+                                             self.head_dim)
+            scores = jnp.einsum("shd,sthd->sht", q, kseq) \
+                / jnp.sqrt(float(self.head_dim)).astype(q.dtype)
+            scores = jnp.where(mask[:, None, :], scores, -jnp.inf)
+            w = _softmax(scores)
+            att = jnp.einsum("sht,sthd->shd", w, vseq).reshape(
+                S, self.hidden)
+            h = h + att @ p["l%d_wo" % l]
+            h = self._mlp(p, l, h)
+        logits = _rms(h) @ p["embed"].T
+        return logits, k_pool, v_pool
+
+
+def _softmax(scores):
+    """Max-shifted softmax over the last axis with exact-zero masking:
+    ``exp(-inf - finite_max) == 0`` exactly, so masked positions contribute
+    nothing to the normalizer regardless of the padded width."""
+    import jax.numpy as jnp
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    return e / jnp.sum(e, axis=-1, keepdims=True)

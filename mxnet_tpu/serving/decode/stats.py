@@ -1,0 +1,147 @@
+"""Decode-engine observability: per-engine counters + latency windows.
+
+Same two-sink design as ``serving/stats.py``: always-on numeric fields
+behind one lock for ``DecodeEngine.stats()``, plus profiler Counters on the
+``serving`` Domain — gated on ``profiler.profiling_active()`` — so a trace
+shows the decode loop's occupancy next to op spans:
+
+* ``<engine>:live_seqs``      — sequences in decode slots after each step
+* ``<engine>:kv_blocks_used`` — allocated KV pool blocks after each step
+* ``<engine>:ttft_ms``        — time-to-first-token of each prefill
+* ``<engine>:tokens_per_s``   — instantaneous decode throughput per step
+
+Conservation contract (the chaos scenario's invariant): ``requests`` counts
+ADMITTED streams and every one of them reaches exactly one terminal
+counter, so ``requests == ok + timeouts + errors + unavailable``; ``shed``,
+``invalid`` and ``unavailable_rejected`` count fast rejections that never
+enter ``requests`` (the same split as ``ModelStats``).
+"""
+from __future__ import annotations
+
+import threading
+
+from ... import profiler
+from ..stats import LatencyWindow
+
+__all__ = ["DecodeStats"]
+
+
+class DecodeStats:
+    """All counters for one decode engine.  Thread-safe."""
+
+    def __init__(self, engine_name):
+        self._lock = threading.Lock()
+        self.requests = 0            # admitted streams
+        self.ok = 0
+        self.timeouts = 0
+        self.errors = 0
+        self.unavailable = 0         # admitted, terminated by teardown
+        self.shed = 0                # rejected: queue/KV pool full
+        self.invalid = 0             # rejected: prompt outside the menu
+        self.unavailable_rejected = 0  # rejected: breaker open / closed
+        self.retries = 0             # transient execute failures absorbed
+        self.prefills = 0
+        self.steps = 0               # decode iterations executed
+        self.tokens_out = 0          # tokens emitted across all streams
+        self.step_slot_sum = 0       # live slots summed over steps
+        self.live_seqs = 0
+        self.kv_blocks_used = 0
+        self._ttft = LatencyWindow()
+        self._step_ms = LatencyWindow()
+        domain = profiler.Domain("serving")
+        self._c_live = domain.new_counter("%s:live_seqs" % engine_name)
+        self._c_blocks = domain.new_counter("%s:kv_blocks_used" % engine_name)
+        self._c_ttft = domain.new_counter("%s:ttft_ms" % engine_name)
+        self._c_tps = domain.new_counter("%s:tokens_per_s" % engine_name)
+
+    # -- event hooks ----------------------------------------------------
+    def on_admitted(self):
+        with self._lock:
+            self.requests += 1
+
+    def on_shed(self):
+        with self._lock:
+            self.shed += 1
+
+    def on_invalid(self):
+        with self._lock:
+            self.invalid += 1
+
+    def on_unavailable_rejected(self):
+        with self._lock:
+            self.unavailable_rejected += 1
+
+    def on_retry(self):
+        with self._lock:
+            self.retries += 1
+
+    def on_prefill(self, ttft_ms):
+        with self._lock:
+            self.prefills += 1
+            self._ttft.add(ttft_ms)
+        if profiler.profiling_active():
+            self._c_ttft.set_value(ttft_ms)
+
+    def on_step(self, live, tokens_emitted, step_ms, kv_blocks_used):
+        with self._lock:
+            self.steps += 1
+            self.step_slot_sum += live
+            self.tokens_out += tokens_emitted
+            self.live_seqs = live
+            self.kv_blocks_used = kv_blocks_used
+            self._step_ms.add(step_ms)
+        if profiler.profiling_active():
+            self._c_live.set_value(live)
+            self._c_blocks.set_value(kv_blocks_used)
+            if step_ms > 0:
+                self._c_tps.set_value(tokens_emitted / (step_ms / 1e3))
+
+    def on_tokens(self, n):
+        """Tokens emitted outside a decode step (the prefill's first)."""
+        with self._lock:
+            self.tokens_out += n
+
+    def on_idle(self, live, kv_blocks_used):
+        """Occupancy update without a step (join/finish bookkeeping)."""
+        with self._lock:
+            self.live_seqs = live
+            self.kv_blocks_used = kv_blocks_used
+        if profiler.profiling_active():
+            self._c_live.set_value(live)
+            self._c_blocks.set_value(kv_blocks_used)
+
+    def on_result(self, status):
+        from ..server import OK, TIMEOUT, ERROR, UNAVAILABLE
+        with self._lock:
+            if status == OK:
+                self.ok += 1
+            elif status == TIMEOUT:
+                self.timeouts += 1
+            elif status == ERROR:
+                self.errors += 1
+            elif status == UNAVAILABLE:
+                self.unavailable += 1
+
+    # -- snapshot -------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "ok": self.ok,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "unavailable": self.unavailable,
+                "shed": self.shed,
+                "invalid": self.invalid,
+                "unavailable_rejected": self.unavailable_rejected,
+                "retries": self.retries,
+                "prefills": self.prefills,
+                "steps": self.steps,
+                "tokens_out": self.tokens_out,
+                "avg_live_slots": (self.step_slot_sum / self.steps
+                                   if self.steps else 0.0),
+                "live_seqs": self.live_seqs,
+                "kv_blocks_used": self.kv_blocks_used,
+                "ttft_ms": self._ttft.percentiles(ps=(50, 95, 99)),
+                "step_ms": self._step_ms.percentiles(ps=(50, 95, 99)),
+            }

@@ -12,8 +12,10 @@ on top (mxnet_tpu.faults; docs/ROBUSTNESS.md): serving storms under
 transient/fatal predict faults (request conservation incl. UNAVAILABLE,
 breaker opens and re-closes) and checkpoint saves killed at every write/
 replace/manifest fault point (restore always finds the newest complete
-checkpoint, bit-exact).  Exit code is non-zero iff any seed violated any
-invariant.
+checkpoint, bit-exact).  The ``decode`` scenario storms the
+continuous-batching decode engine: stream conservation, bitwise/prefix
+token integrity, KV-block accounting, zero steady-state recompiles, no
+deadlock.  Exit code is non-zero iff any seed violated any invariant.
 
 Usage:
   python tools/mxstress.py --smoke              # 25 fixed seeds, <=10 s

@@ -1,16 +1,25 @@
 #!/usr/bin/env python
 """serve_bench — load generator for mxnet_tpu.serving.
 
-Serves a small shape-polymorphic Gluon MLP (mean over a variable-length
-axis, then two Dense layers) under concurrent closed-loop clients firing a
-mixed-shape workload, and reports throughput, per-request latency
-percentiles, status counts, batching efficiency, and the compile-cache
-delta (which must be zero after warmup) to a BENCH_SERVE.json-style
-artifact.
+Two load profiles:
+
+* ``--profile batch`` (default) — the one-shot inference path: a small
+  shape-polymorphic Gluon MLP under concurrent closed-loop clients firing
+  a mixed-shape workload; reports throughput, latency percentiles, status
+  counts, batching efficiency, and the compile-cache delta (which must be
+  zero after warmup) to a BENCH_SERVE.json-style artifact.
+* ``--profile decode`` — the autoregressive path: hundreds of concurrent
+  token streams with mixed prompt/output lengths through the continuous-
+  batching DecodeEngine (serving/decode/), then the SAME workload through
+  run-to-completion ("static") batching at equal slot count; reports token
+  throughput, p50/p99 time-to-first-token, KV pool peak/leak, the
+  steady-state recompile count, and the continuous-vs-static speedup to a
+  BENCH_DECODE.json artifact.
 
 Usage:
-  python tools/serve_bench.py                       # full run
-  python tools/serve_bench.py --smoke               # fast tier-1 smoke
+  python tools/serve_bench.py                        # full batch run
+  python tools/serve_bench.py --profile decode       # full decode run
+  python tools/serve_bench.py --smoke [--profile decode]  # tier-1 smokes
   python tools/serve_bench.py --clients 16 --requests 64 --out bench.json
 """
 from __future__ import annotations
@@ -124,8 +133,122 @@ def run_bench(clients, requests_per_client, shapes, max_batch, linger_ms,
     }
 
 
+def run_decode_bench(streams, slots, block_size, max_prompt, max_new, seed,
+                     model_cfg):
+    """Mixed prompt/output-length stream workload, continuous vs static.
+
+    Both runs see the IDENTICAL stream list (same seeded prompts, same
+    per-stream token budgets) on engines with equal slot counts; the only
+    difference is the scheduler — iteration-level join/leave vs
+    run-to-completion batches — so the speedup isolates continuous
+    batching itself.  Two workload/config choices keep the comparison
+    honest on that axis: output lengths are bimodal (mostly short, a
+    long tail — the production mix run-to-completion batching handles
+    worst), and both engines run a SINGLE attention-width signature so a
+    decode step costs the same under either scheduler (the bucketed
+    width ladder would otherwise hand the static leg a discount: its
+    age-aligned batches ride the narrow rungs together).
+    """
+    from mxnet_tpu.serving.decode import DecodeEngine, TinyCausalLM
+
+    model = TinyCausalLM(**model_cfg)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, model.vocab_size,
+                           rng.randint(1, max_prompt + 1)).tolist()
+               for _ in range(streams)]
+    budgets = [int(rng.randint(max(2, max_new * 2 // 3), max_new + 1))
+               if rng.random() < 0.2
+               else int(rng.randint(2, max(3, max_new // 4)))
+               for _ in range(streams)]
+    max_width = DecodeEngine.worst_case_width(max_prompt, max_new,
+                                              block_size)
+
+    def one(scheduling):
+        t0 = time.monotonic()
+        engine = DecodeEngine(model, name="bench-decode", max_slots=slots,
+                              block_size=block_size,
+                              max_prompt_len=max_prompt,
+                              max_new_tokens=max_new, max_queue=streams,
+                              width_blocks=[max_width],
+                              scheduling=scheduling)
+        warmup_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        handles = [engine.submit(p, max_new_tokens=m)
+                   for p, m in zip(prompts, budgets)]
+        tokens = 0
+        ttfts = []
+        statuses = {}
+        for h in handles:
+            h.wait()
+            statuses[h.status] = statuses.get(h.status, 0) + 1
+            tokens += len(h.tokens())
+            if h.ttft_ms is not None:
+                ttfts.append(h.ttft_ms)
+        wall = time.monotonic() - t0
+        snap = engine.stats_snapshot()
+        kv = engine.kv_stats()
+        engine.stop()
+        # same nearest-rank estimator the engine's stats_snapshot()
+        # reports, so artifact and snapshot agree on what "p99" means
+        from mxnet_tpu.serving.stats import LatencyWindow
+        window = LatencyWindow(capacity=max(1, len(ttfts)))
+        for ms in ttfts:
+            window.add(ms)
+        pcts = {k: round(v, 3)
+                for k, v in window.percentiles(ps=(50, 99)).items()}
+        return {
+            "scheduling": scheduling,
+            "warmup_s": round(warmup_s, 3),
+            "wall_s": round(wall, 3),
+            "tokens_out": tokens,
+            "tokens_per_s": round(tokens / wall, 1) if wall else 0.0,
+            "ttft_ms": pcts,
+            "statuses": statuses,
+            "prefills": snap["prefills"],
+            "steps": snap["steps"],
+            "avg_live_slots": round(snap["avg_live_slots"], 2),
+            "steady_state_recompiles": (snap["cache"]["recompiles"]
+                                        - snap["warmup"]["cache"]["misses"]),
+            "kv_peak_blocks": kv["peak_used"],
+            "kv_leaked_blocks": kv["allocated_total"] - kv["freed_total"],
+        }
+
+    continuous = one("continuous")
+    static = one("static")
+    speedup = (continuous["tokens_per_s"] / static["tokens_per_s"]
+               if static["tokens_per_s"] else 0.0)
+    return {
+        "profile": "decode",
+        "workload": {
+            "streams": streams,
+            "slots": slots,
+            "block_size": block_size,
+            "max_prompt_len": max_prompt,
+            "max_new_tokens": max_new,
+            "seed": seed,
+            "model": dict(model_cfg),
+        },
+        "continuous": continuous,
+        "static": static,
+        "speedup_tokens_per_s": round(speedup, 3),
+    }
+
+
+def _decode_ok(report):
+    """Exit gate for the decode profile: zero steady-state recompiles,
+    zero leaked KV blocks, every stream OK, on BOTH schedulers."""
+    for leg in (report["continuous"], report["static"]):
+        if leg["steady_state_recompiles"] != 0 or leg["kv_leaked_blocks"]:
+            return False
+        if set(leg["statuses"]) != {"OK"}:
+            return False
+    return True
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="serve_bench", description=__doc__)
+    ap.add_argument("--profile", choices=("batch", "decode"),
+                    default="batch")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=40,
                     help="requests per client")
@@ -135,10 +258,57 @@ def main(argv=None):
     ap.add_argument("--linger-ms", type=float, default=2.0)
     ap.add_argument("--timeout-ms", type=float, default=5000.0)
     ap.add_argument("--max-queue", type=int, default=1024)
-    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SERVE.json"))
+    ap.add_argument("--streams", type=int, default=192,
+                    help="[decode] concurrent token streams")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="[decode] decode batch slots")
+    ap.add_argument("--block-size", type=int, default=8,
+                    help="[decode] KV cache block size (tokens)")
+    ap.add_argument("--max-prompt", type=int, default=16,
+                    help="[decode] max prompt length")
+    ap.add_argument("--max-new", type=int, default=96,
+                    help="[decode] max generated tokens per stream")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default BENCH_SERVE.json / "
+                         "BENCH_DECODE.json by profile)")
     ap.add_argument("--smoke", action="store_true",
                     help="small fast run for tier-1 (overrides sizes)")
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = os.path.join(
+            REPO, "BENCH_DECODE.json" if args.profile == "decode"
+            else "BENCH_SERVE.json")
+
+    if args.profile == "decode":
+        if args.smoke:
+            # 4 prefill + 1 (pinned) width signature per engine: cheap on
+            # 1-core CI
+            args.streams, args.slots = 16, 4
+            args.block_size, args.max_prompt, args.max_new = 4, 8, 12
+            model_cfg = dict(vocab_size=32, hidden=16, num_layers=1,
+                             num_heads=2, max_len=32, seed=7)
+        else:
+            model_cfg = dict(vocab_size=48, hidden=32, num_layers=2,
+                             num_heads=2, max_len=128, seed=7)
+        report = run_decode_bench(args.streams, args.slots, args.block_size,
+                                  args.max_prompt, args.max_new, args.seed,
+                                  model_cfg)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        c, s = report["continuous"], report["static"]
+        print("continuous: %s tok/s  ttft p50/p99: %s/%s ms  avg_live: %s"
+              % (c["tokens_per_s"], c["ttft_ms"]["p50"], c["ttft_ms"]["p99"],
+                 c["avg_live_slots"]))
+        print("static:     %s tok/s  ttft p50/p99: %s/%s ms  avg_live: %s"
+              % (s["tokens_per_s"], s["ttft_ms"]["p50"], s["ttft_ms"]["p99"],
+                 s["avg_live_slots"]))
+        print("speedup: %sx  steady-state recompiles: %d/%d  wrote %s"
+              % (report["speedup_tokens_per_s"],
+                 c["steady_state_recompiles"], s["steady_state_recompiles"],
+                 args.out))
+        return 0 if _decode_ok(report) else 1
 
     if args.smoke:
         args.clients, args.requests = 4, 6
