@@ -527,26 +527,81 @@ print("PROBE_OK %s %d" % (devs[0].platform, len(devs)))
 """
 
 
+# probe-failure taxonomy (round-6 hardening): BENCH_r05 burned its budget
+# on 13/13 failed probes with stderr discarded, leaving WHY undiagnosable.
+# Probes now capture stderr and every failure is classified into one of
+# these, tallied into BENCH_FAILURE.json:
+#   timeout   — the probe subprocess hung in native code and was killed
+#               (the classic down-relay signature)
+#   connect   — transport-level failure (refused / unreachable / DNS /
+#               socket / tunnel) in the probe's stderr
+#   http      — the relay endpoint answered, but with an HTTP-level error
+#               (bad gateway / service unavailable / status code)
+#   backend   — the probe process ran and raised inside backend init
+#               (a stderr traceback that is none of the above)
+#   no-output — exited without PROBE_OK and with nothing on stderr
+_PROBE_FAILURE_CLASSES = ("timeout", "connect", "http", "backend",
+                          "no-output")
+
+_CONNECT_MARKERS = ("connection refused", "connection reset", "unreachable",
+                    "no route to host", "getaddrinfo",
+                    "name or service not known",
+                    "temporary failure in name resolution",
+                    "failed to connect", "connect failed", "socket error",
+                    "broken pipe", "tunnel", "deadline exceeded")
+_HTTP_MARKERS = ("http error", "status code", "bad gateway",
+                 "service unavailable", "gateway timeout", "http/1.",
+                 " 502", " 503", " 504", " 404")
+
+
+def _classify_probe_failure(timed_out, returncode, out, err):
+    """(class, detail) for one failed backend probe — pure, testable.
+
+    ``detail`` is the last non-empty stderr line (capped), the most
+    specific human-readable evidence the probe left behind."""
+    err = err or ""
+    lines = [ln.strip() for ln in err.splitlines() if ln.strip()]
+    detail = lines[-1][:300] if lines else ""
+    if timed_out:
+        return "timeout", "probe subprocess hung in backend init (killed)"
+    low = err.lower()
+    if any(marker in low for marker in _CONNECT_MARKERS):
+        return "connect", detail
+    if any(marker in low for marker in _HTTP_MARKERS):
+        return "http", detail
+    if detail:
+        return "backend", detail
+    stray = (out or "").strip()
+    if stray:
+        return "no-output", ("no PROBE_OK line; stdout was: %r"
+                             % stray[:200])
+    return "no-output", "probe exited rc=%s silently" % returncode
+
+
 def _probe_backend(timeout_s):
     """Cheap disposable check that backend init returns at all.
 
-    A down axon relay hangs jax.devices() forever inside native code, so the
-    probe must be its own subprocess that the parent can kill.  Returns the
-    platform string, or None if the probe hung/failed."""
+    A down axon relay hangs jax.devices() forever inside native code, so
+    the probe must be its own subprocess that the parent can kill.
+    Returns ``(platform, None)`` on success or ``(None, failure)`` where
+    ``failure`` is a ``{"class", "detail"}`` record (see
+    ``_classify_probe_failure``)."""
     import subprocess
     proc = subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
                             stdout=subprocess.PIPE,
-                            stderr=subprocess.DEVNULL, text=True)
+                            stderr=subprocess.PIPE, text=True)
     try:
-        out, _ = proc.communicate(timeout=timeout_s)
+        out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.communicate()
-        return None
+        cls, detail = _classify_probe_failure(True, None, "", "")
+        return None, {"class": cls, "detail": detail}
     for line in out.splitlines():
         if line.startswith("PROBE_OK"):
-            return line.split()[1]
-    return None
+            return line.split()[1], None
+    cls, detail = _classify_probe_failure(False, proc.returncode, out, err)
+    return None, {"class": cls, "detail": detail}
 
 
 def _fail_artifact_path():
@@ -623,23 +678,30 @@ def _watchdog():
     probes = failed_probes = attempts = 0
     last_err = "no attempt made"
     last_platform = None
+    probe_failures_by_class = {}
+    last_probe_failure = None
     backoff = delay
     while attempts < max_attempts:
         if remaining() < probe_timeout + min_attempt_s:
             break
         probes += 1
-        platform = _probe_backend(min(probe_timeout, remaining()))
+        platform, fail = _probe_backend(min(probe_timeout, remaining()))
         if platform is None:
             failed_probes += 1
-            last_err = ("backend probe hung/failed (relay down?), "
-                        "%d/%d probes failed" % (failed_probes, probes))
+            cls = fail["class"]
+            probe_failures_by_class[cls] = \
+                probe_failures_by_class.get(cls, 0) + 1
+            last_probe_failure = fail
+            last_err = ("backend probe failed [%s] (%s), %d/%d probes "
+                        "failed" % (cls, fail["detail"] or "no detail",
+                                    failed_probes, probes))
             # jitter (0.5x-1.5x) decorrelates retry storms across drivers
             sleep_s = min(backoff * random.uniform(0.5, 1.5),
                           max(remaining(), 0))
             if failed_probes <= _PROBE_LOG_HEAD or \
                     failed_probes % _PROBE_LOG_EVERY == 0:
-                print("probe %d failed; backing off %.1fs%s"
-                      % (probes, sleep_s,
+                print("probe %d failed [%s]; backing off %.1fs%s"
+                      % (probes, cls, sleep_s,
                          "" if failed_probes <= _PROBE_LOG_HEAD else
                          " (logging every %d)" % _PROBE_LOG_EVERY),
                       file=sys.stderr)
@@ -711,6 +773,8 @@ def _watchdog():
         "error": last_err,
         "probes": probes,
         "failed_probes": failed_probes,
+        "probe_failures_by_class": probe_failures_by_class,
+        "last_probe_failure": last_probe_failure,
         "attempts": attempts,
         "platform": last_platform,
         "budget_s": budget_s,

@@ -61,10 +61,18 @@ Invariants asserted (per seed)
   strict prefix — no torn or cross-contaminated token streams), KV block
   accounting whole after the drain (allocated == freed), zero
   steady-state recompiles, no deadlock (see ``decode_storm``).
+* **elastic fleet** (``fleet``) — a replica is killed (SimulatedCrash at
+  the ``fleet.replica`` fault point) under storm load through the
+  FleetRouter: zero dropped requests (fleet conservation across
+  failovers), no torn results, bounded tail latency, the background
+  rebalance restores the replication factor (re-warm before cutover), and
+  the router re-converges HEALTHY (see ``fleet_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
-and ``tests/test_faults.py`` gates the two fault scenarios.
+and ``tests/test_faults.py``/``tests/test_fleet.py`` gate the fault-driven
+scenarios (``faults``, ``crash``, ``fleet``) on the smaller
+``FAULT_SMOKE_SEEDS`` set.
 """
 from __future__ import annotations
 
@@ -1022,11 +1030,215 @@ def decode_storm(engine, prompts, refs, seed, n_clients=4, per_client=2):
 
 
 # ---------------------------------------------------------------------------
+# scenario 9: elastic fleet — replica death under storm load
+# ---------------------------------------------------------------------------
+
+def _build_fleet_fixture(n_clients):
+    """-> (router, model_name, inputs, expected).
+
+    Three replicas, the model placed (and warmed) on two of them: a seeded
+    kill always leaves one warm copy to fail over to, and the idle third
+    replica is where the background rebalance restores the replication
+    factor — re-warming BEFORE the placement cutover, so the scenario's
+    recompile-free failover claim is actually exercised."""
+    import numpy as np
+    from .. import gluon, init
+    from ..gluon import nn
+    from .. import ndarray as nd
+    from ..serving.fleet import FleetRouter
+
+    class _Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.out = nn.Dense(_CLASSES, in_units=_FEAT)
+
+        def hybrid_forward(self, F, x):
+            return self.out(x)
+
+    net = _Net()
+    net.initialize(init.Xavier())
+    router = FleetRouter(replicas=3, failover_budget=2,
+                         breaker_threshold=2, breaker_backoff_ms=10.0)
+    router.load_model("elastic", net, input_shapes=[(_FEAT,)], replicas=2,
+                      max_batch=4, max_queue=8, linger_ms=1.0, warmup=True,
+                      breaker_threshold=4, breaker_backoff_ms=15.0)
+    inputs, expected = [], []
+    for i in range(n_clients):
+        x = np.full((_FEAT,), 0.25 * (i + 1), np.float32)
+        inputs.append(x)
+        expected.append(net(nd.array(x[None])).asnumpy()[0])
+    return router, "elastic", inputs, expected
+
+
+def fleet_storm(router, name, inputs, expected, seed, per_client=3):
+    """Kill a replica under storm load (the ``fleet`` scenario).
+
+    A seeded SimulatedCrash at the ``fleet.replica`` fault point models one
+    replica dying mid-request while concurrent clients stream predicts
+    through the FleetRouter.  Invariants:
+
+    * **zero dropped requests** — every client call reaches exactly one
+      terminal status, and the fleet counters conserve ACROSS FAILOVERS:
+      ``requests == ok + timeouts + errors + unavailable`` with every
+      per-status delta matching the client tally;
+    * **no torn results** — an OK result matches the eager reference for
+      that client's own input even when the request was failed over; a
+      TIMEOUT never carries outputs;
+    * **the death is observed** — exactly one replica death, at least one
+      failover, and the killed replica is off every placement;
+    * **bounded tail latency** — no request outlives the 10 s bound (a
+      dying replica must fail over, not wedge its callers);
+    * **re-convergence** — the background rebalance restores the
+      replication factor on the idle replica (warm before cutover) and the
+      router reports HEALTHY again.
+
+    Each seed ends with a repair step (``add_replica``) so the next seed
+    again has three live replicas."""
+    import numpy as np
+    from .. import faults
+    from ..serving import server as srv
+
+    terminal = {srv.OK, srv.TIMEOUT, srv.OVERLOADED, srv.INVALID_INPUT,
+                srv.ERROR, srv.UNAVAILABLE}
+    _TAIL_BOUND_MS = 10_000.0
+    violations = []
+    rng = random.Random(seed ^ 0xF1EE7)
+    n_clients = len(inputs)
+    total = n_clients * per_client
+    before = router.stats()
+
+    plans = []
+    for c in range(n_clients):
+        plan = []
+        for _ in range(per_client):
+            if rng.random() < 0.2:
+                plan.append(rng.uniform(0.2, 2.0))     # likely TIMEOUT
+            else:
+                plan.append(2000.0)
+        plans.append(plan)
+    # the kill fires on a seeded routed attempt in the first half of the
+    # storm, so surviving traffic still exercises the failed-over path
+    kill_after = rng.randrange(0, max(1, total // 2))
+    kill_plan = faults.FaultPlan(seed ^ 0x51E7)
+    kill_plan.add("fleet.replica", kind="crash", after=kill_after, times=1)
+
+    results = [[] for _ in range(n_clients)]
+
+    def client(c):
+        for tmo in plans[c]:
+            results[c].append(router.predict(name, inputs[c],
+                                             timeout_ms=tmo))
+
+    with faults.plan(kill_plan):
+        violations.extend(_spawn([lambda c=c: client(c)
+                                  for c in range(n_clients)]))
+    after = router.stats()
+
+    if kill_plan.fired_count("fleet.replica") != 1:
+        violations.append("fleet: replica kill fired %d time(s) (want 1; "
+                          "after=%d of %d attempts)"
+                          % (kill_plan.fired_count("fleet.replica"),
+                             kill_after, kill_plan.hit_count("fleet.replica")))
+
+    tally = {"OK": 0, "TIMEOUT": 0, "OVERLOADED": 0, "INVALID_INPUT": 0,
+             "ERROR": 0, "UNAVAILABLE": 0}
+    for c in range(n_clients):
+        if len(results[c]) != per_client:
+            violations.append("fleet: client %d lost results: %d of %d"
+                              % (c, len(results[c]), per_client))
+        for res in results[c]:
+            if res is None or res.status not in terminal:
+                violations.append("fleet: non-terminal result %r" % (res,))
+                continue
+            tally[res.status] += 1
+            if res.latency_ms is not None and res.latency_ms > _TAIL_BOUND_MS:
+                violations.append("fleet: tail latency %0.f ms > %.0f ms "
+                                  "bound (%s)" % (res.latency_ms,
+                                                  _TAIL_BOUND_MS, res.status))
+            if res.status == srv.OK:
+                if res.outputs is None:
+                    violations.append("fleet: torn result: OK with "
+                                      "outputs=None")
+                elif not np.allclose(res.outputs[0], expected[c],
+                                     rtol=1e-4, atol=1e-5):
+                    violations.append("fleet: row mixup: client %d OK output "
+                                      "does not match its reference" % c)
+            elif res.status == srv.TIMEOUT and res.outputs is not None:
+                violations.append("fleet: torn result: TIMEOUT carrying "
+                                  "outputs")
+
+    # fleet-level conservation across failovers (counters bump before
+    # predict() returns, so the deltas are final once the clients join)
+    keys = ("requests", "ok", "timeouts", "errors", "unavailable", "shed",
+            "invalid", "failovers", "replica_deaths")
+    d = {k: after[k] - before[k] for k in keys}
+    routed = (tally["OK"] + tally["TIMEOUT"] + tally["ERROR"]
+              + tally["UNAVAILABLE"])
+    if d["requests"] != routed:
+        violations.append("fleet: dropped requests: router %d vs clients %d"
+                          % (d["requests"], routed))
+    if d["requests"] != d["ok"] + d["timeouts"] + d["errors"] \
+            + d["unavailable"]:
+        violations.append(
+            "fleet: conservation broken: requests %d != ok %d + timeouts %d "
+            "+ errors %d + unavailable %d"
+            % (d["requests"], d["ok"], d["timeouts"], d["errors"],
+               d["unavailable"]))
+    for client_key, fleet_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
+                                  ("ERROR", "errors"),
+                                  ("UNAVAILABLE", "unavailable"),
+                                  ("OVERLOADED", "shed"),
+                                  ("INVALID_INPUT", "invalid")):
+        if d[fleet_key] != tally[client_key]:
+            violations.append("fleet: %s mismatch: router %d vs clients %d"
+                              % (fleet_key, d[fleet_key], tally[client_key]))
+    if d["replica_deaths"] != 1:
+        violations.append("fleet: %d replica death(s) recorded (want 1)"
+                          % d["replica_deaths"])
+    if d["failovers"] < 1:
+        violations.append("fleet: kill fired but zero failovers recorded")
+    dead = [rid for rid, state in router.replicas().items()
+            if state == "DEAD"]
+    for m in after["models"].values():
+        for rid in dead:
+            if rid in m["placement"]:
+                violations.append("fleet: dead replica %s still placed" % rid)
+
+    # re-convergence: the background rebalance re-warms the model on the
+    # idle replica, then routing health must return to HEALTHY
+    if not router.wait_converged(timeout_s=10.0):
+        violations.append("fleet: placement never re-converged after the "
+                          "death: %r" % router.stats()["models"])
+    deadline = time.monotonic() + 10.0
+    healthy = False
+    while time.monotonic() < deadline:
+        res = router.predict(name, inputs[0], timeout_ms=2000.0)
+        if res.status == srv.OK and router.health(name) == "HEALTHY":
+            healthy = True
+            break
+        time.sleep(0.005)
+    if not healthy:
+        violations.append("fleet: router did not re-converge HEALTHY "
+                          "(health %r)" % router.health(name))
+
+    # repair for the next seed: rejoin a replica (synchronous rebalance —
+    # nothing to place if the factor is already restored)
+    router.add_replica()
+    live = [rid for rid, state in router.replicas().items()
+            if state == "LIVE"]
+    if len(live) != 3:
+        violations.append("fleet: repair left %d live replica(s) (want 3)"
+                          % len(live))
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
-             "crash", "decode")
+             "crash", "decode", "fleet")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -1050,6 +1262,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                 n_clients, max_queue)
         decode_fixture = (_build_decode_fixture()
                           if "decode" in scenarios else None)
+        fleet_fixture = (_build_fleet_fixture(n_clients)
+                         if "fleet" in scenarios else None)
         try:
             for seed in seeds:
                 sched.reseed(seed)
@@ -1078,6 +1292,11 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                     per_seed["decode"] = decode_storm(
                         decode_fixture[0], decode_fixture[1],
                         decode_fixture[2], seed)
+                if fleet_fixture is not None:
+                    per_seed["fleet"] = fleet_storm(
+                        fleet_fixture[0], fleet_fixture[1],
+                        fleet_fixture[2], fleet_fixture[3], seed,
+                        per_client=per_client)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n
@@ -1091,6 +1310,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                 server.stop()
             if decode_fixture is not None:
                 decode_fixture[0].stop()
+            if fleet_fixture is not None:
+                fleet_fixture[0].stop()
     report["preemptions"] = sched.preemptions
     report["elapsed_s"] = time.monotonic() - t0
     return report

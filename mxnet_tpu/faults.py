@@ -76,6 +76,12 @@ KNOWN_SITES = frozenset({
     "kvstore.pull",
     # serving
     "serving.predict",        # ServableModel.execute, before the XLA call
+    # fleet routing (serving/fleet.py): before the router hands one attempt
+    # to the chosen replica.  A "crash" here models the REPLICA's death as
+    # observed by the router — the router is the surviving process, so it
+    # (exceptionally) catches SimulatedCrash at this one site, marks the
+    # replica DEAD, and fails the request over; see FleetRouter.predict.
+    "fleet.replica",
 })
 
 
@@ -97,7 +103,10 @@ class SimulatedCrash(BaseException):
     Deliberately a ``BaseException``: recovery code written as
     ``except Exception`` must not be able to swallow a crash — after a real
     SIGKILL there is nobody left to run the handler.  Only the chaos harness
-    (which plays the role of the *next* process) catches it.
+    (which plays the role of the *next* process) catches it — plus one
+    documented exception: at the ``fleet.replica`` site the crash models a
+    *replica's* death and the FleetRouter is the surviving observer, so the
+    router catches it there and converts it into replica-death handling.
     """
 
 

@@ -1,38 +1,261 @@
-"""KVStore server bootstrap.
+"""KVStore server: lease-based worker membership + bounded server loop.
 
 Reference: python/mxnet/kvstore_server.py — when DMLC_ROLE=server, importing
 mxnet blocks in the server loop (the ps-lite server applies updates pushed by
 workers, kvstore_dist_server.h).
 
 TPU-native: there IS no server role — sync data parallelism is an in-graph
-allreduce and every process is a worker.  For compatibility with reference
-launch scripts that spawn server processes, this module accepts the role and
-parks the process in a barrier loop so old scripts don't crash; a warning
-documents the divergence (SURVEY §7 hard-part e: async PS has no TPU analog).
+allreduce and every process is a worker (SURVEY §7 hard-part e: async PS has
+no TPU analog).  But the *membership* concern the parameter-server design
+assigns to its scheduler (MXNet paper §5; TensorFlow's dynamic-membership
+story) is real on preemptible fleets, and this module provides it:
+
+* workers ``register()`` for a TTL **lease** and ``heartbeat()`` to renew;
+* a missed lease marks the worker **dead** — its lease generation is fenced
+  so late traffic from the preempted process cannot land;
+* ``push``/``pull`` through a dead or unknown lease raise
+  :class:`LeaseExpired` / :class:`UnknownWorker` — clean, *retryable after
+  rejoin* errors instead of silent acceptance or a hang;
+* a preempted worker ``register()``s again (generation bumps) and resumes
+  mid-epoch via ``fit(auto_resume=True)``, restoring bitwise from the
+  crash-consistent checkpoint manifest (docs/ROBUSTNESS.md).
+
+``KVStoreServer.run()`` is the membership loop: it sweeps expired leases on
+a short poll and exits when ``stop()`` is called — or when the controller it
+was given goes away, so a teardown can never hang on a parked server thread
+(the pre-elastic stub slept in ``while True`` forever).  For compatibility
+with reference launch scripts, DMLC_ROLE=server/scheduler still parks the
+process in ``run()`` — now bounded by the same stop/controller conditions.
+
+See docs/ROBUSTNESS.md ("Fleet membership") for the lease protocol next to
+its serving twin, ``serving/fleet.py``.
 """
 from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 
+from .base import MXNetError
 
-def _init_server_module():
-    role = os.environ.get("DMLC_ROLE", "")
-    if role == "server" or role == "scheduler":
-        logging.warning(
-            "mxnet_tpu: DMLC_ROLE=%s has no TPU analog (gradient aggregation "
-            "is an XLA collective between workers). This process will idle "
-            "until its process group exits.", role)
-        while True:
-            time.sleep(60)
+__all__ = ["Lease", "LeaseExpired", "UnknownWorker", "MembershipTable",
+           "KVStoreServer"]
+
+
+class LeaseExpired(MXNetError):
+    """The worker's lease lapsed: heartbeats stopped for longer than the
+    TTL, so the worker is presumed preempted and fenced.  Retryable — but
+    only *after* the worker re-registers (new lease generation) and
+    resumes from the last complete checkpoint (``fit(auto_resume=True)``);
+    blindly retrying the same push would reintroduce the fenced update."""
+
+
+class UnknownWorker(MXNetError):
+    """Membership traffic from a worker id that never registered."""
+
+
+class Lease:
+    """One granted lease.  ``generation`` increments on every (re-)register
+    of the same worker id — the fencing token that tells a fresh incarnation
+    from a zombie of the preempted one."""
+
+    __slots__ = ("worker_id", "generation", "expires_at")
+
+    def __init__(self, worker_id, generation, expires_at):
+        self.worker_id = worker_id
+        self.generation = generation
+        self.expires_at = expires_at
+
+    def __repr__(self):
+        return ("Lease(worker_id=%r, generation=%d, expires_at=%.3f)"
+                % (self.worker_id, self.generation, self.expires_at))
+
+
+class MembershipTable:
+    """worker_id -> lease, with TTL expiry and generation fencing.
+
+    Thread-safe: one lock guards every field (registrations arrive on
+    worker threads, sweeps on the server loop).  The lock is reentrant
+    because the public entry points hold it across the shared
+    check/evict helpers.  The clock is injectable so expiry is testable
+    without real sleeps."""
+
+    def __init__(self, lease_ttl_s=10.0, clock=time.monotonic):
+        if lease_ttl_s <= 0:
+            raise ValueError("lease_ttl_s must be > 0")
+        self._lock = threading.RLock()
+        self._ttl = float(lease_ttl_s)
+        self._clock = clock
+        self._leases = {}        # worker_id -> Lease (live members)
+        self._generations = {}   # worker_id -> last generation ever granted
+        self._dead = {}          # worker_id -> generation at eviction
+        self._evictions = 0      # lifetime expired-lease evictions
+
+    # -- worker-facing ---------------------------------------------------
+    def register(self, worker_id):
+        """Grant (or re-grant) a lease; returns the :class:`Lease`.
+
+        Registering is how a preempted worker rejoins: its dead entry is
+        cleared and the generation bumps past every lease it ever held."""
+        with self._lock:
+            gen = self._generations.get(worker_id, 0) + 1
+            self._generations[worker_id] = gen
+            self._dead.pop(worker_id, None)
+            lease = Lease(worker_id, gen, self._clock() + self._ttl)
+            self._leases[worker_id] = lease
+            return lease
+
+    def heartbeat(self, worker_id):
+        """Renew the lease; returns the new expiry.  Raises
+        :class:`UnknownWorker` (never registered) or :class:`LeaseExpired`
+        (missed the TTL — the worker is already fenced and must
+        re-register)."""
+        with self._lock:
+            self._check_locked(worker_id)
+            lease = self._leases[worker_id]
+            lease.expires_at = self._clock() + self._ttl
+            return lease.expires_at
+
+    def check(self, worker_id):
+        """Gate one membership-checked operation (push/pull): raises like
+        ``heartbeat`` but does NOT renew — liveness is the heartbeat's
+        job, not a side effect of traffic."""
+        with self._lock:
+            self._check_locked(worker_id)
+
+    def _check_locked(self, worker_id):
+        with self._lock:   # reentrant: callers already hold it
+            lease = self._leases.get(worker_id)
+            if lease is None:
+                if worker_id in self._dead:
+                    raise LeaseExpired(
+                        "worker %r lease (generation %d) expired; "
+                        "re-register and resume from the last complete "
+                        "checkpoint" % (worker_id, self._dead[worker_id]))
+                raise UnknownWorker("worker %r never registered; known: %s"
+                                    % (worker_id,
+                                       sorted(self._generations) or "none"))
+            if self._clock() > lease.expires_at:
+                self._evict_locked(worker_id, lease)
+                raise LeaseExpired(
+                    "worker %r lease (generation %d) expired; re-register "
+                    "and resume from the last complete checkpoint"
+                    % (worker_id, lease.generation))
+
+    # -- server-facing ---------------------------------------------------
+    def sweep(self):
+        """Evict every expired lease; returns the evicted worker ids."""
+        with self._lock:
+            now = self._clock()
+            expired = [wid for wid, lease in self._leases.items()
+                       if now > lease.expires_at]
+            for wid in expired:
+                self._evict_locked(wid, self._leases[wid])
+            return expired
+
+    def _evict_locked(self, worker_id, lease):
+        with self._lock:   # reentrant: callers already hold it
+            del self._leases[worker_id]
+            self._dead[worker_id] = lease.generation
+            self._evictions += 1
+
+    # -- observability ---------------------------------------------------
+    def is_alive(self, worker_id):
+        with self._lock:
+            lease = self._leases.get(worker_id)
+            return lease is not None and self._clock() <= lease.expires_at
+
+    def alive(self):
+        with self._lock:
+            now = self._clock()
+            return sorted(wid for wid, lease in self._leases.items()
+                          if now <= lease.expires_at)
+
+    def dead(self):
+        with self._lock:
+            return sorted(self._dead)
+
+    def snapshot(self):
+        with self._lock:
+            now = self._clock()
+            return {
+                "alive": sorted(wid for wid, lease in self._leases.items()
+                                if now <= lease.expires_at),
+                "dead": sorted(self._dead),
+                "generations": dict(self._generations),
+                "evictions": self._evictions,
+                "lease_ttl_s": self._ttl,
+            }
 
 
 class KVStoreServer:
-    """API-compatible stub of the reference KVStoreServer."""
+    """Membership gateway in front of one kvstore + the bounded server loop.
 
-    def __init__(self, kvstore):
+    Grown from the API-compatible reference stub: ``run()`` used to park
+    forever (or return immediately); now it sweeps leases until ``stop()``
+    or until ``controller`` — a ``threading.Thread`` or a zero-arg callable
+    returning liveness — goes away.  ``push``/``pull`` are the
+    lease-checked counterparts of the kvstore's own methods: traffic from
+    a dead worker fails with the retryable-after-rejoin
+    :class:`LeaseExpired` instead of landing a zombie update."""
+
+    def __init__(self, kvstore, controller=None, lease_ttl_s=10.0,
+                 poll_s=0.05, clock=time.monotonic):
         self.kvstore = kvstore
+        self.members = MembershipTable(lease_ttl_s=lease_ttl_s, clock=clock)
+        self._controller = controller
+        self._poll_s = float(poll_s)
+        self._stop = threading.Event()
 
+    # -- membership gateway ----------------------------------------------
+    def register(self, worker_id):
+        return self.members.register(worker_id)
+
+    def heartbeat(self, worker_id):
+        return self.members.heartbeat(worker_id)
+
+    def push(self, worker_id, key, value, priority=0):
+        """kvstore.push gated on a live lease: a dead/unknown worker's
+        update is refused (raises) and never reaches the store."""
+        self.members.check(worker_id)
+        return self.kvstore.push(key, value, priority=priority)
+
+    def pull(self, worker_id, key, out=None, priority=0):
+        self.members.check(worker_id)
+        return self.kvstore.pull(key, out=out, priority=priority)
+
+    # -- server loop ------------------------------------------------------
     def run(self):
-        _init_server_module()
+        """Serve membership until ``stop()`` or the controller goes away.
+
+        Compatibility: with no controller and no server/scheduler role
+        this returns immediately, like the reference stub (callers that
+        treated ``run()`` as a no-op keep working).  With DMLC_ROLE set —
+        or a controller to watch — it loops, sweeping expired leases every
+        ``poll_s``; either exit condition ends the loop, so a teardown can
+        never hang on this thread."""
+        role = os.environ.get("DMLC_ROLE", "")
+        if role in ("server", "scheduler"):
+            logging.warning(
+                "mxnet_tpu: DMLC_ROLE=%s has no TPU analog (gradient "
+                "aggregation is an XLA collective between workers). This "
+                "process serves worker membership until its controller "
+                "exits.", role)
+        elif self._controller is None:
+            return
+        while not self._stop.wait(self._poll_s):
+            self.members.sweep()
+            if self._controller_gone():
+                break
+
+    def stop(self):
+        """End ``run()`` at its next poll tick; idempotent."""
+        self._stop.set()
+
+    def _controller_gone(self):
+        c = self._controller
+        if c is None:
+            return False
+        alive = c.is_alive() if hasattr(c, "is_alive") else bool(c())
+        return not alive

@@ -1,0 +1,644 @@
+"""Elastic serving fleet: health-routed predicts across N server replicas.
+
+One :class:`~mxnet_tpu.serving.server.ModelServer` is one *replica*; this
+router is the tier above it — the dynamic-membership story of the TensorFlow
+paper (replicas come and go; the system reroutes, drains, and resumes) made
+concrete for the serving path:
+
+* **Placement** — ``load_model(name, ..., replicas=k)`` spreads the model
+  over the k least-loaded live replicas; every copy is warmed (the full
+  bucket-menu precompile) before it takes traffic.
+* **Health-routed selection** — one ``serving/health.py`` CircuitBreaker per
+  (model, replica) pair, fed by what the *router* observes: an UNAVAILABLE
+  result or an unreachable/dead replica is a failure, any answered request
+  is a success.  Selection rotates round-robin over the model's placement,
+  skipping DRAINING/DEAD replicas and open breakers.
+* **Bounded failover** — a predict that lands on a dead or UNAVAILABLE
+  replica is retried on the next routable one, at most ``failover_budget``
+  times; the request reaches exactly one terminal status either way, so
+  fleet conservation (``requests == ok + timeouts + errors + unavailable``)
+  holds across failovers.
+* **Drain** — ``drain(rid)`` stops admission to a replica while its
+  in-flight requests finish (the replica's server keeps running); new
+  submissions that have nowhere else to go get UNAVAILABLE with a
+  ``draining`` reason.  ``enable(rid)`` restores routing.
+* **Rebalance** — when a replica joins (``add_replica``) or dies, every
+  under-replicated model is re-loaded — *and re-warmed* — on a new replica
+  BEFORE the placement cutover, so failover never recompiles in the hot
+  path.  Death-triggered rebalancing runs on a background thread; the dying
+  request has already failed over to an existing warm copy.
+
+Replica death is observed, not announced: a ``faults.SimulatedCrash``
+injected at the ``fleet.replica`` site (or an explicit ``kill_replica``)
+models the replica process dying mid-request.  This is the one site where
+production code catches SimulatedCrash — the router IS the surviving
+process (see faults.py).
+
+The ``fleet`` mxstress scenario (analysis/schedule.py) is the standing
+chaos consumer: a replica is killed under storm load and zero requests may
+drop, tail latency stays bounded, and the router must re-converge HEALTHY.
+See docs/ROBUSTNESS.md ("Fleet membership") and docs/SERVING.md (topology).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import faults
+from ..base import MXNetError
+from .health import (CircuitBreaker, HEALTHY, DEGRADED, UNAVAILABLE_HEALTH,
+                     REJECT)
+from .server import (ModelServer, InferenceResult,
+                     OK, TIMEOUT, ERROR, UNAVAILABLE, OVERLOADED,
+                     INVALID_INPUT)
+from .stats import LatencyWindow
+
+__all__ = ["FleetRouter", "FleetStats", "LIVE", "DRAINING", "DEAD"]
+
+# replica lifecycle states
+LIVE = "LIVE"          # routable
+DRAINING = "DRAINING"  # no new admissions; in-flight requests finish
+DEAD = "DEAD"          # crashed or removed; never routable again
+
+
+class FleetStats:
+    """Fleet-level counters.  Thread-safe; same two-tier split as
+    ModelStats: ``requests`` counts routed client calls that reached a
+    terminal OK/TIMEOUT/ERROR/UNAVAILABLE status (the conservation set);
+    ``shed``/``invalid`` count pass-through fast rejections outside it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.ok = 0
+        self.timeouts = 0
+        self.errors = 0
+        self.unavailable = 0
+        self.shed = 0            # OVERLOADED passed through from a replica
+        self.invalid = 0         # INVALID_INPUT passed through
+        self.failovers = 0       # attempts re-routed to another replica
+        self.replica_deaths = 0
+        self.rebalances = 0      # placement commits after a re-warm
+        self._lat = LatencyWindow()
+
+    def on_result(self, status, latency_ms=None):
+        with self._lock:
+            if status == OK:
+                self.requests += 1
+                self.ok += 1
+            elif status == TIMEOUT:
+                self.requests += 1
+                self.timeouts += 1
+            elif status == ERROR:
+                self.requests += 1
+                self.errors += 1
+            elif status == UNAVAILABLE:
+                self.requests += 1
+                self.unavailable += 1
+            elif status == OVERLOADED:
+                self.shed += 1
+            elif status == INVALID_INPUT:
+                self.invalid += 1
+            if latency_ms is not None:
+                self._lat.add(latency_ms)
+
+    def on_failover(self):
+        with self._lock:
+            self.failovers += 1
+
+    def on_replica_death(self):
+        with self._lock:
+            self.replica_deaths += 1
+
+    def on_rebalance(self):
+        with self._lock:
+            self.rebalances += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "ok": self.ok,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "unavailable": self.unavailable,
+                "shed": self.shed,
+                "invalid": self.invalid,
+                "failovers": self.failovers,
+                "replica_deaths": self.replica_deaths,
+                "rebalances": self.rebalances,
+                "latency_ms": self._lat.percentiles(),
+            }
+
+
+class _Replica:
+    """One replica row; every field except ``server`` is guarded by the
+    router's ``_lock`` (``server`` is assigned once and never rebound)."""
+
+    __slots__ = ("rid", "server", "state", "inflight")
+
+    def __init__(self, rid, server):
+        self.rid = rid
+        self.server = server
+        self.state = LIVE
+        self.inflight = 0
+
+
+class _ModelSpec:
+    """Everything needed to re-load a model on a joining replica."""
+
+    __slots__ = ("name", "block", "input_shapes", "replicas", "kwargs")
+
+    def __init__(self, name, block, input_shapes, replicas, kwargs):
+        self.name = name
+        self.block = block
+        self.input_shapes = input_shapes
+        self.replicas = replicas
+        self.kwargs = kwargs
+
+
+class FleetRouter:
+    """Spread models across replicas; route every predict by health.
+
+    ``replica_factory`` builds one replica server (default: ModelServer).
+    ``failover_budget`` bounds how many times one client request may be
+    re-routed after an UNAVAILABLE/dead replica.  The per-(model, replica)
+    breaker knobs mirror ServableModel's.
+
+    Locking: ``_lock`` guards every piece of routing state (replica table,
+    specs, placement, breakers, round-robin cursors, the closed flag).  No
+    replica server call ever runs under ``_lock`` — predicts, loads and
+    warmups are slow and must not serialize routing.  ``_rebalance_mutex``
+    serializes rebalance passes (join + death-triggered) and always nests
+    OUTSIDE ``_lock``.
+    """
+
+    def __init__(self, replicas=0, replica_factory=None, failover_budget=2,
+                 breaker_threshold=3, breaker_backoff_ms=50.0,
+                 breaker_max_backoff_ms=2000.0):
+        if failover_budget < 0:
+            raise ValueError("failover_budget must be >= 0")
+        self._factory = replica_factory or ModelServer
+        self._failover_budget = int(failover_budget)
+        self._breaker_threshold = breaker_threshold
+        self._breaker_backoff_s = breaker_backoff_ms / 1e3
+        self._breaker_max_backoff_s = breaker_max_backoff_ms / 1e3
+        self._lock = threading.Lock()
+        self._rebalance_mutex = threading.Lock()
+        self._replicas = {}     # rid -> _Replica
+        self._specs = {}        # name -> _ModelSpec
+        self._placement = {}    # name -> [rid, ...] (routable copies)
+        self._breakers = {}     # (name, rid) -> CircuitBreaker
+        self._rr = {}           # name -> round-robin cursor
+        self._next_rid = 0
+        self._closed = False
+        self.stats_sink = FleetStats()
+        for _ in range(replicas):
+            self.add_replica()
+
+    # -- replica membership ---------------------------------------------
+    def add_replica(self, server=None):
+        """Join one replica (building it via the factory if not given),
+        then rebalance: every under-replicated model is loaded AND warmed
+        on it before its placement commits.  Returns the replica id."""
+        server = server if server is not None else self._factory()
+        with self._lock:
+            if self._closed:
+                raise MXNetError("fleet is stopped; create a new FleetRouter")
+            rid = "r%d" % self._next_rid
+            self._next_rid += 1
+            self._replicas[rid] = _Replica(rid, server)
+        self._rebalance()
+        return rid
+
+    def drain(self, rid):
+        """Stop admitting requests to ``rid``; in-flight requests finish
+        (the replica's server keeps running).  Idempotent."""
+        with self._lock:
+            rep = _lookup_replica(self._replicas, rid)
+            if rep.state == DEAD:
+                raise MXNetError("replica %s is dead" % rid)
+            rep.state = DRAINING
+
+    def enable(self, rid):
+        """Undo ``drain``: restore routing to ``rid``."""
+        with self._lock:
+            rep = _lookup_replica(self._replicas, rid)
+            if rep.state == DEAD:
+                raise MXNetError("replica %s is dead" % rid)
+            rep.state = LIVE
+
+    def kill_replica(self, rid):
+        """Abrupt replica death (the test/chaos hook): mark DEAD, drop it
+        from every placement, stop its server, rebalance in the
+        background.  Returns False if it was already dead/unknown."""
+        return self._replica_died(rid)
+
+    def remove_replica(self, rid, timeout_s=10.0):
+        """Graceful decommission: drain, wait for in-flight requests to
+        finish (bounded), then retire the replica and rebalance."""
+        self.drain(rid)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if _lookup_replica(self._replicas, rid).inflight == 0:
+                    break
+            time.sleep(0.005)
+        self._replica_died(rid, expected=True)
+
+    def inflight(self, rid):
+        with self._lock:
+            return _lookup_replica(self._replicas, rid).inflight
+
+    def replicas(self):
+        """rid -> state for every replica ever joined (dead ones linger
+        for observability)."""
+        with self._lock:
+            return {rid: rep.state for rid, rep in self._replicas.items()}
+
+    def server(self, rid):
+        """The underlying replica server (tests / direct maintenance)."""
+        with self._lock:
+            return _lookup_replica(self._replicas, rid).server
+
+    # -- model management ------------------------------------------------
+    def load_model(self, name, block, input_shapes, replicas=2, **kwargs):
+        """Load ``block`` on the ``replicas`` least-loaded live replicas
+        (capped at the live count; at least one required).  Each copy is
+        warmed before its placement commits, so the model never takes
+        traffic on a cold replica.  ``kwargs`` pass through to
+        ``ModelServer.load_model`` and are retained for rebalancing."""
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        with self._lock:
+            if self._closed:
+                raise MXNetError("fleet is stopped; create a new FleetRouter")
+            if name in self._specs:
+                raise MXNetError("model %r is already loaded in the fleet"
+                                 % name)
+            if not any(r.state == LIVE for r in self._replicas.values()):
+                raise MXNetError("no live replicas; add_replica() first")
+            # reserve the name so a racing duplicate load fails fast;
+            # placement stays empty until each copy is warm
+            self._specs[name] = _ModelSpec(name, block, input_shapes,
+                                           int(replicas), dict(kwargs))
+            self._placement[name] = []
+            self._rr[name] = 0
+        try:
+            self._rebalance()
+        except Exception:
+            self.unload_model(name)
+            raise
+        with self._lock:
+            placed = bool(self._placement.get(name))
+        if not placed:
+            self.unload_model(name)
+            raise MXNetError("could not place model %r on any live replica"
+                             % name)
+
+    def unload_model(self, name):
+        with self._lock:
+            if name not in self._specs:
+                raise MXNetError("no model %r in the fleet; loaded: %s"
+                                 % (name, sorted(self._specs) or "none"))
+            del self._specs[name]
+            rids = self._placement.pop(name, [])
+            self._rr.pop(name, None)
+            servers = []
+            for rid in rids:
+                self._breakers.pop((name, rid), None)
+                rep = self._replicas.get(rid)
+                if rep is not None and rep.state != DEAD:
+                    servers.append(rep.server)
+        for server in servers:
+            try:
+                server.unload(name)
+            except MXNetError:
+                pass   # replica raced into teardown; nothing to unload
+
+    def models(self):
+        with self._lock:
+            return sorted(self._specs)
+
+    # -- inference -------------------------------------------------------
+    def predict(self, name, data, timeout_ms=None):
+        """Blocking fleet predict; always returns an InferenceResult.
+
+        Routes to a healthy replica; an UNAVAILABLE result, an injected
+        link fault, or the replica dying mid-request triggers failover to
+        the next routable replica, at most ``failover_budget`` times.
+        Exactly one terminal status is counted per client call."""
+        t0 = time.monotonic()
+        res = self._route(name, data, timeout_ms)
+        ms = (time.monotonic() - t0) * 1e3
+        if res.latency_ms is None:
+            res.latency_ms = ms
+        self.stats_sink.on_result(res.status, ms)
+        return res
+
+    def _route(self, name, data, timeout_ms):
+        tried = set()
+        budget = self._failover_budget
+        for attempt in range(budget + 1):
+            sel, reason = self._select(name, tried)
+            if sel is None:
+                return InferenceResult(
+                    UNAVAILABLE,
+                    error="no routable replica for %r (%s)" % (name, reason))
+            rep, breaker = sel
+            self._begin(rep)
+            try:
+                faults.fault_point("fleet.replica", replica=rep.rid,
+                                   model=name)
+                res = rep.server.predict(name, data, timeout_ms=timeout_ms)
+            except faults.SimulatedCrash:
+                # the ONE place production code catches SimulatedCrash: at
+                # the fleet.replica site the crash is the REPLICA's death
+                # and this router is the surviving process (faults.py)
+                self._replica_died(rep.rid)
+                tried.add(rep.rid)
+                if attempt < budget:
+                    self.stats_sink.on_failover()
+                    continue
+                return InferenceResult(
+                    UNAVAILABLE,
+                    error="replica %s died mid-request; failover budget "
+                          "exhausted" % rep.rid)
+            except faults.InjectedFault as exc:
+                # transient/fatal link fault between router and replica:
+                # the replica may be fine, but THIS path isn't — count a
+                # breaker failure and fail over
+                breaker.on_failure()
+                tried.add(rep.rid)
+                if attempt < budget:
+                    self.stats_sink.on_failover()
+                    continue
+                return InferenceResult(
+                    UNAVAILABLE,
+                    error="replica %s unreachable (%s); failover budget "
+                          "exhausted" % (rep.rid, exc))
+            finally:
+                self._end(rep)
+            if res.status != UNAVAILABLE:
+                # the replica answered — reachable from the router's seat.
+                # (ERROR/OVERLOADED are the replica's own concern; its
+                # per-model breaker and queue bound handle them.)
+                breaker.on_success()
+                return res
+            breaker.on_failure()
+            tried.add(rep.rid)
+            if attempt < budget:
+                self.stats_sink.on_failover()
+                continue
+            return res
+        raise AssertionError("unreachable")   # loop always returns
+
+    def _select(self, name, tried):
+        """Pick (replica, breaker) for one attempt, or (None, reason).
+
+        Round-robin over the model's placement, skipping already-tried,
+        non-LIVE, and breaker-REJECT replicas.  Unknown model raises."""
+        with self._lock:
+            if self._closed:
+                return None, "fleet stopped"
+            if name not in self._specs:
+                raise MXNetError("no model %r in the fleet; loaded: %s"
+                                 % (name, sorted(self._specs) or "none"))
+            placed = list(self._placement.get(name, ()))
+            if not placed:
+                return None, "no replicas host it"
+            cursor = self._rr[name]
+            self._rr[name] = cursor + 1
+            start = cursor % len(placed)
+            order = placed[start:] + placed[:start]
+            cands = []
+            n_draining = 0
+            for rid in order:
+                rep = self._replicas[rid]
+                if rep.state == DRAINING:
+                    n_draining += 1
+                if rid in tried or rep.state != LIVE:
+                    continue
+                cands.append((rep, self._breakers[(name, rid)]))
+        if not cands:
+            if n_draining:
+                return None, "draining"
+            return None, "all replicas tried or dead"
+        for rep, breaker in cands:
+            # admit() outside _lock: the breaker has its own lock, and a
+            # REJECT here must not stall other routing threads
+            if breaker.admit() != REJECT:
+                return (rep, breaker), None
+        return None, "all breakers open"
+
+    def _begin(self, rep):
+        with self._lock:
+            rep.inflight += 1
+
+    def _end(self, rep):
+        with self._lock:
+            rep.inflight -= 1
+
+    # -- replica death + rebalancing --------------------------------------
+    def _replica_died(self, rid, expected=False):
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or rep.state == DEAD:
+                return False
+            rep.state = DEAD
+            for name, rids in self._placement.items():
+                if rid in rids:
+                    rids.remove(rid)
+                    self._breakers.pop((name, rid), None)
+            closed = self._closed
+        if not expected:
+            self.stats_sink.on_replica_death()
+        try:
+            rep.server.stop()
+        except Exception:
+            pass   # it "crashed"; best-effort teardown of the local object
+        if not closed:
+            # rebalance off the request path: the failing request has
+            # already failed over to a warm copy; restoring the replication
+            # factor (re-warm included) is background work
+            threading.Thread(target=self._rebalance,
+                             name="fleet-rebalance", daemon=True).start()
+        return True
+
+    def _rebalance(self):
+        """Restore every model to min(target, live replicas) copies.
+
+        One (model, replica) deficit at a time: pick the least-loaded live
+        candidate under ``_lock``, load + warm OUTSIDE the lock, then
+        commit the placement — the re-warm-before-cutover rule."""
+        with self._rebalance_mutex:
+            failed = set()   # (name, rid) that refused the load this pass
+            while True:
+                task = None
+                with self._lock:
+                    if self._closed:
+                        return
+                    live = [r for r in self._replicas.values()
+                            if r.state == LIVE]
+                    hosted = {r.rid: 0 for r in live}
+                    for rids in self._placement.values():
+                        for rid in rids:
+                            if rid in hosted:
+                                hosted[rid] += 1
+                    for name in sorted(self._specs):
+                        spec = self._specs[name]
+                        placed = self._placement[name]
+                        live_placed = [rid for rid in placed
+                                       if self._replicas[rid].state == LIVE]
+                        want = min(spec.replicas, len(live))
+                        if len(live_placed) >= want:
+                            continue
+                        cands = [r for r in live
+                                 if r.rid not in placed
+                                 and (name, r.rid) not in failed]
+                        if not cands:
+                            continue
+                        cands.sort(key=lambda r: (hosted[r.rid], r.rid))
+                        task = (name, spec, cands[0])
+                        break
+                    if task is None:
+                        return
+                name, spec, rep = task
+                try:
+                    # load + full bucket-menu warmup on the new replica,
+                    # BEFORE the placement commit below makes it routable
+                    rep.server.load_model(name, spec.block,
+                                          spec.input_shapes, **spec.kwargs)
+                except MXNetError:
+                    failed.add((name, rep.rid))
+                    continue
+                committed = False
+                with self._lock:
+                    if (not self._closed and rep.state == LIVE
+                            and name in self._specs
+                            and rep.rid not in self._placement[name]):
+                        self._placement[name].append(rep.rid)
+                        self._breakers[(name, rep.rid)] = CircuitBreaker(
+                            failure_threshold=self._breaker_threshold,
+                            backoff_s=self._breaker_backoff_s,
+                            max_backoff_s=self._breaker_max_backoff_s)
+                        committed = True
+                if committed:
+                    self.stats_sink.on_rebalance()
+                else:
+                    # lost the race (replica died / model unloaded / fleet
+                    # stopped while warming): roll the orphan copy back
+                    try:
+                        rep.server.unload(name)
+                    except MXNetError:
+                        pass
+
+    def wait_converged(self, timeout_s=10.0):
+        """Block until every model has min(target, live) routable copies
+        (rebalancing settled).  Returns True on convergence."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                n_live = sum(1 for r in self._replicas.values()
+                             if r.state == LIVE)
+                done = all(
+                    len([rid for rid in self._placement[name]
+                         if self._replicas[rid].state == LIVE])
+                    >= min(spec.replicas, n_live)
+                    for name, spec in self._specs.items())
+            if done:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
+    # -- observability ----------------------------------------------------
+    def health(self, name=None):
+        """HEALTHY / DEGRADED / UNAVAILABLE for one model (or the worst
+        across the fleet).  A model with zero routable replicas is
+        UNAVAILABLE; under target, a non-LIVE placement, or any breaker
+        off HEALTHY is DEGRADED."""
+        with self._lock:
+            if name is not None and name not in self._specs:
+                raise MXNetError("no model %r in the fleet; loaded: %s"
+                                 % (name, sorted(self._specs) or "none"))
+            names = [name] if name is not None else sorted(self._specs)
+            n_live = sum(1 for r in self._replicas.values()
+                         if r.state == LIVE)
+            rows = []
+            for n in names:
+                placed = list(self._placement[n])
+                states = [self._replicas[rid].state for rid in placed]
+                breakers = [self._breakers[(n, rid)] for rid in placed
+                            if self._replicas[rid].state == LIVE]
+                rows.append((n, self._specs[n].replicas, states, breakers))
+        worst = HEALTHY
+        rank = {HEALTHY: 0, DEGRADED: 1, UNAVAILABLE_HEALTH: 2}
+        for _, target, states, breakers in rows:
+            n_routable = sum(1 for s in states if s == LIVE)
+            if n_routable == 0:
+                h = UNAVAILABLE_HEALTH
+            else:
+                b_health = [b.health() for b in breakers]
+                if (any(bh != HEALTHY for bh in b_health)
+                        or n_routable < min(target, max(n_live, 1))
+                        or any(s != LIVE for s in states)):
+                    h = DEGRADED
+                else:
+                    h = HEALTHY
+            if rank[h] > rank[worst]:
+                worst = h
+        return worst
+
+    def stats(self):
+        """Fleet counters + per-replica and per-model routing state."""
+        with self._lock:
+            reps = {rid: {"state": rep.state, "inflight": rep.inflight,
+                          "models": sorted(n for n, rids
+                                           in self._placement.items()
+                                           if rid in rids)}
+                    for rid, rep in self._replicas.items()}
+            models = {}
+            for name, spec in self._specs.items():
+                placed = list(self._placement[name])
+                models[name] = {
+                    "target": spec.replicas,
+                    "placement": placed,
+                    "breakers": {rid: self._breakers[(name, rid)]
+                                 for rid in placed
+                                 if (name, rid) in self._breakers},
+                }
+        for snap in models.values():
+            snap["breakers"] = {rid: b.snapshot()
+                                for rid, b in snap["breakers"].items()}
+        out = self.stats_sink.snapshot()
+        out["replicas"] = reps
+        out["models"] = models
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self):
+        """Stop every replica server; idempotent."""
+        with self._lock:
+            self._closed = True
+            servers = [rep.server for rep in self._replicas.values()
+                       if rep.state != DEAD]
+        for server in servers:
+            try:
+                server.stop()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+def _lookup_replica(replicas, rid):
+    """Row lookup over an already-locked replica table."""
+    try:
+        return replicas[rid]
+    except KeyError:
+        raise MXNetError("no replica %r; known: %s"
+                         % (rid, sorted(replicas) or "none"))

@@ -78,3 +78,56 @@ def test_bench_int8_mode_smoke():
     assert rec["metric"] == "resnet50_int8_infer_imgs_per_sec_bs2"
     assert rec["calib"] == "minmax"
     assert rec["timed_window"]["iters"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# probe-failure classification (round-6: BENCH_r05's 13/13 failed probes
+# left no evidence of WHY — every failure now gets a class + detail)
+# ---------------------------------------------------------------------------
+
+def _load_module(name, path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_CLASSIFY_CASES = [
+    # (timed_out, rc, stdout, stderr) -> expected class
+    ((True, None, "", ""), "timeout"),
+    ((False, 1, "", "ConnectionRefusedError: [Errno 111] Connection "
+                    "refused"), "connect"),
+    ((False, 1, "", "socket error: no route to host"), "connect"),
+    ((False, 1, "", "gaierror: getaddrinfo failed"), "connect"),
+    ((False, 1, "", "urllib.error.HTTPError: HTTP Error 502: Bad "
+                    "Gateway"), "http"),
+    ((False, 1, "", "relay returned status code 503 service "
+                    "unavailable"), "http"),
+    ((False, 1, "", "Traceback (most recent call last):\n"
+                    "RuntimeError: backend init exploded"), "backend"),
+    ((False, 0, "", ""), "no-output"),
+    ((False, 0, "garbage but no PROBE_OK", ""), "no-output"),
+]
+
+
+def test_probe_failure_classifier(monkeypatch):
+    # a stray BENCH_MODE in the test env would make bench.py sys.exit at
+    # import; pin the defaults
+    monkeypatch.delenv("BENCH_MODE", raising=False)
+    monkeypatch.delenv("BENCH_LAYOUT", raising=False)
+    bench = _load_module("_bench_ut", os.path.join(REPO, "bench.py"))
+    watcher = _load_module("_relay_watcher_ut",
+                           os.path.join(REPO, "tools", "relay_watcher.py"))
+    for args, want in _CLASSIFY_CASES:
+        b_cls, b_detail = bench._classify_probe_failure(*args)
+        w_cls, w_detail = watcher.classify_probe_failure(*args)
+        assert b_cls == want, (args, b_cls)
+        # the watcher's copy must never drift from bench.py's
+        assert (w_cls, w_detail) == (b_cls, b_detail), (args, w_cls)
+        assert b_cls in bench._PROBE_FAILURE_CLASSES
+        assert isinstance(b_detail, str)
+    # detail carries the most specific stderr evidence
+    _, detail = bench._classify_probe_failure(
+        False, 1, "", "noise line\nConnectionRefusedError: refused")
+    assert detail == "ConnectionRefusedError: refused"

@@ -1,0 +1,495 @@
+"""Elastic fleet (docs/ROBUSTNESS.md "Fleet membership").
+
+Tier-1 gates for PR 9's two halves:
+
+* **Serving** — FleetRouter places models across replicas, routes every
+  predict by breaker health, fails over (bounded) on UNAVAILABLE / injected
+  link faults / replica death at the ``fleet.replica`` site, drains
+  gracefully (in-flight finishes, new submissions get a ``draining``
+  UNAVAILABLE), and rebalances onto a re-warmed replica before cutover so
+  failover never recompiles in the hot path.
+* **Training** — lease-based worker membership: heartbeats renew a TTL
+  lease, a missed lease fences the worker (push/pull raise the
+  retryable-after-rejoin LeaseExpired), re-registering bumps the lease
+  generation, and a preempted worker resumes mid-epoch via
+  ``fit(auto_resume=True)`` to params bitwise-identical to the
+  uninterrupted run.
+* **Chaos** — the mxstress ``fleet`` scenario (replica killed under storm
+  load) holds request conservation, bounded tails, and HEALTHY
+  re-convergence over the FAULT_SMOKE_SEEDS set.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, io, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore_server import (KVStoreServer, LeaseExpired,
+                                      MembershipTable, UnknownWorker)
+from mxnet_tpu.serving import OK, UNAVAILABLE
+from mxnet_tpu.serving.fleet import DEAD, DRAINING, LIVE, FleetRouter
+
+
+_FEAT, _CLASSES = 6, 3
+
+
+class _Net(mx.gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.out = nn.Dense(_CLASSES, in_units=_FEAT)
+
+    def hybrid_forward(self, F, x):
+        return self.out(x)
+
+
+def _make_net():
+    net = _Net()
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+_LOAD_KW = dict(max_batch=4, max_queue=16, linger_ms=1.0, warmup=True)
+
+
+def _fleet(n_replicas, n_copies, **router_kw):
+    """(router, net, x, expected): one model spread over n_copies."""
+    router_kw.setdefault("breaker_backoff_ms", 10.0)
+    router = FleetRouter(replicas=n_replicas, **router_kw)
+    net = _make_net()
+    router.load_model("m", net, input_shapes=[(_FEAT,)],
+                      replicas=n_copies, **_LOAD_KW)
+    x = np.full((_FEAT,), 0.5, np.float32)
+    expected = net(nd.array(x[None])).asnumpy()[0]
+    return router, net, x, expected
+
+
+# ---------------------------------------------------------------------------
+# placement + health-routed predict
+# ---------------------------------------------------------------------------
+
+def test_load_spreads_copies_and_routes_correctly():
+    router, _, x, expected = _fleet(3, 2)
+    with router:
+        st = router.stats()
+        assert len(st["models"]["m"]["placement"]) == 2
+        for _ in range(4):   # round-robin touches both copies
+            res = router.predict("m", x, timeout_ms=5000)
+            assert res.status == OK
+            assert np.allclose(res.outputs, expected, rtol=1e-4, atol=1e-5)
+        after = router.stats()
+        assert after["requests"] == after["ok"] == 4
+        assert router.health("m") == "HEALTHY"
+
+
+def test_unknown_model_raises_not_a_status():
+    router, _, x, _ = _fleet(1, 1)
+    with router:
+        with pytest.raises(MXNetError, match="no model 'ghost'"):
+            router.predict("ghost", x)
+        with pytest.raises(MXNetError, match="no model"):
+            router.health("ghost")
+
+
+def test_load_requires_live_replica_and_rejects_duplicates():
+    router = FleetRouter(replicas=0)
+    with router:
+        with pytest.raises(MXNetError, match="no live replicas"):
+            router.load_model("m", _make_net(), input_shapes=[(_FEAT,)],
+                              replicas=1, **_LOAD_KW)
+    router, net, _, _ = _fleet(2, 1)
+    with router:
+        with pytest.raises(MXNetError, match="already loaded"):
+            router.load_model("m", net, input_shapes=[(_FEAT,)],
+                              replicas=1, **_LOAD_KW)
+
+
+# ---------------------------------------------------------------------------
+# failover: replica death (explicit + fault-injected), bounded budget
+# ---------------------------------------------------------------------------
+
+def test_kill_replica_fails_over_and_rebalances():
+    router, _, x, expected = _fleet(3, 2)
+    with router:
+        victim = router.stats()["models"]["m"]["placement"][0]
+        assert router.kill_replica(victim)
+        assert not router.kill_replica(victim)   # idempotent: already dead
+        for _ in range(4):   # service continues on the surviving copy
+            res = router.predict("m", x, timeout_ms=5000)
+            assert res.status == OK
+            assert np.allclose(res.outputs, expected, rtol=1e-4, atol=1e-5)
+        assert router.wait_converged(timeout_s=10.0)
+        st = router.stats()
+        assert st["replica_deaths"] == 1
+        assert victim not in st["models"]["m"]["placement"]
+        assert len(st["models"]["m"]["placement"]) == 2   # re-placed
+        assert st["replicas"][victim]["state"] == DEAD
+
+
+def test_fault_point_crash_is_replica_death_with_failover():
+    router, _, x, expected = _fleet(3, 2)
+    with router:
+        plan = faults.FaultPlan(0).add("fleet.replica", kind="crash",
+                                       after=0, times=1)
+        with faults.plan(plan):
+            res = router.predict("m", x, timeout_ms=5000)
+        # the routed replica "died" mid-request; the router failed the
+        # request over to a warm copy — the client never saw the crash
+        assert res.status == OK
+        assert np.allclose(res.outputs, expected, rtol=1e-4, atol=1e-5)
+        st = router.stats()
+        assert st["replica_deaths"] == 1
+        assert st["failovers"] >= 1
+        dead = [rid for rid, rep in st["replicas"].items()
+                if rep["state"] == DEAD]
+        assert len(dead) == 1
+        assert dead[0] not in st["models"]["m"]["placement"]
+
+
+def test_failover_budget_is_bounded():
+    router, _, x, _ = _fleet(2, 2, failover_budget=1)
+    with router:
+        # every router->replica hop fails: 1 + failover_budget attempts,
+        # then a clean UNAVAILABLE — never an unbounded retry loop
+        plan = faults.FaultPlan(0).add("fleet.replica", kind="fatal")
+        with faults.plan(plan):
+            res = router.predict("m", x, timeout_ms=5000)
+        assert res.status == UNAVAILABLE
+        assert "failover budget exhausted" in res.error
+        st = router.stats()
+        assert st["failovers"] == 1
+        assert st["requests"] == st["unavailable"] == 1
+        # link faults are not deaths: both replicas are still LIVE
+        assert all(rep["state"] == LIVE
+                   for rep in st["replicas"].values())
+
+
+# ---------------------------------------------------------------------------
+# drain semantics (the satellite gate): in-flight completes, new requests
+# get a 'draining' UNAVAILABLE, enable() restores routing
+# ---------------------------------------------------------------------------
+
+def test_drain_lets_inflight_finish_and_refuses_new_requests():
+    router, _, x, expected = _fleet(1, 1)
+    with router:
+        rid = router.stats()["models"]["m"]["placement"][0]
+        server = router.server(rid)
+        server.pause("m")   # hold the replica's batcher: request stays
+        results = {}        # in flight until resume()
+
+        def client():
+            results["r"] = router.predict("m", x, timeout_ms=10000)
+
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while router.inflight(rid) == 0:
+            assert time.monotonic() < deadline, "request never admitted"
+            time.sleep(0.002)
+
+        router.drain(rid)
+        router.drain(rid)   # idempotent
+        assert router.replicas()[rid] == DRAINING
+        # new submission has nowhere to go — immediate, reasoned rejection
+        refused = router.predict("m", x, timeout_ms=5000)
+        assert refused.status == UNAVAILABLE
+        assert "draining" in refused.error
+
+        server.resume("m")  # the in-flight request now completes normally
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert results["r"].status == OK
+        assert np.allclose(results["r"].outputs, expected,
+                           rtol=1e-4, atol=1e-5)
+
+        router.enable(rid)  # un-drain restores routing
+        assert router.replicas()[rid] == LIVE
+        assert router.predict("m", x, timeout_ms=5000).status == OK
+
+
+def test_drain_and_enable_reject_dead_replicas():
+    router, _, _, _ = _fleet(2, 1)
+    with router:
+        rid = router.stats()["models"]["m"]["placement"][0]
+        router.kill_replica(rid)
+        with pytest.raises(MXNetError, match="dead"):
+            router.drain(rid)
+        with pytest.raises(MXNetError, match="dead"):
+            router.enable(rid)
+        with pytest.raises(MXNetError, match="no replica"):
+            router.drain("r99")
+
+
+def test_remove_replica_is_a_graceful_decommission():
+    router, _, x, _ = _fleet(2, 2)
+    with router:
+        victim = router.stats()["models"]["m"]["placement"][0]
+        router.remove_replica(victim)
+        st = router.stats()
+        assert st["replicas"][victim]["state"] == DEAD
+        assert st["replica_deaths"] == 0   # expected exit, not a death
+        assert router.predict("m", x, timeout_ms=5000).status == OK
+        assert router.wait_converged(timeout_s=10.0)
+
+
+def test_health_tracks_drain_and_recovery():
+    router, _, _, _ = _fleet(2, 2)
+    with router:
+        rid = router.stats()["models"]["m"]["placement"][0]
+        assert router.health("m") == "HEALTHY"
+        router.drain(rid)
+        assert router.health("m") == "DEGRADED"   # placed copy not LIVE
+        router.enable(rid)
+        assert router.health("m") == "HEALTHY"
+        assert router.health() == "HEALTHY"       # fleet-wide worst
+
+
+# ---------------------------------------------------------------------------
+# rebalance-on-join: re-warm BEFORE cutover, zero hot-path recompiles
+# ---------------------------------------------------------------------------
+
+def test_join_rebalance_warms_before_taking_traffic():
+    router, _, x, _ = _fleet(2, 3)   # wants 3 copies, only 2 replicas
+    with router:
+        assert len(router.stats()["models"]["m"]["placement"]) == 2
+        new_rid = router.add_replica()   # synchronous rebalance
+        st = router.stats()
+        assert new_rid in st["models"]["m"]["placement"]
+        assert len(st["models"]["m"]["placement"]) == 3
+        # the joining replica was fully warmed before placement committed
+        new_stats = router.server(new_rid).stats()["models"]["m"]
+        warm = new_stats["warmup"]
+        assert warm["compiles"] >= 1
+        assert warm["compiles"] == warm["signatures"]
+        # traffic routed after the cutover compiles NOTHING new: every
+        # signature was built during the pre-commit warmup
+        placed = st["models"]["m"]["placement"]
+        miss_before = {rid: router.server(rid).stats()
+                       ["models"]["m"]["cache"]["misses"]
+                       for rid in placed}
+        for _ in range(6):
+            assert router.predict("m", x, timeout_ms=5000).status == OK
+        for rid in placed:
+            cache = router.server(rid).stats()["models"]["m"]["cache"]
+            assert cache["misses"] == miss_before[rid], (rid, cache)
+
+
+def test_stop_is_idempotent_and_refuses_new_work():
+    router, _, x, _ = _fleet(1, 1)
+    router.stop()
+    router.stop()
+    res = router.predict("m", x)
+    assert res.status == UNAVAILABLE
+    assert "fleet stopped" in res.error
+    with pytest.raises(MXNetError, match="stopped"):
+        router.add_replica()
+
+
+# ---------------------------------------------------------------------------
+# training membership: leases, fencing, rejoin
+# ---------------------------------------------------------------------------
+
+def _fake_clock():
+    t = [0.0]
+    return t, (lambda: t[0])
+
+
+def test_lease_register_heartbeat_expiry_rejoin():
+    t, clock = _fake_clock()
+    tbl = MembershipTable(lease_ttl_s=5.0, clock=clock)
+    lease = tbl.register("w0")
+    assert lease.generation == 1
+    t[0] = 4.0
+    tbl.heartbeat("w0")               # renews to t=9
+    t[0] = 8.9
+    assert tbl.is_alive("w0")
+    tbl.check("w0")                   # gates but does NOT renew
+    t[0] = 9.1
+    with pytest.raises(LeaseExpired, match="re-register"):
+        tbl.heartbeat("w0")
+    assert tbl.dead() == ["w0"]
+    with pytest.raises(LeaseExpired):
+        tbl.check("w0")               # fenced: zombie traffic refused
+    with pytest.raises(UnknownWorker, match="never registered"):
+        tbl.check("w1")
+    lease2 = tbl.register("w0")       # rejoin bumps the fencing token
+    assert lease2.generation == 2
+    tbl.check("w0")
+    assert tbl.dead() == []
+
+
+def test_sweep_evicts_expired_leases():
+    t, clock = _fake_clock()
+    tbl = MembershipTable(lease_ttl_s=2.0, clock=clock)
+    tbl.register("a")
+    tbl.register("b")
+    t[0] = 1.0
+    tbl.heartbeat("b")
+    t[0] = 2.5                        # a expired (2.0), b renewed (3.0)
+    assert tbl.sweep() == ["a"]
+    assert tbl.alive() == ["b"]
+    snap = tbl.snapshot()
+    assert snap["dead"] == ["a"]
+    assert snap["evictions"] == 1
+    assert snap["generations"] == {"a": 1, "b": 1}
+
+
+def test_push_pull_gated_on_live_lease():
+    t, clock = _fake_clock()
+    kv = mx.kvstore.create("local")
+    srv = KVStoreServer(kv, lease_ttl_s=5.0, clock=clock)
+    kv.init("w", nd.zeros((4,)))
+    srv.register("w0")
+    srv.push("w0", "w", nd.ones((4,)))
+    out = nd.zeros((4,))
+    srv.pull("w0", "w", out=out)
+    assert np.allclose(out.asnumpy(), 1.0)
+    with pytest.raises(UnknownWorker):
+        srv.push("stranger", "w", nd.ones((4,)))
+    t[0] = 6.0                        # w0's lease lapses
+    with pytest.raises(LeaseExpired):
+        srv.push("w0", "w", nd.ones((4,)) * 9)
+    with pytest.raises(LeaseExpired):
+        srv.pull("w0", "w", out=out)
+    # the fenced push never landed
+    srv.register("w0")                # rejoin (generation 2)
+    srv.pull("w0", "w", out=out)
+    assert np.allclose(out.asnumpy(), 1.0)
+
+
+def test_server_run_exits_when_controller_dies():
+    controller = threading.Thread(target=time.sleep, args=(0.05,))
+    controller.start()
+    srv = KVStoreServer(None, controller=controller, poll_s=0.01)
+    runner = threading.Thread(target=srv.run)
+    runner.start()
+    runner.join(timeout=5)
+    assert not runner.is_alive(), "run() failed to notice controller exit"
+    srv.stop()                        # idempotent after exit
+    srv.stop()
+
+
+def test_server_run_without_controller_returns_immediately(monkeypatch):
+    monkeypatch.delenv("DMLC_ROLE", raising=False)
+    srv = KVStoreServer(None)
+    runner = threading.Thread(target=srv.run)
+    runner.start()
+    runner.join(timeout=2)
+    assert not runner.is_alive()      # reference-stub compatibility
+
+
+def test_server_run_sweeps_leases_and_stops():
+    t, clock = _fake_clock()
+    srv = KVStoreServer(None, controller=lambda: True, lease_ttl_s=1.0,
+                        poll_s=0.005, clock=clock)
+    srv.register("w0")
+    runner = threading.Thread(target=srv.run)
+    runner.start()
+    try:
+        t[0] = 2.0                    # lease lapses; the loop must evict
+        deadline = time.monotonic() + 5.0
+        while srv.members.dead() != ["w0"]:
+            assert time.monotonic() < deadline, "sweep never evicted w0"
+            time.sleep(0.005)
+    finally:
+        srv.stop()
+        runner.join(timeout=5)
+    assert not runner.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# the training acceptance: preempted worker rejoins mid-epoch, bitwise
+# ---------------------------------------------------------------------------
+
+_N, _F = 16, 5
+
+
+def _fit_data():
+    rng = np.random.RandomState(11)
+    X = rng.randn(_N, _F).astype(np.float32)
+    Y = (rng.rand(_N) > 0.5).astype(np.float32)
+    return io.NDArrayIter(X, Y, batch_size=8)
+
+
+def _make_mod():
+    x = mx.sym.Variable("data")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc1")
+    y = mx.sym.Activation(y, act_type="relu")
+    y = mx.sym.FullyConnected(y, num_hidden=2, name="fc2")
+    return mx.mod.Module(mx.sym.SoftmaxOutput(y, name="softmax"),
+                         context=mx.cpu())
+
+
+def _run_fit(prefix, resume=False, crash_plan=None):
+    mod = _make_mod()
+    cbs = [mx.callback.module_checkpoint(mod, prefix,
+                                         save_optimizer_states=True)]
+    mx.random.seed(1234)
+    kw = dict(num_epoch=2, optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+              initializer=mx.init.Xavier(), epoch_end_callback=cbs)
+    if crash_plan is not None:
+        with faults.plan(crash_plan):
+            mod.fit(_fit_data(), **kw)
+    else:
+        mod.fit(_fit_data(), auto_resume=resume, **kw)
+    return mod.get_params()
+
+
+def test_preempted_worker_rejoins_bitwise(tmp_path):
+    """The PR 9 training gate, end to end: a registered worker is preempted
+    mid-fit (SimulatedCrash during the epoch-0 checkpoint), its lease
+    expires and fenced traffic is refused, then it re-registers (generation
+    bump) and ``fit(auto_resume=True)`` lands on params bitwise-identical
+    to the uninterrupted run."""
+    t, clock = _fake_clock()
+    srv = KVStoreServer(mx.kvstore.create("local"), lease_ttl_s=5.0,
+                        clock=clock)
+    assert srv.register("w0").generation == 1
+
+    ref_args, _ = _run_fit(str(tmp_path / "ref"))
+
+    prefix = str(tmp_path / "pre")
+    plan = faults.FaultPlan(0).add("checkpoint.write", kind="crash",
+                                   after=2, times=1)
+    with pytest.raises(faults.SimulatedCrash):
+        _run_fit(prefix, crash_plan=plan)
+
+    # the preempted process stops heartbeating; the fleet notices
+    t[0] = 6.0
+    assert srv.members.sweep() == ["w0"]
+    with pytest.raises(LeaseExpired, match="re-register"):
+        srv.heartbeat("w0")
+
+    # rejoin: new lease generation, then resume from the last complete
+    # checkpoint — bitwise, optimizer momentum included
+    assert srv.register("w0").generation == 2
+    srv.heartbeat("w0")
+    args, _ = _run_fit(prefix, resume=True)
+    for k in ref_args:
+        assert np.array_equal(ref_args[k].asnumpy(), args[k].asnumpy()), \
+            "param %r diverged across preemption+rejoin" % k
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate: mxstress fleet scenario, zero violations
+# ---------------------------------------------------------------------------
+
+def test_mxstress_fleet_scenario_zero_violations():
+    from mxnet_tpu.analysis import schedule
+    t0 = time.monotonic()
+    report = schedule.stress(seeds=schedule.FAULT_SMOKE_SEEDS,
+                             scenarios=("fleet",))
+    elapsed = time.monotonic() - t0
+    flat = ["seed %s [%s] %s" % (seed, scen, v)
+            for seed, per_seed in report["seeds"].items()
+            for scen, violations in per_seed.items()
+            for v in violations]
+    assert report["violations"] == 0, "\n".join(flat)
+    assert len(report["seeds"]) == len(schedule.FAULT_SMOKE_SEEDS)
+    # smoke budget: this is a tier-1 gate, it must stay cheap
+    assert elapsed < 20.0, "fleet smoke blew its budget: %.1fs" % elapsed

@@ -439,3 +439,76 @@ def test_serve_bench_smoke_artifact(tmp_path):
     assert report["statuses"].get("OK") == report["workload"]["total_requests"]
     assert set(report["latency_ms"]) == {"p50", "p95", "p99"}
     assert report["throughput_rps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# decode-engine observability surface (attach_engine / stats / health)
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(name):
+    from mxnet_tpu.serving.decode import DecodeEngine, TinyCausalLM
+    model = TinyCausalLM(vocab_size=16, hidden=8, num_layers=1,
+                        num_heads=1, max_len=32, seed=3)
+    return DecodeEngine(model, name=name, max_slots=2, block_size=4,
+                        max_prompt_len=8, max_new_tokens=8, max_queue=16)
+
+
+def test_attached_engine_reports_through_server_stats_and_health():
+    server = serving.ModelServer()
+    eng = _tiny_engine("lm")
+    try:
+        server.attach_engine(eng)
+        assert server.engines() == ["lm"]
+        stream = eng.generate([1, 2, 3], max_new_tokens=4, timeout_ms=30000)
+        assert stream.status == serving.OK
+        # DecodeStats surfaces through the SAME stats()/health() the fleet
+        # router reads for batched models
+        snap = server.stats()["engines"]["lm"]
+        assert snap["ok"] >= 1
+        assert snap["health"] == "HEALTHY"
+        assert {"kv", "cache", "breaker"} <= set(snap)
+        assert server.health("lm") == "HEALTHY"
+    finally:
+        server.stop()
+    # server.stop() tears the attached engine down with it
+    refused = eng.generate([1], max_new_tokens=1, timeout_ms=5000)
+    assert refused.status == serving.UNAVAILABLE
+
+
+def test_engine_and_model_names_are_one_namespace():
+    server = serving.ModelServer()
+    eng = _tiny_engine("m")
+    clash = _tiny_engine("m")
+    try:
+        server.load_model("m", _make_net(), input_shapes=[(4, 8)])
+        with pytest.raises(mx.MXNetError, match="already a loaded model"):
+            server.attach_engine(clash)
+        server.unload("m")
+        server.attach_engine(eng)
+        with pytest.raises(mx.MXNetError, match="already attached"):
+            server.attach_engine(clash)
+        with pytest.raises(mx.MXNetError, match="already an attached"):
+            server.load_model("m", _make_net(), input_shapes=[(4, 8)])
+        with pytest.raises(mx.MXNetError, match="no engine 'ghost'"):
+            server.detach_engine("ghost")
+    finally:
+        server.stop()
+        clash.stop()
+
+
+def test_detach_engine_returns_it_running():
+    server = serving.ModelServer()
+    eng = _tiny_engine("lm")
+    try:
+        server.attach_engine(eng)
+        got = server.detach_engine("lm")
+        assert got is eng
+        assert server.engines() == []
+        # detaching is an ownership transfer, not a teardown
+        stream = eng.generate([1, 2], max_new_tokens=2, timeout_ms=30000)
+        assert stream.status == serving.OK
+        with pytest.raises(mx.MXNetError):
+            server.health("lm")
+    finally:
+        server.stop()
+        eng.stop()

@@ -15,10 +15,14 @@ replace/manifest fault point (restore always finds the newest complete
 checkpoint, bit-exact).  The ``decode`` scenario storms the
 continuous-batching decode engine: stream conservation, bitwise/prefix
 token integrity, KV-block accounting, zero steady-state recompiles, no
-deadlock.  Exit code is non-zero iff any seed violated any invariant.
+deadlock.  The ``fleet`` scenario kills a serving replica under storm load
+(SimulatedCrash at ``fleet.replica``): the FleetRouter must drop zero
+requests across failovers, keep tail latency bounded, rebalance onto a
+re-warmed replica, and re-converge HEALTHY.  Exit code is non-zero iff any
+seed violated any invariant.
 
 Usage:
-  python tools/mxstress.py --smoke              # 25 fixed seeds, <=10 s
+  python tools/mxstress.py --smoke              # 25 fixed seeds, <=20 s
   python tools/mxstress.py --seeds 100          # longer soak
   python tools/mxstress.py --scenarios serving,cache
   python tools/mxstress.py --p 0.5 --max-sleep-ms 2.0   # heavier preemption

@@ -15,10 +15,13 @@ immediately runs the full capture battery:
 
 Every resulting JSON line is appended to BENCH_LIVE.json with a timestamp
 and the probe evidence; every probe (success or failure) is logged to
-PROBE_LOG_r05.txt.  The watcher exits 0 once the whole battery has
-succeeded at least once (so the session can commit the artifact), or exits
-3 at DEADLINE_S with the probe log as evidence that every relay window was
-tried.
+PROBE_LOG_r05.txt.  Probe failures are *classified* (timeout / connect /
+http / backend / no-output — same taxonomy as bench.py's watchdog) so a
+13/13-probes-failed run is diagnosable after the fact.  The watcher exits
+0 once the whole battery has succeeded at least once (so the session can
+commit the artifact), or exits 3 at DEADLINE_S — writing a structured
+BENCH_FAILURE.json with the per-class failure tally as evidence of what,
+specifically, was down during every window tried.
 
 Usage:  python tools/relay_watcher.py [--poll 240] [--deadline 39600]
 """
@@ -32,6 +35,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LIVE_PATH = os.path.join(REPO, "BENCH_LIVE.json")
 LOG_PATH = os.path.join(REPO, "PROBE_LOG_r05.txt")
+FAIL_PATH = os.environ.get("BENCH_FAIL_ARTIFACT",
+                           os.path.join(REPO, "BENCH_FAILURE.json"))
 
 _PROBE_SRC = """
 import os, sys
@@ -56,24 +61,62 @@ def _log(msg):
         f.write(line + "\n")
 
 
+# Mirror of bench.py's _classify_probe_failure taxonomy.  Kept local on
+# purpose: bench.py validates BENCH_MODE/BENCH_LAYOUT at import time and
+# can sys.exit(1), which must never take the watcher down with it.
+_CONNECT_MARKERS = ("connection refused", "connection reset", "unreachable",
+                    "no route to host", "getaddrinfo",
+                    "name or service not known",
+                    "temporary failure in name resolution",
+                    "failed to connect", "connect failed", "socket error",
+                    "broken pipe", "tunnel", "deadline exceeded")
+_HTTP_MARKERS = ("http error", "status code", "bad gateway",
+                 "service unavailable", "gateway timeout", "http/1.",
+                 " 502", " 503", " 504", " 404")
+
+
+def classify_probe_failure(timed_out, returncode, out, err):
+    """(class, detail) for one failed probe: timeout / connect / http /
+    backend / no-output.  ``detail`` is the last non-empty stderr line."""
+    err = err or ""
+    lines = [ln.strip() for ln in err.splitlines() if ln.strip()]
+    detail = lines[-1][:300] if lines else ""
+    if timed_out:
+        return "timeout", "probe subprocess hung in backend init (killed)"
+    low = err.lower()
+    if any(marker in low for marker in _CONNECT_MARKERS):
+        return "connect", detail
+    if any(marker in low for marker in _HTTP_MARKERS):
+        return "http", detail
+    if detail:
+        return "backend", detail
+    stray = (out or "").strip()
+    if stray:
+        return "no-output", "no PROBE_OK line; stdout was: %r" % stray[:200]
+    return "no-output", "probe exited rc=%s silently" % returncode
+
+
 def probe(timeout_s=45):
-    """Return 'platform kind' string if backend init returns, else None.
+    """Return ('platform kind', None) if backend init returns, else
+    (None, {"class", "detail"}) classifying what was down.
 
     A down relay hangs jax.devices() in native code, so the probe is a
     disposable subprocess the parent can kill."""
     proc = subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
                             stdout=subprocess.PIPE,
-                            stderr=subprocess.DEVNULL, text=True, cwd=REPO)
+                            stderr=subprocess.PIPE, text=True, cwd=REPO)
     try:
-        out, _ = proc.communicate(timeout=timeout_s)
+        out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.communicate()
-        return None
+        cls, detail = classify_probe_failure(True, None, "", "")
+        return None, {"class": cls, "detail": detail}
     for line in out.splitlines():
         if line.startswith("PROBE_OK"):
-            return line[len("PROBE_OK "):].strip()
-    return None
+            return line[len("PROBE_OK "):].strip(), None
+    cls, detail = classify_probe_failure(False, proc.returncode, out, err)
+    return None, {"class": cls, "detail": detail}
 
 
 def _run_capture(name, cmd, env_extra, timeout_s):
@@ -220,13 +263,19 @@ def main():
     _log("watcher start: poll=%gs deadline=%gs battery=%s"
          % (args.poll, args.deadline, [b[0] for b in BATTERY]))
     n_probe = n_fail = 0
+    fail_by_class = {}
+    last_fail = None
     while time.monotonic() - t0 < args.deadline:
         n_probe += 1
-        got = probe(args.probe_timeout)
+        got, fail = probe(args.probe_timeout)
         if got is None:
             n_fail += 1
-            _log("probe %d FAILED (relay down), %d/%d failed so far"
-                 % (n_probe, n_fail, n_probe))
+            fail_by_class[fail["class"]] = \
+                fail_by_class.get(fail["class"], 0) + 1
+            last_fail = fail
+            _log("probe %d FAILED [%s] (%s), %d/%d failed so far"
+                 % (n_probe, fail["class"], fail["detail"] or "no detail",
+                    n_fail, n_probe))
         else:
             _log("probe %d OK: %s — relay is UP, running battery" %
                  (n_probe, got))
@@ -243,7 +292,7 @@ def main():
                 else:
                     # relay may have dropped mid-battery; re-probe before
                     # burning time on the remaining items
-                    if probe(args.probe_timeout) is None:
+                    if probe(args.probe_timeout)[0] is None:
                         _log("relay dropped mid-battery; back to polling")
                         break
             if len(done) == len(BATTERY):
@@ -251,9 +300,37 @@ def main():
                      % len(done))
                 return 0
         time.sleep(args.poll)
-    _log("deadline reached: %d probes, %d failed, captured=%s"
-         % (n_probe, n_fail, sorted(done)))
-    return 3 if len(done) < len(BATTERY) else 0
+    _log("deadline reached: %d probes, %d failed (%s), captured=%s"
+         % (n_probe, n_fail, fail_by_class or "none", sorted(done)))
+    if len(done) < len(BATTERY):
+        # structured failure evidence, same artifact bench.py's watchdog
+        # writes — so the driver reads ONE file to learn what was down
+        record = {
+            "ts": round(time.time(), 1),
+            "source": "relay_watcher",
+            "error": ("deadline reached with %d/%d battery items captured"
+                      % (len(done), len(BATTERY))),
+            "probes": n_probe,
+            "failed_probes": n_fail,
+            "probe_failures_by_class": fail_by_class,
+            "last_probe_failure": last_fail,
+            "captured": sorted(done),
+            "missing": sorted(set(b[0] for b in BATTERY) - done),
+            "deadline_s": args.deadline,
+            "probe_log": os.path.basename(LOG_PATH),
+        }
+        try:
+            tmp = FAIL_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f, indent=1)
+            os.replace(tmp, FAIL_PATH)
+            _log("wrote %s (last failure class: %s)"
+                 % (os.path.basename(FAIL_PATH),
+                    last_fail["class"] if last_fail else "n/a"))
+        except OSError as exc:
+            _log("WARNING: could not write %s: %s" % (FAIL_PATH, exc))
+        return 3
+    return 0
 
 
 if __name__ == "__main__":
