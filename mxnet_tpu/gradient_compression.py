@@ -15,10 +15,77 @@ server-side sum of dequantized workers' values."""
 from __future__ import annotations
 
 import functools
+import threading
+
+
+def quantize_2bit(grad, residual, threshold):
+    """One error-feedback quantization step (traced; jax arrays/tracers).
+
+    ``residual += grad``; emit ±1 int8 codes where the accumulated value
+    crosses ±threshold, subtracting the emitted value from the residual.
+    This single definition serves both the eager kvstore path
+    (:class:`TwoBitCompression` jits it per instance) and the compiled
+    2-bit wire format (parallel/zero.py traces it inside the train step)."""
+    import jax.numpy as jnp
+    acc = residual + grad
+    codes = jnp.where(acc >= threshold, jnp.int8(1),
+                      jnp.where(acc <= -threshold, jnp.int8(-1),
+                                jnp.int8(0)))
+    new_r = acc - codes.astype(acc.dtype) * threshold
+    return codes, new_r
+
+
+class ResidualStore:
+    """Thread-safe per-key error-feedback residual store.
+
+    ONE bookkeeping home for every consumer of the 2-bit codec: the dist
+    kvstore's ``_compressed_allreduce`` (raw jax arrays keyed by kvstore
+    key) and the compiled wire format (NDArray aux handles keyed by
+    parameter name, mutated in place by CachedOp writeback).  The store is
+    value-agnostic; it only guarantees that concurrent pushes (kvstore
+    worker threads) and step dispatches see consistent entries."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._residuals = {}
+
+    def get(self, key, default=None):
+        with self._lock:
+            return self._residuals.get(key, default)
+
+    def set(self, key, value):
+        with self._lock:
+            self._residuals[key] = value
+
+    def get_or_create(self, key, factory):
+        """The entry for ``key``, creating it via ``factory()`` if absent."""
+        with self._lock:
+            value = self._residuals.get(key)
+            if value is None:
+                value = factory()
+                self._residuals[key] = value
+            return value
+
+    def keys(self):
+        with self._lock:
+            return list(self._residuals)
+
+    def clear(self):
+        with self._lock:
+            self._residuals.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._residuals)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._residuals
 
 
 class TwoBitCompression:
-    """Stateless quantizer; callers keep the per-key residual."""
+    """Stateless quantizer; callers keep the per-key residual
+    (:class:`ResidualStore`)."""
 
     def __init__(self, threshold=0.5):
         self.threshold = float(threshold)
@@ -29,19 +96,9 @@ class TwoBitCompression:
     def quantize(self, grad, residual):
         """(grad, residual) -> (int8 codes, new residual).  jax arrays."""
         import jax
-        import jax.numpy as jnp
         if self._jit_quantize is None:
-            t = self.threshold
-
-            def q(g, r):
-                acc = r + g
-                codes = jnp.where(acc >= t, jnp.int8(1),
-                                  jnp.where(acc <= -t, jnp.int8(-1),
-                                            jnp.int8(0)))
-                new_r = acc - codes.astype(acc.dtype) * t
-                return codes, new_r
-
-            self._jit_quantize = jax.jit(q)
+            self._jit_quantize = jax.jit(
+                functools.partial(quantize_2bit, threshold=self.threshold))
         return self._jit_quantize(grad, residual)
 
     def dequantize(self, codes, dtype=None):

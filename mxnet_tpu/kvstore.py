@@ -221,7 +221,17 @@ class KVStore:
         self._compression = dict(compression_params)
         from . import gradient_compression as _gc
         self._compressor = _gc.create(compression_params)
-        self._residuals = {}
+        # ONE shared per-key residual home (gradient_compression.py:
+        # ResidualStore) — the same store class the compiled wire format
+        # (fit(wire_format="2bit")) keys its error-feedback aux state in,
+        # so residual bookkeeping has a single auditable shape
+        self._residuals = _gc.ResidualStore()
+
+    @property
+    def residual_store(self):
+        """The error-feedback :class:`~mxnet_tpu.gradient_compression.
+        ResidualStore` (None until set_gradient_compression)."""
+        return getattr(self, "_residuals", None)
 
     # ------------------------------------------------------------------
     def barrier(self):
@@ -373,7 +383,7 @@ class KVStoreDist(KVStoreTPUSync):
         if res is None:
             res = jnp.zeros_like(merged._data)
         codes, new_res = self._compressor.quantize(merged._data, res)
-        self._residuals[key] = new_res
+        self._residuals.set(key, new_res)
         if self._num_workers > 1 and self._initialized_dist:
             codes = self._allreduce_codes(codes)
         total = self._compressor.dequantize(codes, merged._data.dtype)

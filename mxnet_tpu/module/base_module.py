@@ -146,7 +146,8 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
             sparse_row_id_fn=None, prefetch_to_device=None,
             resume_from=None, auto_resume=False, compiled=None,
-            steps_per_call=1, metric_interval=None, donate="auto"):
+            steps_per_call=1, metric_interval=None, donate="auto",
+            shard_update=False, wire_format=None, wire_threshold=0.5):
         """Train the module (reference base_module.py:410).
 
         Compiled training (default ON, docs/PERF.md "Compiled training
@@ -163,6 +164,19 @@ class BaseModule:
         eager loop with a one-line warning; ``compiled=False`` forces eager.
         Under the compiled path, callbacks observe metric values that lag by
         up to ``metric_interval`` batches.
+
+        ``shard_update=True`` (docs/PERF.md "Sharded weight update (ZeRO)")
+        runs the compiled step's optimizer update ZeRO-sharded over all
+        local devices: optimizer state lives dp-sharded at 1/N bytes per
+        replica and each replica updates only its flat parameter shard
+        (bitwise-equal to the replicated step for elementwise optimizers;
+        checkpoints/resume keep working — the updater's state arrays simply
+        hold the flat sharded form).  ``wire_format="2bit"`` additionally
+        routes the gradient reduce through the error-feedback 2-bit codec
+        (``wire_threshold`` is its quantization step) — 4x fewer wire
+        bytes, with the residual carried per replica in the module's shared
+        ResidualStore.  Both require the compiled path: configurations that
+        fall back to eager train replicated, with the usual warning.
 
         ``prefetch_to_device`` (a Context) routes each epoch's batches
         through an ``io.DeviceFeed``: a background thread stays up to two
@@ -249,6 +263,10 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        if (shard_update or wire_format is not None) and compiled is not None \
+                and not compiled:
+            raise ValueError("shard_update/wire_format need the compiled "
+                             "path (fit(compiled=False) trains replicated)")
         compiled_step = None
         if compiled is None or compiled:
             from .compiled_step import (CompiledTrainStep,
@@ -262,13 +280,22 @@ class BaseModule:
                 try:
                     compiled_step = CompiledTrainStep.from_module(
                         self, eval_metric=eval_metric,
-                        steps_per_call=steps_per_call, donate=donate)
+                        steps_per_call=steps_per_call, donate=donate,
+                        shard_update=shard_update, wire_format=wire_format,
+                        wire_threshold=wire_threshold)
                 except CompiledStepUnsupported as exc:
                     reason = str(exc)
             if compiled_step is None:
-                self.logger.warning(
-                    "fit(compiled=%s): falling back to the eager loop: %s",
-                    compiled, reason)
+                if shard_update or wire_format is not None:
+                    self.logger.warning(
+                        "fit(shard_update=%s, wire_format=%s): the ZeRO "
+                        "sharded update is unavailable here — training "
+                        "REPLICATED via the eager loop: %s",
+                        shard_update, wire_format, reason)
+                else:
+                    self.logger.warning(
+                        "fit(compiled=%s): falling back to the eager loop: "
+                        "%s", compiled, reason)
         self._compiled_step = compiled_step
 
         for epoch in range(begin_epoch, num_epoch):

@@ -151,6 +151,31 @@ def _metric_leaves(metric):
     return [metric]
 
 
+class _ShardInfo:
+    """Static layout of a ``shard_update=True`` step (docs/PERF.md "Sharded
+    weight update"): the 1-D dp mesh, per-parameter flat/padded metas
+    (parallel/zero.py), the wire-format threshold (None = fp32 reduce), and
+    the ``r:`` aux key per parameter when the 2-bit codec is on."""
+
+    def __init__(self, mesh, dp, wire, metas, residual_keys):
+        self.mesh = mesh
+        self.dp = dp
+        self.wire = wire            # quantization threshold, or None
+        self.metas = metas          # pkey -> parallel.zero.ParamMeta
+        self.residual_keys = residual_keys   # pkey -> "r:<name>"
+
+    def state_spec(self, key):
+        """The PartitionSpec a state entry holds in steady state: optimizer
+        leaves live flat-sharded over dp (the ZeRO 1/N win), residual rows
+        shard over the replica axis, everything else is replicated."""
+        from jax.sharding import PartitionSpec as P
+        if key.startswith("o:"):
+            return P("dp")
+        if key.startswith("r:"):
+            return P("dp", None)
+        return P()
+
+
 def _resolve_donate(donate, ctx):
     if donate != "auto":
         return bool(donate)
@@ -183,9 +208,10 @@ class CompiledTrainStep:
 
     def __init__(self, microstep, state_nd, optimizer, opt_bindings,
                  opt_indices, metrics, metric_keys, n_inputs, keys_per_step,
-                 steps_per_call, ctx, donate, owner=None):
+                 steps_per_call, ctx, donate, owner=None, shard=None):
         if steps_per_call < 1:
             raise ValueError("steps_per_call must be >= 1")
+        self._shard = shard
         self._microstep = microstep
         self.state = state_nd
         self._state_names = sorted(state_nd)
@@ -214,9 +240,14 @@ class CompiledTrainStep:
         opt = self._optimizer
         n_keys = self._keys_per_step
 
+        shard = self._shard
+
         def apply_optimizer(carry, new_carry, grads, lr_t, t_t):
             """Run the optimizer's own (traced) update kernels over NDArray
             wrappers of the carry values; harvest the mutated handles."""
+            if shard is not None:
+                return apply_optimizer_sharded(carry, new_carry, grads,
+                                               lr_t, t_t)
             staged = []
             for index, pkey, template, leaf_keys in opt_bindings:
                 weight = NDArray(new_carry.get(pkey, carry[pkey]))
@@ -231,6 +262,96 @@ class CompiledTrainStep:
                 new_carry[pkey] = weight._data
                 for key, leaf in zip(leaf_keys, _state_leaf_nds(state)):
                     new_carry[key] = leaf._data
+
+        def apply_optimizer_sharded(carry, new_carry, grads, lr_t, t_t):
+            """The ZeRO variant: ONE shard_map region updates every
+            parameter's flat 1/N slice on its owning replica (optimizer
+            state enters as true dp-sharded vectors, so the in_specs are
+            free slicing, not resharding), then all-gathers the updated
+            shards.  For elementwise optimizers this is bitwise the full
+            update (docs/PERF.md).  With the 2-bit wire format on, each
+            replica EF-quantizes the full flat gradient against its own
+            residual row and the int8 codes cross the wire reduce-scattered
+            as int32."""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+            from ..parallel.collectives import allgather
+            from ..parallel.zero import (flatten_param, unflatten_param,
+                                         quantized_reduce_scatter)
+            axis = "dp"
+            wire_t = shard.wire
+            repl = NamedSharding(shard.mesh, P())
+            wf, gf, sf, rf = [], [], [], []
+            for index, pkey, template, leaf_keys in opt_bindings:
+                meta = shard.metas[pkey]
+                # pin the raw gradient (and weight) REPLICATED before it
+                # feeds the shard_map: without the constraint GSPMD
+                # back-propagates the region's P("dp") in_specs into the
+                # vjp itself and partitions the backward reductions —
+                # different summation order, so grads drift a ulp from the
+                # replicated program and the bitwise parity gate breaks
+                w_full = jax.lax.with_sharding_constraint(
+                    new_carry.get(pkey, carry[pkey]), repl)
+                g_full = jax.lax.with_sharding_constraint(grads[pkey], repl)
+                wf.append(flatten_param(w_full, meta.padded))
+                gf.append(flatten_param(g_full, meta.padded))
+                sf.append(tuple(carry[k] for k in leaf_keys))
+                if wire_t is not None:
+                    rf.append(carry[shard.residual_keys[pkey]])
+            wf, gf, sf, rf = tuple(wf), tuple(gf), tuple(sf), tuple(rf)
+
+            def region(wl, gl, sl, rl, lr_v, t_v):
+                staged = []
+                new_r = []
+                for i, (index, pkey, template, leaf_keys) in \
+                        enumerate(opt_bindings):
+                    if wire_t is not None:
+                        # fit-path gradients are replicated (the batch is),
+                        # so the psum_scatter/dp mean of dp identical
+                        # dequantized copies models exactly one quantizer
+                        g_shard, r_new = quantized_reduce_scatter(
+                            gl[i], rl[i][0], wire_t, axis, shard.dp)
+                        new_r.append(r_new[None])
+                    else:
+                        g_shard = gl[i]   # in_spec P("dp") sliced it
+                    weight = NDArray(wl[i])
+                    grad = NDArray(g_shard)
+                    leaves = iter([NDArray(v) for v in sl[i]])
+                    state = _rebuild_state(template, leaves)
+                    staged.append((index, weight, grad, state))
+                with _step_hyperparams(opt, lr_v, t_v):
+                    for index, weight, grad, state in staged:
+                        opt.update_multi_precision(index, weight, grad,
+                                                   state)
+                out_w = tuple(allgather(weight._data, axis)
+                              for _, weight, _, _ in staged)
+                out_s = tuple(tuple(leaf._data
+                                    for leaf in _state_leaf_nds(state))
+                              for _, _, _, state in staged)
+                return out_w, out_s, tuple(new_r)
+
+            s_specs = tuple(tuple(P(axis) for _ in s) for s in sf)
+            r_specs = tuple(P(axis, None) for _ in rf)
+            region_sh = shard_map(
+                region, mesh=shard.mesh,
+                in_specs=(tuple(P(axis) for _ in wf),
+                          tuple(P() if wire_t is not None else P(axis)
+                                for _ in gf),
+                          s_specs, r_specs, P(), P()),
+                out_specs=(tuple(P() for _ in wf), s_specs, r_specs),
+                check_rep=False)
+            new_w, new_s, new_r = region_sh(wf, gf, sf, rf, lr_t, t_t)
+            for i, (index, pkey, template, leaf_keys) in \
+                    enumerate(opt_bindings):
+                meta = shard.metas[pkey]
+                new_carry[pkey] = unflatten_param(new_w[i], meta.shape,
+                                                  meta.size)
+                for key, leaf in zip(leaf_keys, new_s[i]):
+                    new_carry[key] = leaf
+                if wire_t is not None:
+                    new_carry[shard.residual_keys[pkey]] = new_r[i]
 
         def body(carry, xs):
             import jax.numpy as jnp
@@ -276,6 +397,18 @@ class CompiledTrainStep:
                 carry, ys = jax.lax.scan(body, carry, {
                     "t": t_nd._data, "lr": lr_nd._data, "keys": keys,
                     "in": in_vals})
+            if shard is not None:
+                # pin every carried output to its canonical steady-state
+                # sharding: without the constraint GSPMD may pick a
+                # different output layout than the inputs arrived with,
+                # and step 2 would silently recompile on the changed
+                # input shardings (a stealth recompile cache_stats cannot
+                # see — its signature is shapes/dtypes only)
+                from jax.sharding import NamedSharding
+                carry = {k: jax.lax.with_sharding_constraint(
+                             v, NamedSharding(shard.mesh,
+                                              shard.state_spec(k)))
+                         for k, v in carry.items()}
             for k in state_names:
                 p[k]._set_data(carry[k])
             return NDArray(ys)
@@ -292,6 +425,17 @@ class CompiledTrainStep:
             ts.append(float(t))
             lrs.append(float(opt.lr_scheduler(t))
                        if opt.lr_scheduler is not None else float(opt.lr))
+        if self._shard is not None:
+            # every step input must live on the mesh: a vector committed to
+            # a single device cannot enter the same jit as dp-sharded state
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..ndarray import from_jax
+            repl = NamedSharding(self._shard.mesh, P())
+            return (from_jax(jax.device_put(_np.asarray(ts, _np.float32),
+                                            repl), ctx=self._ctx),
+                    from_jax(jax.device_put(_np.asarray(lrs, _np.float32),
+                                            repl), ctx=self._ctx))
         from ..ndarray import array
         return (array(_np.asarray(ts, _np.float32), ctx=self._ctx),
                 array(_np.asarray(lrs, _np.float32), ctx=self._ctx))
@@ -325,7 +469,16 @@ class CompiledTrainStep:
         stacked = []
         for j in range(len(batches_io[0])):
             vals = [b[j]._data for b in batches_io]
-            stacked.append(_wrap(jnp.stack(vals), ctx=self._ctx))
+            val = jnp.stack(vals)
+            if self._shard is not None:
+                # replicate the window onto the mesh (the shard_update fit
+                # path keeps the batch replicated — the sharding is of the
+                # UPDATE and optimizer state, docs/PERF.md)
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                val = jax.device_put(
+                    val, NamedSharding(self._shard.mesh, P()))
+            stacked.append(_wrap(val, ctx=self._ctx))
         with autograd.train_mode():
             out = self.cached_op(self.state, t_nd, lr_nd, *stacked)
         self._advance_counts(window)
@@ -360,8 +513,13 @@ class CompiledTrainStep:
         an UNcommitted constant would flip the jit cache key and silently
         recompile the whole step on the next window."""
         import jax
-        dev = self._ctx.jax_device() if self._ctx is not None \
-            else jax.devices()[0]
+        if self._shard is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            dev = NamedSharding(self._shard.mesh, P())
+        elif self._ctx is not None:
+            dev = self._ctx.jax_device()
+        else:
+            dev = jax.devices()[0]
         # a fresh numpy scalar per call: jnp constants can be cached, and a
         # shared buffer across state slots would defeat per-slot donation
         return jax.device_put(_np.zeros((), _np.float32), dev)
@@ -375,14 +533,29 @@ class CompiledTrainStep:
     # ------------------------------------------------------------------
     @classmethod
     def from_module(cls, module, eval_metric=None, steps_per_call=1,
-                    donate="auto"):
+                    donate="auto", shard_update=False, wire_format=None,
+                    wire_threshold=0.5, residual_store=None):
         """Capture a bound Module's forward+backward+update as one CachedOp.
 
         State handles are the executor's own ``arg_dict``/``aux_dict``
         entries and the updater's state arrays — so ``get_params()``,
         ``save_optimizer_states()`` and crash-resume (docs/ROBUSTNESS.md)
         see exactly what the step trains, and a run killed mid-epoch
-        resumes bitwise like the eager path."""
+        resumes bitwise like the eager path.
+
+        ``shard_update=True`` builds the step over the default 1-D dp mesh
+        (all local devices): parameters/aux replicate across the mesh while
+        optimizer state converts IN PLACE to flat dp-sharded vectors
+        (1/N bytes per replica — ZeRO-1/2), and the update runs per-shard
+        inside a shard_map region (bitwise-equal to the replicated step for
+        elementwise optimizers).  The SAME updater state handles now hold
+        the flat vectors, so save/load_optimizer_states and crash-resume
+        keep working bitwise — a restored flat vector is recognized by its
+        padded size and re-placed sharded.  ``wire_format="2bit"`` adds the
+        error-feedback quantized gradient reduce, with per-replica residual
+        rows riding as ``r:`` aux entries keyed in ``residual_store`` (one
+        shared :class:`~mxnet_tpu.gradient_compression.ResidualStore`; by
+        default the module's own, so residuals carry across fit calls)."""
         handles_fn = getattr(module, "_compiled_step_handles", None)
         if handles_fn is None:
             raise CompiledStepUnsupported(
@@ -394,6 +567,22 @@ class CompiledTrainStep:
         if updater is None:
             raise CompiledStepUnsupported("no local updater")
         _check_optimizer(opt)
+        if wire_format not in (None, "2bit"):
+            raise ValueError("unknown wire_format %r (supported: '2bit')"
+                             % (wire_format,))
+        if wire_format is not None and not shard_update:
+            raise ValueError("wire_format=%r requires shard_update=True"
+                             % (wire_format,))
+        shard_mesh = None
+        if shard_update:
+            if not getattr(opt, "elementwise", False):
+                raise CompiledStepUnsupported(
+                    "optimizer %s is not elementwise: the ZeRO sharded "
+                    "update runs the update rule on flat 1/N parameter "
+                    "slices, which is only the full update for per-element "
+                    "rules" % type(opt).__name__)
+            from ..parallel import make_mesh
+            shard_mesh = make_mesh()
         metrics = _metric_leaves(eval_metric)
 
         param_names = [n for n in h["param_names"] if n in exe.arg_names]
@@ -448,7 +637,14 @@ class CompiledTrainStep:
                 state_nd[key] = leaf
             opt_bindings.append((index, "p:" + n, template, leaf_keys))
             opt_indices.append(index)
-        metric_keys = cls._metric_state(state_nd, metrics, h["context"])
+
+        shard = None
+        if shard_mesh is not None:
+            shard = cls._shard_state(
+                state_nd, opt_bindings, exe, shard_mesh, wire_format,
+                wire_threshold, residual_store, h)
+        metric_keys = cls._metric_state(state_nd, metrics, h["context"],
+                                        mesh=shard_mesh)
 
         input_pos = {n: i for i, n in enumerate(input_names)}
         label_idx = [input_pos[n] for n in h["label_names"]]
@@ -489,7 +685,79 @@ class CompiledTrainStep:
 
         return cls(microstep, state_nd, opt, opt_bindings, opt_indices,
                    metrics, metric_keys, len(input_names), n_rng,
-                   steps_per_call, h["context"], donate, owner=module)
+                   steps_per_call, h["context"], donate, owner=module,
+                   shard=shard)
+
+    @staticmethod
+    def _shard_state(state_nd, opt_bindings, exe, mesh, wire_format,
+                     wire_threshold, residual_store, h):
+        """Re-place the step's state for shard_update mode, IN PLACE on the
+        live handles: params/aux replicate over the mesh (a single-device-
+        committed array cannot enter the same jit as mesh-sharded state),
+        optimizer-state leaves flatten+pad to dp-sharded vectors (the
+        updater now holds — and checkpoints — the flat form; a leaf already
+        flat from a resumed checkpoint is re-placed bitwise), and the wire
+        format's per-replica residual rows are created (or adopted from the
+        shared ResidualStore) as ``r:`` aux entries."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..ndarray import from_jax
+        from ..parallel.zero import (param_meta, check_flat_state,
+                                     flatten_param)
+
+        dp = int(mesh.shape["dp"])
+        repl = NamedSharding(mesh, P())
+        vec = NamedSharding(mesh, P("dp"))
+        row = NamedSharding(mesh, P("dp", None))
+
+        for key, nd in state_nd.items():
+            if key.startswith(("p:", "a:")):
+                nd._set_data(jax.device_put(nd._data, repl))
+
+        metas, residual_keys = {}, {}
+        store = None
+        if wire_format == "2bit":
+            store = residual_store
+            if store is None:
+                make_store = h.get("residual_store")
+                store = make_store() if make_store is not None else None
+            if store is None:
+                from ..gradient_compression import ResidualStore
+                store = ResidualStore()
+        for index, pkey, template, leaf_keys in opt_bindings:
+            name = pkey[2:]
+            weight = exe.arg_dict[name]
+            meta = param_meta(name, weight._data, dp)
+            metas[pkey] = meta
+            for key in leaf_keys:
+                leaf = state_nd[key]
+                padded = check_flat_state(name, int(leaf._data.size),
+                                          meta.size, dp)
+                flat = flatten_param(leaf._data.reshape(-1), padded)
+                leaf._set_data(jax.device_put(flat, vec))
+            if store is not None:
+                rkey = "r:" + name
+
+                def make_residual(meta=meta, dtype=weight._data.dtype):
+                    return from_jax(
+                        jax.device_put(
+                            jnp.zeros((dp, meta.padded), dtype), row),
+                        ctx=h["context"])
+
+                res_nd = store.get_or_create(name, make_residual)
+                if tuple(res_nd.shape) != (dp, meta.padded):
+                    raise ValueError(
+                        "sharded-update flattener: residual for parameter "
+                        "%r has shape %s; expected (%d, %d) for dp=%d"
+                        % (name, tuple(res_nd.shape), dp, meta.padded, dp))
+                # adopt a carried-over residual onto this mesh (bitwise)
+                res_nd._set_data(jax.device_put(res_nd._data, row))
+                state_nd[rkey] = res_nd
+                residual_keys[pkey] = rkey
+        return _ShardInfo(mesh, dp,
+                          wire_threshold if wire_format == "2bit" else None,
+                          metas, residual_keys)
 
     @classmethod
     def from_block(cls, block, loss_fn, optimizer, n_inputs=1,
@@ -549,14 +817,18 @@ class CompiledTrainStep:
                    1, steps_per_call, ctx, donate)
 
     @staticmethod
-    def _metric_state(state_nd, metrics, ctx):
+    def _metric_state(state_nd, metrics, ctx, mesh=None):
         """Allocate the (sum, count) scalar accumulator pair per metric
         (device-committed, matching the steady-state jit-output buffers —
-        see _committed_zero)."""
+        see _committed_zero; mesh-replicated under shard_update)."""
         import jax
         from ..ndarray import from_jax
         metric_keys = []
-        dev = ctx.jax_device() if ctx is not None else jax.devices()[0]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            dev = NamedSharding(mesh, P())
+        else:
+            dev = ctx.jax_device() if ctx is not None else jax.devices()[0]
         for j, _m in enumerate(metrics):
             skey, ckey = "m:%d:s" % j, "m:%d:n" % j
             for key in (skey, ckey):

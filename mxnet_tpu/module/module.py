@@ -390,6 +390,21 @@ class Module(BaseModule):
         assert self.binded
         self._exec_group.install_monitor(mon)
 
+    def gradient_residual_store(self):
+        """The module's error-feedback residual store
+        (:class:`~mxnet_tpu.gradient_compression.ResidualStore`), created
+        on first use and persistent for the module's lifetime — the same
+        per-key store shape the dist kvstore's ``set_gradient_compression``
+        path keeps, here adopted by ``fit(wire_format="2bit")``'s compiled
+        2-bit reduce so the quantization residual carries across steps AND
+        across fit() calls."""
+        store = getattr(self, "_residual_store", None)
+        if store is None:
+            from ..gradient_compression import ResidualStore
+            store = ResidualStore()
+            self._residual_store = store
+        return store
+
     def _compiled_step_handles(self):
         """Everything CompiledTrainStep.from_module needs to capture this
         module's whole training iteration as one CachedOp, or raise
@@ -433,6 +448,7 @@ class Module(BaseModule):
             "data_names": [d.name for d in self._data_shapes],
             "label_names": [l.name for l in (self._label_shapes or [])],
             "context": self._context[0],
+            "residual_store": self.gradient_residual_store,
         }
 
     def prepare(self, data_batch, sparse_row_id_fn=None):

@@ -19,8 +19,12 @@ The reference only ships DP + manual model parallelism + sparse-PS semantics
 from .mesh import (make_mesh, default_mesh, data_parallel_spec, replicated_spec,
                    local_device_count, MeshConfig)
 from .collectives import (allreduce, allgather, reduce_scatter, ppermute_ring,
-                          barrier_sync)
+                          barrier_sync, axis_size)
 from .data_parallel import make_data_parallel_train_step, shard_batch
+from .zero import (init_shard_update_state, make_sharded_update_step,
+                   quantized_reduce_scatter, padded_size, flatten_param,
+                   unflatten_param, check_dp_divisible, check_flat_state,
+                   param_meta, ParamMeta)
 from .ring_attention import ring_attention, sequence_parallel_attention
 from .pipeline import pipeline_apply, make_pipeline_step
 from .ulysses import ulysses_attention_local, ulysses_parallel_attention

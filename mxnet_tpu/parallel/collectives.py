@@ -19,16 +19,26 @@ def allgather(x, axis_name="dp", axis=0, tiled=True):
 
 
 def reduce_scatter(x, axis_name="dp", scatter_dimension=0):
+    """psum_scatter: each replica receives the sum of its 1/N tile only —
+    half of an allreduce, and the gradient half the ZeRO sharded update
+    (parallel/zero.py) needs.  Works on integer dtypes too, which is how
+    the 2-bit wire format accumulates int8 codes in int32 in-graph."""
     import jax
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
                                 tiled=True)
+
+
+def axis_size(axis_name="dp"):
+    """The extent of a mesh axis, from inside the traced region."""
+    import jax
+    return jax.lax.psum(1, axis_name)
 
 
 def ppermute_ring(x, axis_name, shift=1):
     """Rotate shards around the ring — the building block of ring attention
     and of bandwidth-optimal bidirectional allreduce on ICI."""
     import jax
-    n = jax.lax.psum(1, axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 

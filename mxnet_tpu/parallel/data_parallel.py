@@ -11,11 +11,19 @@ import functools
 
 
 def shard_batch(mesh, batch):
-    """Place host batch (numpy / jax arrays) sharded over the dp axis."""
+    """Place host batch (numpy / jax arrays) sharded over the dp axis.
+
+    The leading (batch) dimension of every leaf must divide evenly over the
+    mesh's ``dp`` extent; an uneven batch raises a ValueError naming both
+    numbers instead of XLA's opaque sharding failure."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from .zero import check_dp_divisible
+
+    dp = int(mesh.shape.get("dp", 1))
 
     def put(x):
+        check_dp_divisible("shard_batch", int(x.shape[0]), dp)
         spec = P("dp", *([None] * (x.ndim - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
     return jax.tree_util.tree_map(put, batch)
@@ -23,7 +31,9 @@ def shard_batch(mesh, batch):
 
 def make_data_parallel_train_step(loss_fn, optimizer_update, mesh,
                                   donate_params=True, param_shardings=None,
-                                  opt_state_shardings=None):
+                                  opt_state_shardings=None,
+                                  shard_update=False, wire_format=None,
+                                  wire_threshold=0.5):
     """Build a pjit'ed step: (params, opt_state, batch) -> (params, opt_state, loss).
 
     loss_fn(params, batch) -> scalar loss (jax-traceable).
@@ -35,9 +45,30 @@ def make_data_parallel_train_step(loss_fn, optimizer_update, mesh,
     per-parameter (a pytree prefix of NamedShardings matching ``params``) —
     this is how tensor-parallel weight sharding composes with the dp axis:
     tp-sharded params get tp-sharded grads and updates with no resharding.
+
+    ``shard_update=True`` switches to the ZeRO-sharded update
+    (parallel/zero.py, docs/PERF.md "Sharded weight update"): gradients are
+    reduce-scattered over ``dp``, the — necessarily elementwise —
+    ``optimizer_update`` runs on each replica's 1/N flat shard of
+    params + optimizer state (state lives sharded; build it with
+    :func:`~mxnet_tpu.parallel.init_shard_update_state`), and the updated
+    shards are all-gathered.  Bitwise-equal to the replicated step at fp32.
+    ``wire_format="2bit"`` additionally ships the gradient reduce as
+    error-feedback int8 codes (4x fewer wire bytes; int32 in-graph
+    accumulation), with the residual carried in the step's state dict.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if shard_update:
+        from .zero import make_sharded_update_step
+        return make_sharded_update_step(
+            loss_fn, optimizer_update, mesh, donate_params=donate_params,
+            wire_format=wire_format, wire_threshold=wire_threshold)
+    if wire_format is not None:
+        raise ValueError("wire_format=%r requires shard_update=True (the "
+                         "quantized reduce lives under the sharded update)"
+                         % (wire_format,))
 
     repl = NamedSharding(mesh, P())
     p_shard = param_shardings if param_shardings is not None else repl

@@ -1,0 +1,264 @@
+"""ZeRO-style sharded weight update over the ``dp`` axis.
+
+Naive data parallelism (parallel/data_parallel.py default path) replicates
+parameters AND optimizer state on every replica and allreduces full fp32
+gradients — per-replica memory and wire bytes both scale with the full
+model.  This module implements the sharded-update alternative of
+ZeRO-1/2 (arxiv 2004.13336), expressed entirely as XLA collectives inside
+one compiled module:
+
+  1. reduce-scatter the (flattened, padded) gradients over ``dp`` — each
+     replica receives the mean gradient for its 1/N contiguous shard;
+  2. run the (elementwise) optimizer update on that shard only — optimizer
+     state lives sharded, so momentum/Adam slots cost 1/N per replica;
+  3. all-gather the updated parameter shards for the next forward.
+
+Because a reduce-scatter + all-gather pair moves exactly the bytes of one
+allreduce, the sharding is bandwidth-neutral at fp32 — and the optional
+2-bit error-feedback wire format (``wire_format="2bit"``, EQuARX-style,
+arxiv 2506.17615) then cuts the reduce's wire bytes 4x by shipping int8
+codes (summed in int32 in-graph) instead of fp32 words, with the
+quantization error carried in a per-replica residual
+(gradient_compression.py).
+
+Bitwise contract (asserted in tests/test_parallel.py and
+tests/test_multichip_topologies.py): at fp32 the sharded step is
+bitwise-equal to the replicated step for elementwise optimizers — XLA's
+``psum_scatter`` produces the same partial sums as ``psum`` followed by a
+slice, and slice → elementwise update → all-gather is the identity
+rearrangement of the full update.
+"""
+from __future__ import annotations
+
+import math
+from collections import namedtuple
+
+__all__ = ["padded_size", "check_dp_divisible", "check_flat_state",
+           "flatten_param", "unflatten_param", "param_meta", "ParamMeta",
+           "quantized_reduce_scatter", "make_sharded_update_step",
+           "init_shard_update_state"]
+
+#: static per-parameter layout of the flattened/padded shard space:
+#: ``size`` raw elements padded with zeros to ``padded`` (= shard * dp) so
+#: every replica owns an equal contiguous ``shard``-element slice.
+ParamMeta = namedtuple("ParamMeta", ["name", "shape", "dtype", "size",
+                                     "padded", "shard"])
+
+
+def padded_size(size, dp):
+    """Smallest multiple of ``dp`` >= ``size`` (0-size params pad to dp)."""
+    return max(1, math.ceil(size / dp)) * dp
+
+
+def check_dp_divisible(name, extent, dp, what="leading (batch) dimension"):
+    """Raise the clear error XLA would otherwise bury in a sharding
+    failure: ``extent`` must split evenly over the mesh's dp axis."""
+    if extent % dp != 0:
+        raise ValueError(
+            "%s: %s of %d is not divisible by the mesh 'dp' axis extent %d "
+            "(pad or drop the remainder of %d)"
+            % (name, what, extent, dp, extent % dp))
+
+
+def check_flat_state(name, got_size, full_size, dp):
+    """Validate a pre-flattened sharded-update array for parameter ``name``.
+
+    Accepts either the parameter's raw element count (``full_size`` — will
+    be padded) or the already-padded flat size; anything else is a layout
+    mismatch and raises naming the parameter, the observed size, and the
+    dp extent so the caller is not left with XLA's opaque error."""
+    padded = padded_size(full_size, dp)
+    if got_size not in (full_size, padded):
+        raise ValueError(
+            "sharded-update flattener: state for parameter %r has %d "
+            "elements; expected %d (the parameter) or %d (padded to a "
+            "multiple of the dp=%d axis extent)"
+            % (name, got_size, full_size, padded, dp))
+    return padded
+
+
+def param_meta(name, arr, dp):
+    size = int(_prod(arr.shape))
+    padded = padded_size(size, dp)
+    return ParamMeta(name, tuple(arr.shape), arr.dtype, size, padded,
+                     padded // dp)
+
+
+def _prod(shape):
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
+
+
+def flatten_param(x, padded):
+    """[...]-shaped array -> zero-padded flat [padded] vector."""
+    import jax.numpy as jnp
+    flat = x.reshape(-1)
+    if flat.shape[0] == padded:
+        return flat
+    return jnp.pad(flat, (0, padded - flat.shape[0]))
+
+
+def unflatten_param(flat, shape, size):
+    """Inverse of :func:`flatten_param`: drop the pad, restore the shape."""
+    return flat[:size].reshape(shape)
+
+
+def quantized_reduce_scatter(grad_flat, residual, threshold, axis_name="dp",
+                             axis_size=None):
+    """EF-quantized gradient reduce-scatter: the ``wire_format="2bit"`` hot
+    path shared by the mesh step and the compiled fit step.
+
+    Each replica quantizes its full flat gradient against its own residual
+    (error feedback: the quantization error rides into the next step), the
+    int8 codes cross the wire summed as int32 (1 byte/element vs 4 for
+    fp32), and each replica dequantizes only the shard it owns.  Returns
+    ``(mean gradient shard, new residual)``."""
+    import jax
+    import jax.numpy as jnp
+    from ..gradient_compression import quantize_2bit
+    from .collectives import reduce_scatter
+    n = axis_size if axis_size is not None else jax.lax.psum(1, axis_name)
+    codes, new_residual = quantize_2bit(grad_flat, residual, threshold)
+    summed = reduce_scatter(codes.astype(jnp.int32), axis_name)
+    g_shard = summed.astype(grad_flat.dtype) * (threshold / n)
+    return g_shard, new_residual
+
+
+def _check_wire_format(wire_format):
+    if wire_format not in (None, "2bit"):
+        raise ValueError("unknown wire_format %r (supported: '2bit')"
+                         % (wire_format,))
+
+
+def init_shard_update_state(mesh, params, opt_state, wire_format=None):
+    """Place optimizer state (and wire-format residuals) for a
+    ``shard_update=True`` step built by
+    :func:`~mxnet_tpu.parallel.make_data_parallel_train_step`.
+
+    Non-scalar ``opt_state`` leaves — which must align elementwise with a
+    parameter — are flattened, zero-padded to a multiple of the dp extent,
+    and placed sharded ``P("dp")`` (1/N bytes per replica, the ZeRO-1/2
+    win); scalar leaves stay replicated.  With ``wire_format="2bit"`` a
+    zero residual of global shape ``[dp, padded]`` is allocated per
+    parameter, sharded on the replica axis so each replica owns only its
+    own error-feedback row.  Returns the ``state`` dict the sharded step
+    carries: ``{"opt": ..., "residual": ...}``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    _check_wire_format(wire_format)
+    dp = int(mesh.shape["dp"])
+    sharded = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+    row_sharded = NamedSharding(mesh, P("dp", None))
+
+    def place(leaf):
+        leaf = jnp.asarray(leaf)
+        if leaf.ndim == 0:
+            return jax.device_put(leaf, repl)
+        flat = flatten_param(leaf, padded_size(leaf.size, dp))
+        return jax.device_put(flat, sharded)
+
+    def residual_like(leaf):
+        leaf = jnp.asarray(leaf)
+        return jax.device_put(
+            jnp.zeros((dp, padded_size(leaf.size, dp)), leaf.dtype),
+            row_sharded)
+
+    state = {"opt": jax.tree_util.tree_map(place, opt_state)}
+    state["residual"] = (jax.tree_util.tree_map(residual_like, params)
+                         if wire_format == "2bit" else None)
+    return state
+
+
+def make_sharded_update_step(loss_fn, optimizer_update, mesh,
+                             donate_params=True, wire_format=None,
+                             wire_threshold=0.5):
+    """The ``shard_update=True`` engine behind
+    :func:`~mxnet_tpu.parallel.make_data_parallel_train_step`.
+
+    Same calling convention as the replicated step —
+    ``step(params, state, batch) -> (params, state, loss)`` — except
+    ``state`` is the dict from :func:`init_shard_update_state` and
+    ``optimizer_update(grads, opt_state, params)`` must be ELEMENTWISE: it
+    is invoked on flat 1/N shards (grads/params pytrees keep their
+    structure but every leaf is a flat ``[padded/dp]`` slice), which is
+    exactly the full update restricted to each replica's slice for any
+    per-element rule (SGD/momentum/Adam-family)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from .collectives import allgather, reduce_scatter
+
+    _check_wire_format(wire_format)
+    axis = "dp"
+    dp = int(mesh.shape[axis])
+    tree = jax.tree_util
+
+    def step(params, state, batch):
+        p_leaves, p_def = tree.tree_flatten(params)
+        metas = [param_meta("param[%d]" % i, l, dp)
+                 for i, l in enumerate(p_leaves)]
+        residual = state["residual"]
+        res_leaves = [] if residual is None else tree.tree_leaves(residual)
+
+        opt_leaves, opt_def = tree.tree_flatten(state["opt"])
+        opt_specs = tree.tree_unflatten(
+            opt_def, [P() if l.ndim == 0 else P(axis) for l in opt_leaves])
+        batch_leaves, batch_def = tree.tree_flatten(batch)
+        for i, leaf in enumerate(batch_leaves):
+            check_dp_divisible("shard_update step: batch leaf %d" % i,
+                               int(leaf.shape[0]), dp)
+        batch_specs = tree.tree_unflatten(
+            batch_def,
+            [P(axis, *([None] * (l.ndim - 1))) for l in batch_leaves])
+        res_specs = [P(axis, None)] * len(res_leaves)
+
+        def body(params, opt_state, res_list, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss = jax.lax.pmean(loss, axis)
+            idx = jax.lax.axis_index(axis)
+            g_shards, p_shards, new_res = [], [], []
+            gl = tree.tree_leaves(grads)
+            pl = tree.tree_leaves(params)
+            for i, meta in enumerate(metas):
+                gf = flatten_param(gl[i], meta.padded)
+                if res_list:
+                    g_shard, r_new = quantized_reduce_scatter(
+                        gf, res_list[i][0], wire_threshold, axis, dp)
+                    new_res.append(r_new[None])
+                else:
+                    g_shard = reduce_scatter(gf, axis) / dp
+                pf = flatten_param(pl[i], meta.padded)
+                p_shards.append(jax.lax.dynamic_slice(
+                    pf, (idx * meta.shard,), (meta.shard,)))
+                g_shards.append(g_shard)
+            new_p, new_opt = optimizer_update(
+                tree.tree_unflatten(p_def, g_shards), opt_state,
+                tree.tree_unflatten(p_def, p_shards))
+            out_p = []
+            for meta, shard in zip(metas, tree.tree_leaves(new_p)):
+                full = allgather(shard, axis)
+                out_p.append(unflatten_param(full, meta.shape, meta.size))
+            return (tree.tree_unflatten(p_def, out_p), new_opt, new_res,
+                    loss)
+
+        sharded = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), opt_specs, res_specs, batch_specs),
+            out_specs=(P(), opt_specs, res_specs, P()),
+            check_rep=False)
+        new_params, new_opt, new_res, loss = sharded(
+            params, state["opt"], res_leaves, batch)
+        new_state = {"opt": new_opt,
+                     "residual": (None if residual is None else
+                                  tree.tree_unflatten(
+                                      tree.tree_structure(residual),
+                                      new_res))}
+        return new_params, new_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate_params else ())

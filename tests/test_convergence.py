@@ -127,3 +127,24 @@ def test_checkpoint_resume_continues_convergence():
                  optimizer="sgd",
                  optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
         assert metric.get()[1] > 0.95, metric.get()
+
+
+def test_mlp_zero_shard_2bit_wire_convergence():
+    """ISSUE 10 acceptance: a short convergence run with the ZeRO sharded
+    update AND the error-feedback 2-bit wire stays inside the documented
+    envelope (docs/PERF.md "When to enable"): same blob task as the fp32
+    test above, threshold near the per-step gradient scale, final train
+    accuracy above the same 0.95 bar."""
+    X, Y = _blob_data(seed=4)
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    metric = mx.metric.create("acc")
+    mod.fit(train, eval_metric=metric, num_epoch=12,
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+            initializer=mx.init.Xavier(),
+            compiled=True, shard_update=True,
+            wire_format="2bit", wire_threshold=0.05)
+    assert mod._compiled_step is not None
+    assert mod._compiled_step._shard is not None
+    assert metric.get()[1] > 0.95, metric.get()

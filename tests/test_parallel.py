@@ -12,7 +12,9 @@ import mxnet_tpu as mx
 from mxnet_tpu.parallel import (
     make_mesh, MeshConfig, data_parallel_spec, replicated_spec,
     allreduce, allgather, reduce_scatter, ppermute_ring,
+    barrier_sync, axis_size,
     make_data_parallel_train_step, shard_batch,
+    init_shard_update_state, padded_size, check_flat_state,
     ring_attention, sequence_parallel_attention)
 
 
@@ -96,6 +98,73 @@ def test_ppermute_ring_rotates():
     # rank r receives the value of rank r-1
     np.testing.assert_allclose(np.asarray(out).ravel(),
                                np.roll(np.arange(n), 1))
+
+
+def test_reduce_scatter_nondefault_scatter_dimension():
+    """scatter_dimension=1: each rank keeps its own COLUMN block of the
+    sum (the layout the flat [dp, padded] residual rows reduce along)."""
+    n = _ndev()
+    mesh = make_mesh()
+    rng = np.random.RandomState(3)
+    x = rng.randn(n, 2, n).astype(np.float32)
+    out = _shmap(
+        mesh, lambda s: reduce_scatter(s[0], "dp", scatter_dimension=1)[None],
+        P("dp"), P("dp"), x)
+    total = x.sum(axis=0)  # (2, n)
+    got = np.asarray(out)  # (n, 2, 1): rank i holds column i of the sum
+    for i in range(n):
+        np.testing.assert_allclose(got[i, :, 0], total[:, i], rtol=1e-6)
+
+
+def test_allgather_untiled_stacks_new_axis():
+    """tiled=False keeps per-rank shards distinct along a NEW leading axis
+    instead of concatenating — the debug-friendly layout for inspecting
+    per-replica quantization codes."""
+    n = _ndev()
+    mesh = make_mesh()
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    out = _shmap(mesh,
+                 lambda s: allgather(s, "dp", tiled=False)[None],
+                 P("dp"), P("dp"), x)
+    got = np.asarray(out)
+    assert got.shape == (n, n, 1, 2)
+    for i in range(n):
+        np.testing.assert_allclose(got[0, i, 0], x[i])
+
+
+def test_ppermute_ring_wraparound_shifts():
+    """shift wraps modulo the ring size, including negative shifts."""
+    n = _ndev()
+    mesh = make_mesh()
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    full = _shmap(mesh, lambda s: ppermute_ring(s, "dp", shift=n + 1),
+                  P("dp"), P("dp"), x)
+    # a full lap plus one == shift by one
+    np.testing.assert_allclose(np.asarray(full).ravel(),
+                               np.roll(np.arange(n), 1))
+    back = _shmap(mesh, lambda s: ppermute_ring(s, "dp", shift=-1),
+                  P("dp"), P("dp"), x)
+    # rank r receives from rank r+1
+    np.testing.assert_allclose(np.asarray(back).ravel(),
+                               np.roll(np.arange(n), -1))
+    lap = _shmap(mesh, lambda s: ppermute_ring(s, "dp", shift=n),
+                 P("dp"), P("dp"), x)
+    # a whole lap is the identity
+    np.testing.assert_allclose(np.asarray(lap).ravel(), np.arange(n))
+
+
+def test_axis_size_reports_dp_extent():
+    n = _ndev()
+    mesh = make_mesh()
+    x = np.zeros((n, 1), np.float32)
+    out = _shmap(mesh, lambda s: s + axis_size("dp"), P("dp"), P("dp"), x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), float(n))
+
+
+def test_barrier_sync_single_host_is_noop():
+    # single-process: must return promptly without raising
+    assert barrier_sync() is None
+    assert barrier_sync("named") is None
 
 
 # ------------------------------------------------------- data parallel
@@ -453,3 +522,167 @@ def test_ulysses_attention_grads_finite():
 
     g = jax.grad(loss)(x)
     assert bool(jnp.isfinite(g).all())
+
+
+# ------------------------------------------------------- ZeRO sharded update
+
+def _sq_loss(params, batch):
+    import jax.numpy as jnp
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _sgd_momentum(grads, opt_state, params):
+    import jax
+    new_m = jax.tree_util.tree_map(
+        lambda m, g: 0.9 * m + g, opt_state, grads)
+    new_p = jax.tree_util.tree_map(
+        lambda p, m: p - 0.1 * m, params, new_m)
+    return new_p, new_m
+
+
+def _zero_fixture(dim=5, dtype=np.float32):
+    rng = np.random.RandomState(7)
+    params = {"w": jnp.asarray(rng.randn(dim).astype(dtype)),
+              "b": jnp.asarray(rng.randn(1).astype(dtype))}
+    n = _ndev()
+    x = rng.randn(4 * n, dim).astype(dtype)
+    y = rng.randn(4 * n).astype(dtype)
+    return params, (x, y)
+
+
+def test_init_shard_update_state_places_one_over_n():
+    """The ZeRO memory contract, measured: each non-scalar optimizer-state
+    leaf holds 1/N of its (padded) elements per device; scalars replicate;
+    2-bit residual rows shard one row per replica."""
+    mesh = make_mesh()
+    n = _ndev()
+    params, _ = _zero_fixture()
+    opt = {"m": {"w": jnp.zeros(5), "b": jnp.zeros(1)},
+           "step": jnp.zeros(())}
+    state = init_shard_update_state(mesh, params, opt, wire_format="2bit")
+    mw = state["opt"]["m"]["w"]
+    assert mw.shape == (padded_size(5, n),)
+    assert mw.addressable_shards[0].data.size * n == mw.size
+    step_leaf = state["opt"]["step"]
+    assert step_leaf.addressable_shards[0].data.size == step_leaf.size
+    rw = state["residual"]["w"]
+    assert rw.shape == (n, padded_size(5, n))
+    assert rw.addressable_shards[0].data.shape[0] == 1
+    # without a wire format there is no residual to carry
+    plain = init_shard_update_state(mesh, params, opt)
+    assert plain["residual"] is None
+
+
+def test_sharded_update_step_matches_replicated_bitwise():
+    """make_data_parallel_train_step(shard_update=True) vs the replicated
+    step on the same mesh and batch: identical modules feed identical
+    grads, and the elementwise update on 1/N slices IS the full update —
+    loss and params must agree bitwise over several steps."""
+    mesh = make_mesh()
+    params, batch = _zero_fixture()
+    opt = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    rep = make_data_parallel_train_step(_sq_loss, _sgd_momentum, mesh,
+                                        donate_params=False)
+    shr = make_data_parallel_train_step(_sq_loss, _sgd_momentum, mesh,
+                                        donate_params=False,
+                                        shard_update=True)
+    p_r, o_r = params, opt
+    p_s = params
+    s_s = init_shard_update_state(mesh, params, opt)
+    b = shard_batch(mesh, batch)
+    for _ in range(4):
+        p_r, o_r, loss_r = rep(p_r, o_r, b)
+        p_s, s_s, loss_s = shr(p_s, s_s, b)
+        assert np.asarray(loss_r) == np.asarray(loss_s)
+        for k in p_r:
+            assert np.array_equal(np.asarray(p_r[k]), np.asarray(p_s[k])), k
+
+
+def test_sharded_update_wire_residual_carries_across_steps():
+    """wire_format='2bit' with a huge threshold: no code ever fires, so
+    params sit still while the error-feedback residual accumulates the
+    full gradient — proof the residual is carried in the step state, not
+    recreated per call."""
+    mesh = make_mesh()
+    params, batch = _zero_fixture()
+    opt = jax.tree_util.tree_map(jnp.zeros_like, params)
+    step = make_data_parallel_train_step(
+        _sq_loss, _sgd_momentum, mesh, donate_params=False,
+        shard_update=True, wire_format="2bit", wire_threshold=1e9)
+    state = init_shard_update_state(mesh, params, opt, wire_format="2bit")
+    b = shard_batch(mesh, batch)
+    p, s = params, state
+    p, s, _ = step(p, s, b)
+    r1 = np.asarray(s["residual"]["w"])
+    p, s, _ = step(p, s, b)
+    r2 = np.asarray(s["residual"]["w"])
+    assert np.abs(r1).max() > 0
+    np.testing.assert_allclose(r2, 2 * r1, rtol=1e-5)
+    for k in params:
+        assert np.array_equal(np.asarray(p[k]), np.asarray(params[k])), k
+
+
+def test_sharded_update_wire_error_feedback_bounds_lag():
+    """The EF accuracy contract (docs/PERF.md): with per-step gradients
+    below the threshold, the quantized stream's delivered total lags the
+    true total by at most one threshold per element, so after T plain-SGD
+    steps on a CONSTANT gradient |p_q - p_f| <= lr * threshold."""
+    n = _ndev()
+    mesh = make_mesh()
+    rng = np.random.RandomState(11)
+    params = {"w": jnp.asarray(rng.randn(5).astype(np.float32))}
+    x = rng.uniform(-1, 1, (4 * n, 5)).astype(np.float32)
+
+    def linear_loss(p, batch):
+        # constant gradient 0.2 * mean(x) per element, |g| < threshold
+        return 0.2 * jnp.mean(batch[0] @ p["w"])
+
+    def sgd(grads, opt_state, p):
+        return (jax.tree_util.tree_map(
+            lambda w, g: w - 0.1 * g, p, grads), opt_state)
+
+    opt = jax.tree_util.tree_map(jnp.zeros_like, params)
+    thr = 0.5
+    fp = make_data_parallel_train_step(linear_loss, sgd, mesh,
+                                       donate_params=False,
+                                       shard_update=True)
+    qt = make_data_parallel_train_step(
+        linear_loss, sgd, mesh, donate_params=False,
+        shard_update=True, wire_format="2bit", wire_threshold=thr)
+    b = shard_batch(mesh, (x,))
+    p_f, s_f = params, init_shard_update_state(mesh, params, opt)
+    p_q, s_q = params, init_shard_update_state(mesh, params, opt,
+                                               wire_format="2bit")
+    for _ in range(10):
+        p_f, s_f, _ = fp(p_f, s_f, (b[0],))
+        p_q, s_q, _ = qt(p_q, s_q, (b[0],))
+    np.testing.assert_allclose(np.asarray(p_q["w"]), np.asarray(p_f["w"]),
+                               atol=0.1 * thr + 1e-6)
+
+
+def test_shard_batch_indivisible_batch_raises_with_sizes():
+    mesh = make_mesh()
+    n = _ndev()
+    bad = np.zeros((n + 1, 3), np.float32)
+    with pytest.raises(ValueError) as e:
+        shard_batch(mesh, bad)
+    msg = str(e.value)
+    assert str(n + 1) in msg and ("extent %d" % n) in msg
+
+
+def test_check_flat_state_error_names_sizes():
+    n = _ndev()
+    with pytest.raises(ValueError) as e:
+        check_flat_state("fc_weight", 7, 100, n)
+    msg = str(e.value)
+    assert "fc_weight" in msg and "7" in msg and "100" in msg
+
+
+def test_wire_format_without_shard_update_raises():
+    mesh = make_mesh()
+    with pytest.raises(ValueError, match="shard_update"):
+        make_data_parallel_train_step(_sq_loss, _sgd_momentum, mesh,
+                                      wire_format="2bit")

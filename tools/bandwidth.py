@@ -14,6 +14,18 @@ push/pull allreduce; rank 0 prints the JSON line:
     python tools/launch.py -n 4 --launcher local \\
         python tools/bandwidth.py --kv dist_sync --size-mb 16
 
+Mesh collectives (the ZeRO sharded-update wire, docs/PERF.md): time one
+collective over the dp mesh instead of the kvstore round trip:
+
+    python tools/bandwidth.py --collective reduce_scatter --size-mb 16
+    python tools/bandwidth.py --collective allgather
+    python tools/bandwidth.py --wire 2bit     # EF-quantized gradient reduce
+
+``--wire 2bit`` benches the quantized gradient reduce-scatter against the
+fp32 baseline on the same gradient stream and reports the wire-byte
+reduction (int8 codes vs fp32: 4x) plus the measured error-feedback
+accuracy delta.  ``--smoke`` shrinks sizes/iters for CI schema checks.
+
 Usage: python tools/bandwidth.py [--size-mb 64] [--copies 4] [--iters 20]
 Prints one JSON line {"metric", "value", "unit"}.
 """
@@ -28,6 +40,137 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _run_collective(args):
+    """Time one mesh collective (jitted shard_map) and print its row."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    import functools
+    from mxnet_tpu.parallel import (make_mesh, allreduce, allgather,
+                                    reduce_scatter)
+
+    mesh = make_mesh()
+    dp = int(mesh.shape["dp"])
+    n = max(dp, int(args.size_mb * (1 << 20) / 4) // dp * dp)
+    rng = np.random.RandomState(0)
+
+    if args.collective == "reduce_scatter":
+        # every replica contributes a FULL gradient row; each keeps 1/N
+        x = rng.uniform(-1, 1, (dp, n)).astype(np.float32)
+        fn, in_spec, out_spec = (
+            lambda s: reduce_scatter(s[0], "dp")[None],
+            P("dp"), P("dp"))
+    elif args.collective == "allgather":
+        x = rng.uniform(-1, 1, n).astype(np.float32)
+        fn, in_spec, out_spec = (
+            lambda s: allgather(s, "dp")[None],
+            P("dp"), P("dp", None))
+    elif args.collective == "allreduce":
+        x = rng.uniform(-1, 1, n).astype(np.float32)
+        fn, in_spec, out_spec = (
+            lambda s: allreduce(s, "dp"), P("dp"), P("dp"))
+    else:
+        raise SystemExit("unknown --collective %r" % args.collective)
+
+    run = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec,
+                            out_specs=out_spec, check_rep=False))
+    x = jax.device_put(x, NamedSharding(
+        mesh, P("dp", *([None] * (x.ndim - 1)))))
+    run(x).block_until_ready()              # compile
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = run(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    gbytes = n * 4 * args.iters / dt / 1e9
+    print(json.dumps({
+        "metric": "mesh_%s" % args.collective,
+        "value": round(gbytes, 2),
+        "unit": "GB/s",
+        "size_mb": round(n * 4 / (1 << 20), 3),
+        "devices": dp,
+    }))
+
+
+def _run_wire(args):
+    """Bench the ZeRO gradient reduce at both wire formats on the SAME
+    gradient stream: fp32 psum_scatter vs the EF-quantized int8-code
+    reduce (parallel/zero.py quantized_reduce_scatter), reporting the
+    wire-byte reduction and the measured error-feedback accuracy delta
+    (max |delivered - fp32| of the per-step mean gradient)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from mxnet_tpu.parallel import (make_mesh, reduce_scatter,
+                                    quantized_reduce_scatter)
+
+    mesh = make_mesh()
+    dp = int(mesh.shape["dp"])
+    n = max(dp, int(args.size_mb * (1 << 20) / 4) // dp * dp)
+    thr = args.wire_threshold
+    rng = np.random.RandomState(0)
+    g = rng.uniform(-0.4, 0.4, (dp, n)).astype(np.float32)
+    row = NamedSharding(mesh, P("dp", None))
+
+    def fp32_fn(gs):
+        return (reduce_scatter(gs[0], "dp") / dp)[None]
+
+    def q_fn(gs, rs):
+        shard, new_r = quantized_reduce_scatter(gs[0], rs[0], thr, "dp", dp)
+        return shard[None], new_r[None]
+
+    fp32 = jax.jit(shard_map(fp32_fn, mesh=mesh, in_specs=P("dp"),
+                             out_specs=P("dp", None), check_rep=False))
+    quant = jax.jit(shard_map(q_fn, mesh=mesh,
+                              in_specs=(P("dp"), P("dp", None)),
+                              out_specs=(P("dp", None), P("dp", None)),
+                              check_rep=False))
+    g_dev = jax.device_put(g, row)
+    res = jax.device_put(jnp.zeros((dp, n), jnp.float32), row)
+
+    fp32(g_dev).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out_f = fp32(g_dev)
+    out_f.block_until_ready()
+    dt_f = time.perf_counter() - t0
+
+    quant(g_dev, res)[0].block_until_ready()
+    res = jax.device_put(jnp.zeros((dp, n), jnp.float32), row)
+    sum_q = np.zeros(n, np.float64)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out_q, res = quant(g_dev, res)
+        sum_q += np.asarray(out_q).ravel()
+    dt_q = time.perf_counter() - t0
+
+    mean_f = np.asarray(out_f).ravel()          # constant across iters
+    # per-step delivered error of the quantized stream (EF bounds this by
+    # ~threshold/iters per element once the residual warms up)
+    delta = float(np.abs(sum_q / args.iters - mean_f).max())
+    fp32_bytes = dp * n * 4
+    wire_bytes = dp * n * 1                     # int8 codes on the wire
+    base = {
+        "unit": "GB/s",
+        "size_mb": round(n * 4 / (1 << 20), 3),
+        "devices": dp,
+    }
+    if args.wire == "fp32":
+        print(json.dumps(dict(base, metric="gradient_reduce_wire_fp32",
+                              value=round(n * 4 * args.iters / dt_f / 1e9, 2),
+                              wire_bytes_per_step=fp32_bytes)))
+        return
+    print(json.dumps(dict(
+        base, metric="gradient_reduce_wire_2bit",
+        value=round(n * 4 * args.iters / dt_q / 1e9, 2),
+        wire_bytes_per_step=wire_bytes,
+        fp32_bytes_per_step=fp32_bytes,
+        wire_reduction_x=round(fp32_bytes / wire_bytes, 1),
+        wire_threshold=thr,
+        accuracy_delta=round(delta, 6))))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size-mb", type=float, default=64.0,
@@ -36,7 +179,18 @@ def main():
                     help="number of per-device gradients to reduce")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--kv", default="tpu_sync")
+    ap.add_argument("--collective", default=None,
+                    choices=["allreduce", "reduce_scatter", "allgather"],
+                    help="time one mesh collective instead of the kvstore")
+    ap.add_argument("--wire", default=None, choices=["fp32", "2bit"],
+                    help="bench the ZeRO gradient reduce at this wire format")
+    ap.add_argument("--wire-threshold", type=float, default=0.5)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes/iters: schema check, not a measurement")
     args = ap.parse_args()
+    if args.smoke:
+        args.size_mb = min(args.size_mb, 0.25)
+        args.iters = min(args.iters, 3)
 
     # honor an explicit platform request before any backend touch (the env
     # var alone does not stop this image's site hook from initializing the
@@ -45,6 +199,13 @@ def main():
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)
+
+    if args.collective is not None:
+        _run_collective(args)
+        return
+    if args.wire is not None:
+        _run_wire(args)
+        return
 
     import mxnet_tpu as mx
     from mxnet_tpu import nd
