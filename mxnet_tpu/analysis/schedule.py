@@ -67,11 +67,21 @@ Invariants asserted (per seed)
   failovers), no torn results, bounded tail latency, the background
   rebalance restores the replication factor (re-warm before cutover), and
   the router re-converges HEALTHY (see ``fleet_storm``).
+* **stateful decode fleet** (``decode_fleet``) — a multi-tenant token-
+  stream storm through ``FleetRouter.submit_stream`` while one replica is
+  drained (fenced KV handoff to a survivor) AND a different one is
+  killed: zero dropped streams (router decode conservation), OK and
+  handed-off streams bitwise-equal to the greedy reference, partial
+  streams strict prefixes (no torn or cross-contaminated handoffs), KV
+  pools whole on every survivor, per-tenant admission conservation with
+  no starvation, zero steady-state recompiles on engines that lived the
+  whole seed (see ``decode_fleet_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
-and ``tests/test_faults.py``/``tests/test_fleet.py`` gate the fault-driven
-scenarios (``faults``, ``crash``, ``fleet``) on the smaller
+and ``tests/test_faults.py``/``tests/test_fleet.py``/
+``tests/test_decode_fleet.py`` gate the fault-driven scenarios
+(``faults``, ``crash``, ``fleet``, ``decode_fleet``) on the smaller
 ``FAULT_SMOKE_SEEDS`` set.
 """
 from __future__ import annotations
@@ -1234,11 +1244,304 @@ def fleet_storm(router, name, inputs, expected, seed, per_client=3):
 
 
 # ---------------------------------------------------------------------------
+# scenario 10: stateful decode fleet — drain + kill under multi-tenant storm
+# ---------------------------------------------------------------------------
+
+_DFLEET_PROMPTS = ((3,), (1, 2), (5, 4, 3, 2), (2, 2, 2))
+_DFLEET_MAX_NEW = 5
+
+
+def _build_decode_fleet_fixture():
+    """-> (router, engine_name, prompts, references).
+
+    Three replicas each hosting one decode engine built from the same
+    seeded TinyCausalLM (identical params per factory call — the handoff
+    bitwise-equality claim depends on it).  Pools are deliberately tight
+    (8 allocatable blocks, 2 slots) so the seeded storm exercises QoS
+    shedding and import-time headroom refusals, not just the happy path."""
+    from ..serving.decode import DecodeEngine, TinyCausalLM
+    from ..serving.fleet import FleetRouter
+
+    def factory(name):
+        model = TinyCausalLM(vocab_size=20, hidden=16, num_layers=1,
+                             num_heads=2, max_len=24, seed=13)
+        return DecodeEngine(model, name=name, max_slots=2, block_size=4,
+                            num_blocks=9, max_prompt_len=4,
+                            max_new_tokens=_DFLEET_MAX_NEW, max_queue=6,
+                            width_blocks=[4], breaker_threshold=4,
+                            breaker_backoff_ms=15.0)
+
+    router = FleetRouter(replicas=3, failover_budget=2,
+                         breaker_threshold=3, breaker_backoff_ms=10.0)
+    router.load_decode("lm", factory, replicas=3)
+    # token budget ~2 concurrent hot streams; calm is uncapped but lighter
+    router.set_tenant("hot", weight=1.0, token_budget=18)
+    router.set_tenant("calm", weight=2.0)
+    rid0 = router.stats()["decode_models"]["lm"]["placement"][0]
+    refs = [router.engine("lm", rid0)
+            .generate_reference(p, _DFLEET_MAX_NEW).tolist()
+            for p in _DFLEET_PROMPTS]
+    return router, "lm", list(_DFLEET_PROMPTS), refs
+
+
+def decode_fleet_storm(router, name, prompts, refs, seed):
+    """Drain AND kill replicas under a multi-tenant token-stream storm
+    (the ``decode_fleet`` scenario).
+
+    A seeded disruptor waits for streams to be in flight, then **drains**
+    one LIVE replica (its engines quiesce, every live stream's prefix +
+    KV pages export and resume on a survivor behind a bumped lease
+    generation) and **kills** a different LIVE one (its streams terminate
+    UNAVAILABLE with their prefixes — no snapshot exists in a crash).
+    Invariants:
+
+    * **zero dropped streams** — every submitted stream reaches exactly
+      one terminal status within the join bound, and the router's decode
+      counters conserve ACROSS HANDOFFS:
+      ``requests == ok + timeouts + errors + unavailable`` with the
+      client tally matching per status;
+    * **no torn or cross-contaminated streams** — an OK stream's tokens
+      (handed off or not) equal the greedy reference for ITS OWN prompt
+      bitwise; TIMEOUT/UNAVAILABLE partials are strict prefixes; an
+      OVERLOADED (QoS-shed) stream carries zero tokens;
+    * **per-tenant conservation** — every admitted stream of every tenant
+      completes; the over-budget tenant sheds while the calm one flows;
+    * **KV pools whole on survivors** — every engine on a non-DEAD
+      replica drains back to used == reserved == live_sequences == 0 and
+      the per-engine conservation ``requests + imported ==
+      ok + timeouts + errors + unavailable + handed_off`` holds;
+    * **zero steady-state recompiles** — engines that lived the whole
+      seed compiled nothing new (handoff rides the warmed menu);
+    * **repair + no starvation** — after enable()/add_replica() the
+      placement re-converges and one sequential probe stream per tenant
+      reaches OK.
+    """
+    from ..serving import server as srv
+
+    violations = []
+    rng = random.Random(seed ^ 0xDF1EE7)
+    n_hot, per_hot = 2, 3
+    n_calm, per_calm = 2, 2
+    before = router.decode_stats.snapshot()
+    before_eng = {(n, rid): snap
+                  for n, per in router.stats()["engines"].items()
+                  for rid, snap in per.items()}
+    before_tenants = router.tenant_snapshot()
+
+    plans = []   # (tenant, [(timeout_ms or None, prompt_idx), ...])
+    for c in range(n_hot):
+        plans.append(("hot", [(rng.uniform(200.0, 2000.0)
+                               if rng.random() < 0.2 else None,
+                               rng.randrange(len(prompts)))
+                              for _ in range(per_hot)]))
+    for c in range(n_calm):
+        plans.append(("calm", [(None, rng.randrange(len(prompts)))
+                               for _ in range(per_calm)]))
+    results = [[] for _ in plans]
+
+    def client(c):
+        tenant, plan = plans[c]
+        for tmo, pi in plan:
+            stream = router.submit_stream(name, list(prompts[pi]),
+                                          max_new_tokens=_DFLEET_MAX_NEW,
+                                          timeout_ms=tmo, tenant=tenant)
+            if not stream.wait(_JOIN_TIMEOUT_S):
+                violations.append("decode_fleet: stream of client %d never "
+                                  "terminated" % c)
+            results[c].append((pi, stream))
+
+    drained = []
+
+    def disruptor():
+        # wait until the storm is actually in flight (bounded)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            d = router.decode_stats.snapshot()
+            if d["requests"] - before["requests"] >= 2:
+                break
+            time.sleep(0.002)
+        live = [rid for rid, state in sorted(router.replicas().items())
+                if state == "LIVE"]
+        if len(live) < 2:
+            violations.append("decode_fleet: %d live replica(s) before the "
+                              "disruption (want >= 2)" % len(live))
+            return
+        rid_d = live[rng.randrange(len(live))]
+        rid_k = rng.choice([r for r in live if r != rid_d])
+        router.drain(rid_d)      # fenced handoff to survivors
+        drained.append(rid_d)
+        router.kill_replica(rid_k)
+
+    workers = [lambda c=c: client(c) for c in range(len(plans))]
+    workers.append(disruptor)
+    violations.extend(_spawn(workers))
+
+    # client-side status checks
+    tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "ERROR": 0,
+             "UNAVAILABLE": 0, "shed": 0, "rejected": 0}
+    for c, (tenant, _plan) in enumerate(plans):
+        for pi, stream in results[c]:
+            status, tokens, _, latency, err = stream.snapshot()
+            if status is None:
+                violations.append("decode_fleet: client %d stream has no "
+                                  "terminal status" % c)
+                continue
+            if latency is not None and latency > _JOIN_TIMEOUT_S * 1e3:
+                violations.append("decode_fleet: stream latency %.0f ms "
+                                  "over the %.0f s bound"
+                                  % (latency, _JOIN_TIMEOUT_S))
+            if stream.admitted:
+                tally["admitted"] += 1
+                if status not in (srv.OK, srv.TIMEOUT, srv.ERROR,
+                                  srv.UNAVAILABLE):
+                    violations.append("decode_fleet: admitted stream ended "
+                                      "%r" % status)
+                    continue
+                tally[status] += 1
+            elif status == srv.OVERLOADED:
+                tally["shed"] += 1
+            elif status == srv.UNAVAILABLE:
+                tally["rejected"] += 1
+            else:
+                violations.append("decode_fleet: rejected stream ended %r"
+                                  % status)
+                continue
+            ref = refs[pi]
+            toks = list(tokens)
+            if status == srv.OK and toks != ref:
+                violations.append(
+                    "decode_fleet: torn stream: client %d OK tokens %s != "
+                    "reference %s" % (c, toks, ref))
+            elif status in (srv.TIMEOUT, srv.UNAVAILABLE) and \
+                    toks != ref[:len(toks)]:
+                violations.append(
+                    "decode_fleet: contaminated partial: client %d %s "
+                    "tokens %s not a prefix of %s" % (c, status, toks, ref))
+            elif status == srv.OVERLOADED and toks:
+                violations.append("decode_fleet: QoS-shed stream carries "
+                                  "%d token(s)" % len(toks))
+
+    # router-level conservation (terminal hooks fire just after complete —
+    # settle briefly, same discipline as the engine scenarios)
+    keys = ("requests", "ok", "timeouts", "errors", "unavailable", "shed",
+            "invalid", "unavailable_rejected")
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = router.decode_stats.snapshot()
+        d = {k: after[k] - before[k] for k in keys}
+        terminal_sum = (d["ok"] + d["timeouts"] + d["errors"]
+                        + d["unavailable"])
+        if d["requests"] == terminal_sum or time.monotonic() >= settle_until:
+            break
+        time.sleep(0.005)
+    if d["requests"] != terminal_sum:
+        violations.append("decode_fleet: lost streams: %d admitted, %d "
+                          "terminal" % (d["requests"], terminal_sum))
+    if d["requests"] != tally["admitted"]:
+        violations.append("decode_fleet: admission mismatch: router %d vs "
+                          "clients %d" % (d["requests"], tally["admitted"]))
+    for client_key, fleet_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
+                                  ("ERROR", "errors"),
+                                  ("UNAVAILABLE", "unavailable"),
+                                  ("shed", "shed"),
+                                  ("rejected", "unavailable_rejected")):
+        if d[fleet_key] != tally[client_key]:
+            violations.append("decode_fleet: %s mismatch: router %d vs "
+                              "clients %d"
+                              % (fleet_key, d[fleet_key], tally[client_key]))
+    if d["errors"]:
+        violations.append("decode_fleet: %d ERROR stream(s) with no faults "
+                          "injected" % d["errors"])
+
+    # per-tenant conservation: every admitted stream settled its tokens
+    for tname, snap in router.tenant_snapshot().items():
+        prev = before_tenants.get(tname, {"admitted": 0, "completed": 0})
+        if snap["inflight_tokens"] != 0:
+            violations.append("decode_fleet: tenant %r still holds %d "
+                              "in-flight token(s) after the storm"
+                              % (tname, snap["inflight_tokens"]))
+        if snap["admitted"] - prev["admitted"] != \
+                snap["completed"] - prev["completed"]:
+            violations.append("decode_fleet: tenant %r admitted %d but "
+                              "completed %d"
+                              % (tname, snap["admitted"] - prev["admitted"],
+                                 snap["completed"] - prev["completed"]))
+
+    # KV pools whole + per-engine conservation on every survivor
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        engines = router.stats()["engines"].get(name, {})
+        if all(s["kv"]["used"] == 0 and s["kv"]["reserved"] == 0
+               and s["kv"]["live_sequences"] == 0
+               for s in engines.values()):
+            break
+        time.sleep(0.005)
+    engines = router.stats()["engines"].get(name, {})
+    for rid, s in engines.items():
+        kv = s["kv"]
+        if kv["used"] != 0 or kv["reserved"] != 0 \
+                or kv["live_sequences"] != 0:
+            violations.append("decode_fleet: KV pool not whole on survivor "
+                              "%s: %r" % (rid, kv))
+        if kv["allocated_total"] != kv["freed_total"]:
+            violations.append("decode_fleet: KV leak on %s: allocated %d != "
+                              "freed %d" % (rid, kv["allocated_total"],
+                                            kv["freed_total"]))
+        if s["requests"] + s["imported"] != (
+                s["ok"] + s["timeouts"] + s["errors"] + s["unavailable"]
+                + s["handed_off"]):
+            violations.append("decode_fleet: engine conservation broken on "
+                              "%s: req %d + imported %d != ok %d + to %d + "
+                              "err %d + unavail %d + handed %d"
+                              % (rid, s["requests"], s["imported"], s["ok"],
+                                 s["timeouts"], s["errors"],
+                                 s["unavailable"], s["handed_off"]))
+        # zero steady-state recompiles on engines alive the whole seed
+        prev = before_eng.get((name, rid))
+        if prev is not None and \
+                s["cache"]["recompiles"] != prev["cache"]["recompiles"]:
+            violations.append("decode_fleet: steady-state recompile on %s: "
+                              "%d -> %d" % (rid,
+                                            prev["cache"]["recompiles"],
+                                            s["cache"]["recompiles"]))
+
+    # repair for the next seed, then structural fairness: one sequential
+    # probe per tenant must reach OK (no tenant starves post-disruption)
+    for rid in drained:
+        if router.replicas().get(rid) == "DRAINING":
+            router.enable(rid)
+    router.add_replica()
+    if not router.wait_converged(timeout_s=10.0):
+        violations.append("decode_fleet: placement never re-converged: %r"
+                          % router.stats()["decode_models"])
+    for tenant in ("hot", "calm"):
+        probe = router.submit_stream(name, list(prompts[0]),
+                                     max_new_tokens=_DFLEET_MAX_NEW,
+                                     tenant=tenant)
+        probe.wait(_JOIN_TIMEOUT_S)
+        status, tokens, _, _, err = probe.snapshot()
+        if status != srv.OK or list(tokens) != refs[0]:
+            violations.append("decode_fleet: post-repair probe for tenant "
+                              "%r ended %r (%r)" % (tenant, status, err))
+    # leave the fixture settled: the terminal hook fires off-lock after
+    # complete(), so a probe's counter bump may land after its wait() —
+    # don't let it straddle the next seed's `before` snapshot
+    settle_until = time.monotonic() + 5.0
+    while time.monotonic() < settle_until:
+        s = router.decode_stats.snapshot()
+        if s["requests"] == (s["ok"] + s["timeouts"] + s["errors"]
+                             + s["unavailable"]):
+            break
+        time.sleep(0.002)
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
-             "crash", "decode", "fleet")
+             "crash", "decode", "fleet", "decode_fleet")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -1264,6 +1567,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                           if "decode" in scenarios else None)
         fleet_fixture = (_build_fleet_fixture(n_clients)
                          if "fleet" in scenarios else None)
+        dfleet_fixture = (_build_decode_fleet_fixture()
+                          if "decode_fleet" in scenarios else None)
         try:
             for seed in seeds:
                 sched.reseed(seed)
@@ -1297,6 +1602,10 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                         fleet_fixture[0], fleet_fixture[1],
                         fleet_fixture[2], fleet_fixture[3], seed,
                         per_client=per_client)
+                if dfleet_fixture is not None:
+                    per_seed["decode_fleet"] = decode_fleet_storm(
+                        dfleet_fixture[0], dfleet_fixture[1],
+                        dfleet_fixture[2], dfleet_fixture[3], seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n
@@ -1312,6 +1621,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                 decode_fixture[0].stop()
             if fleet_fixture is not None:
                 fleet_fixture[0].stop()
+            if dfleet_fixture is not None:
+                dfleet_fixture[0].stop()
     report["preemptions"] = sched.preemptions
     report["elapsed_s"] = time.monotonic() - t0
     return report

@@ -143,6 +143,33 @@ class MembershipTable:
                     "and resume from the last complete checkpoint"
                     % (worker_id, lease.generation))
 
+    def generation(self, worker_id):
+        """Latest generation ever granted to ``worker_id`` (live or dead).
+        Raises :class:`UnknownWorker` if the id never registered."""
+        with self._lock:
+            gen = self._generations.get(worker_id)
+            if gen is None:
+                raise UnknownWorker("worker %r never registered; known: %s"
+                                    % (worker_id,
+                                       sorted(self._generations) or "none"))
+            return gen
+
+    def check_generation(self, worker_id, generation):
+        """Fence one operation on a *generation* token: raises
+        :class:`LeaseExpired` when ``generation`` is older than the latest
+        granted for ``worker_id`` (a zombie incarnation presenting a stale
+        fencing token), :class:`UnknownWorker` when the id never
+        registered.  Compares generations ONLY — TTL liveness stays
+        ``check()``'s job, so a drained-but-alive holder of the *current*
+        generation still passes."""
+        with self._lock:
+            current = self.generation(worker_id)   # reentrant
+            if generation < current:
+                raise LeaseExpired(
+                    "worker %r generation %d is stale (current %d); the "
+                    "holder was fenced — re-register before emitting"
+                    % (worker_id, generation, current))
+
     # -- server-facing ---------------------------------------------------
     def sweep(self):
         """Evict every expired lease; returns the evicted worker ids."""

@@ -18,12 +18,12 @@ from .registry import ModelRegistry, ServableModel
 from .server import (ModelServer, InferenceResult,
                      OK, TIMEOUT, OVERLOADED, INVALID_INPUT, ERROR,
                      UNAVAILABLE)
-from .fleet import FleetRouter, FleetStats
+from .fleet import FleetRouter, FleetStats, DecodeFleetStats
 from . import decode
 
 __all__ = ["ModelServer", "InferenceResult", "BucketLadder", "Request",
            "MicroBatcher", "ModelRegistry", "ServableModel", "shape_key",
            "CircuitBreaker", "HEALTHY", "DEGRADED", "decode",
-           "FleetRouter", "FleetStats",
+           "FleetRouter", "FleetStats", "DecodeFleetStats",
            "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR",
            "UNAVAILABLE"]

@@ -88,6 +88,8 @@ class DecodeStream:
         self._on_token = on_token
         self._cond = threading.Condition()
         self._tokens = []
+        self._owner = None          # fencing token; None = unfenced
+        self._on_terminal = None    # router hook, fired once off-lock
         self.status = None
         self.error = None
         self.ttft_ms = None
@@ -98,11 +100,35 @@ class DecodeStream:
                 and (now if now is not None else time.monotonic())
                 >= self.deadline)
 
+    # -- fencing ---------------------------------------------------------
+    def set_owner(self, token):
+        """Install the fencing token (router: ``(rid, lease_generation)``).
+        Emissions and owner-checked completions presenting a different
+        token are refused — the zombie-replica double-emit guard."""
+        with self._cond:
+            self._owner = token
+
+    def owner(self):
+        with self._cond:
+            return self._owner
+
+    def on_terminal(self, cb):
+        """Register a one-shot terminal hook ``cb(stream)``; fires off-lock
+        right after the winning ``complete()`` — or immediately, if the
+        stream is already terminal (registration/completion race-safe)."""
+        with self._cond:
+            if self.status is None:
+                self._on_terminal = cb
+                return
+        cb(self)
+
     # -- engine side ----------------------------------------------------
-    def _emit(self, token):
+    def _emit(self, token, owner=None):
         with self._cond:
             if self.status is not None:
                 return          # terminal already claimed; drop the token
+            if self._owner is not None and owner != self._owner:
+                return          # fenced: only the owning engine may emit
             if self.ttft_ms is None:
                 self.ttft_ms = (time.monotonic() - self.t_submit) * 1e3
             self._tokens.append(int(token))
@@ -118,16 +144,35 @@ class DecodeStream:
             except Exception:
                 self._on_token = None
 
-    def complete(self, status, error=None):
-        """First completion wins (engine finish vs teardown vs expiry)."""
+    def complete(self, status, error=None, owner=None):
+        """First completion wins (engine finish vs teardown vs expiry).
+
+        An *owner-checked* completion (``owner`` non-None on a fenced
+        stream) is refused on mismatch — a stale engine draining after a
+        handoff cannot terminate the stream out from under its new home.
+        ``owner=None`` always passes: unfenced callers (direct engine use,
+        client-side cancels) predate fencing and stay valid."""
+        cb = None
         with self._cond:
             if self.status is not None:
                 return False
+            if (self._owner is not None and owner is not None
+                    and owner != self._owner):
+                return False    # fenced: a non-owner may not terminate
             self.error = error
             self.latency_ms = (time.monotonic() - self.t_submit) * 1e3
             # status last: it is the done flag every reader keys on
             self.status = status
             self._cond.notify_all()
+            cb = self._on_terminal
+            self._on_terminal = None
+        if cb is not None:
+            # off-lock, like on_token: the router's hook takes its own
+            # lock and must never nest inside the stream's cond
+            try:
+                cb(self)
+            except Exception:
+                pass
         return True
 
     # -- client side ----------------------------------------------------
@@ -175,17 +220,33 @@ class DecodeStream:
                    ", error=%r" % err if err else ""))
 
 
+class _QEntry:
+    """One queued admission: the stream, its fencing token, and — for
+    streams entering via ``import_stream`` — the KV snapshot to restore
+    at join instead of running a prefill."""
+
+    __slots__ = ("stream", "gen", "snap")
+
+    def __init__(self, stream, gen=None, snap=None):
+        self.stream = stream
+        self.gen = gen
+        self.snap = snap
+
+
 class _Seq:
     """Engine-private per-slot state for one live sequence."""
 
-    __slots__ = ("stream", "seq_id", "position", "cur_token", "generated")
+    __slots__ = ("stream", "seq_id", "position", "cur_token", "generated",
+                 "gen", "snap")
 
-    def __init__(self, stream):
+    def __init__(self, stream, gen=None, snap=None):
         self.stream = stream
         self.seq_id = stream.seq_id
         self.position = 0       # cache index the next K/V write lands at
         self.cur_token = 0      # last emitted token (next step's input)
         self.generated = 0
+        self.gen = gen          # fencing token presented on emit/complete
+        self.snap = snap        # pending import restore, cleared at resume
 
 
 class DecodeEngine:
@@ -229,7 +290,7 @@ class DecodeEngine:
         self._cache = PagedKVCache(model.num_layers, num_blocks, block_size,
                                    model.num_heads, model.head_dim)
         self._params = model.param_dict()
-        self.stats = DecodeStats(name)
+        self.stats = DecodeStats(name, kv_capacity=self._cache.capacity())
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold,
             backoff_s=breaker_backoff_ms / 1e3,
@@ -246,10 +307,13 @@ class DecodeEngine:
         self._cond = threading.Condition()
         # guarded by _cond: queue, slots, lifecycle flags; seq ids come
         # from an itertools.count (atomic at the C level, no lock needed)
-        self._queue = deque()
+        self._queue = deque()      # of _QEntry
         self._slots = [None] * self.max_slots
         self._running = True
         self._closed = False
+        self._draining = False     # admission closed, worker parking
+        self._quiesced = threading.Event()  # worker parked, pools published
+        self._pools = None         # (k_pool, v_pool) while quiesced
         self._seq_counter = itertools.count()
         self._thread = threading.Thread(
             target=self._run, name="mx-decode-%s" % name, daemon=True)
@@ -335,14 +399,19 @@ class DecodeEngine:
 
     # -- admission (client threads) --------------------------------------
     def submit(self, prompt, max_new_tokens=None, timeout_ms=None,
-               on_token=None):
+               on_token=None, owner=None):
         """Submit one generation request; always returns a DecodeStream.
 
         Rejections come back already terminal (OVERLOADED when the queue
         or the KV block pool cannot take the stream, INVALID_INPUT for a
         prompt outside the menu, UNAVAILABLE when the breaker is open or
-        the engine is stopped) — callers branch on ``status``, never on
-        exceptions, exactly like ModelServer.predict."""
+        the engine is stopped or draining) — callers branch on ``status``,
+        never on exceptions, exactly like ModelServer.predict.
+
+        ``owner`` is the router's fencing token: it is installed on the
+        stream before admission and presented on every emission/terminal
+        this engine produces, so a handoff (which re-owns the stream) can
+        fence this engine out mid-flight."""
         if max_new_tokens is None:
             max_new_tokens = self.max_new_tokens
         deadline = (time.monotonic() + timeout_ms / 1e3
@@ -357,11 +426,16 @@ class DecodeEngine:
             return stream
         stream = DecodeStream(prompt, int(max_new_tokens), deadline,
                               stats=self.stats, on_token=on_token)
+        if owner is not None:
+            stream.set_owner(owner)
         with self._cond:
             closed = self._closed
-        if closed:
+            draining = self._draining
+        if closed or draining:
             self.stats.on_unavailable_rejected()
-            stream.complete(UNAVAILABLE, error="engine stopped")
+            stream.complete(UNAVAILABLE,
+                            error=("engine draining" if draining
+                                   else "engine stopped"))
             return stream
         problem = self._validate(prompt, int(max_new_tokens))
         if problem is not None:
@@ -390,12 +464,12 @@ class DecodeEngine:
             admitted = "no-blocks"
         else:
             with self._cond:
-                if not self._running:
+                if not self._running or self._draining:
                     admitted = "stopping"
                 elif len(self._queue) >= self._max_queue:
                     admitted = "full"
                 else:
-                    self._queue.append(stream)
+                    self._queue.append(_QEntry(stream, gen=owner))
                     self._cond.notify_all()
                     admitted = True
         if admitted is not True:
@@ -478,15 +552,30 @@ class DecodeEngine:
                 # idle only when queue AND slots are empty — nothing whose
                 # deadline could expire — and submit()/stop() both notify,
                 # so the timeout is pure liveness insurance, kept long to
-                # avoid burning 20 wakeups/s per idle engine
-                while self._running and not self._queue \
-                        and not any(self._slots):
+                # avoid burning 20 wakeups/s per idle engine.  A drain
+                # parks here too, at a step boundary: the worker publishes
+                # its pool handles and signals quiesced so export_stream
+                # can read a frozen device state; resume() un-parks it and
+                # it continues with the same locals (device content is
+                # untouched while parked).
+                while self._running and (
+                        self._draining
+                        or (not self._queue and not any(self._slots))):
+                    if self._draining and not self._quiesced.is_set():
+                        self._pools = (k_pool, v_pool)
+                        self._quiesced.set()
+                        self._cond.notify_all()
                     self._cond.wait(0.5)
                 if not self._running:
                     return
             self._expire()
-            for stream in self._claim_joiners():
-                k_pool, v_pool = self._prefill(stream, k_pool, v_pool)
+            for seq in self._claim_joiners():
+                if seq.snap is not None:
+                    k_pool, v_pool = self._resume_imported(seq, k_pool,
+                                                           v_pool)
+                else:
+                    k_pool, v_pool = self._prefill(seq.stream, k_pool,
+                                                   v_pool)
             with self._cond:
                 has_live = any(self._slots)
             if has_live:
@@ -496,22 +585,24 @@ class DecodeEngine:
         """TIMEOUT queued and live streams whose deadline passed."""
         now = time.monotonic()
         with self._cond:
-            expired_q = [s for s in self._queue if s.expired(now)]
+            expired_q = [e for e in self._queue if e.stream.expired(now)]
             if expired_q:
-                self._queue = deque(s for s in self._queue
-                                    if not s.expired(now))
+                self._queue = deque(e for e in self._queue
+                                    if not e.stream.expired(now))
             expired_live = [(i, seq) for i, seq in enumerate(self._slots)
                             if seq is not None
                             and seq.stream.expired(now)]
             for i, _ in expired_live:
                 self._slots[i] = None
-        for s in expired_q:
-            self._cache.release(s.seq_id)
-            if s.complete(TIMEOUT, error="deadline before prefill"):
+        for e in expired_q:
+            self._cache.release(e.stream.seq_id)
+            if e.stream.complete(TIMEOUT, error="deadline before prefill",
+                                 owner=e.gen):
                 self.stats.on_result(TIMEOUT)
         for _, seq in expired_live:
             self._cache.free_seq(seq.seq_id)
-            if seq.stream.complete(TIMEOUT, error="deadline mid-stream"):
+            if seq.stream.complete(TIMEOUT, error="deadline mid-stream",
+                                   owner=seq.gen):
                 self.stats.on_result(TIMEOUT)
 
     def _claim_joiners(self):
@@ -535,21 +626,28 @@ class DecodeEngine:
                                   if self._slots[i] is None), None)
                 if free_slot is None or not self._queue:
                     break
-                stream = self._queue[0]
-                blocks = self._cache.blocks_for_tokens(
-                    len(stream.prompt) + stream.max_new_tokens)
-                if not self._cache.reserve(stream.seq_id, blocks):
-                    break       # head waits for finishing sequences' blocks
+                entry = self._queue[0]
+                if entry.snap is None:
+                    blocks = self._cache.blocks_for_tokens(
+                        len(entry.stream.prompt)
+                        + entry.stream.max_new_tokens)
+                    if not self._cache.reserve(entry.stream.seq_id, blocks):
+                        break   # head waits for finishing sequences' blocks
+                # imported entries pre-reserved at import_stream time
                 self._queue.popleft()
-                self._slots[free_slot] = _Seq(stream)
-            joined.append(stream)
+                seq = _Seq(entry.stream, gen=entry.gen, snap=entry.snap)
+                self._slots[free_slot] = seq
+            joined.append(seq)
         return joined
 
     def _vacate(self, seq, status, error=None):
         """Free the sequence's pages and complete its stream (the slot
-        entry was already cleared by the caller under ``_cond``)."""
+        entry was already cleared by the caller under ``_cond``).  The
+        completion presents this engine's fencing token: a stream handed
+        off to another engine refuses it, and the refusal keeps the stale
+        engine's terminal counters honest (no double count)."""
         self._cache.free_seq(seq.seq_id)
-        if seq.stream.complete(status, error=error):
+        if seq.stream.complete(status, error=error, owner=seq.gen):
             self.stats.on_result(status)
 
     def _fail_all(self, exc):
@@ -599,7 +697,7 @@ class DecodeEngine:
         seq.position = len(prompt)
         seq.cur_token = token
         seq.generated = 1
-        stream._emit(token)
+        stream._emit(token, owner=seq.gen)
         # TTFT from SUBMISSION (queue wait included — the number a client
         # experiences), taken from the stream's own record so snapshot and
         # bench artifact report the same sample, not two timestamps
@@ -673,13 +771,238 @@ class DecodeEngine:
             seq.position += 1
             seq.cur_token = token
             seq.generated += 1
-            seq.stream._emit(token)
+            seq.stream._emit(token, owner=seq.gen)
             emitted += 1
             self._maybe_finish(seq, token)
         self.stats.on_step(len(live), emitted,
                            (time.monotonic() - t0) * 1e3,
                            self._cache.used())
         return outs[1], outs[2]
+
+    def _resume_imported(self, seq, k_pool, v_pool):
+        """Continue an imported stream: scatter its snapshot's K/V pages
+        into this engine's pools at the blocks just granted to it, restore
+        the (position, cur_token, generated) cursor, and let the normal
+        decode step take it from there.  The restore is bitwise: float32
+        pages round-trip host<->device exactly, and the decode math for a
+        slot depends only on (params, cur_token, position, K/V pages
+        0..position-1), so the continued stream equals the uninterrupted
+        reference token for token."""
+        from ...ndarray import NDArray
+        snap = seq.snap
+        seq.snap = None
+        if snap["generated"] == 0 or snap.get("k") is None:
+            # exported before its prefill ran: nothing to restore — run
+            # the normal prompt path on this engine
+            return self._prefill(seq.stream, k_pool, v_pool)
+        position = int(snap["position"])
+        self._cache.ensure_capacity(seq.seq_id, position)
+        blocks = self._cache.blocks_of(seq.seq_id)
+        idx = np.asarray(blocks, np.int32)
+        k_pool = NDArray(k_pool._data.at[:, idx].set(snap["k"]))
+        v_pool = NDArray(v_pool._data.at[:, idx].set(snap["v"]))
+        seq.position = position
+        seq.cur_token = int(snap["cur_token"])
+        seq.generated = int(snap["generated"])
+        self.stats.on_idle(self._live_count(), self._cache.used())
+        return k_pool, v_pool
+
+    # -- drain / handoff (router threads) ---------------------------------
+    def quiesce(self, timeout_s=5.0):
+        """Stop admitting and park the scheduler at a step boundary.
+
+        Returns True once the worker is parked with its pool handles
+        published (export_stream is only legal then: the device pools are
+        frozen, no step is mutating pages).  False on timeout — the
+        caller treats the engine as wedged and fences its streams instead
+        of exporting them.  Idempotent; ``resume()`` reverses it."""
+        with self._cond:
+            if self._closed:
+                return False
+            self._draining = True
+            parked = self._quiesced
+            self._cond.notify_all()
+        # wait OFF-lock: the worker needs _cond to park and set the event
+        return parked.wait(timeout_s)
+
+    def resume(self):
+        """Reopen admission and un-park the scheduler (a drain that was
+        cancelled, or a drained replica re-enabled)."""
+        with self._cond:
+            self._draining = False
+            self._pools = None
+            self._quiesced.clear()
+            self._cond.notify_all()
+
+    def export_streams(self):
+        """Snapshot-and-remove every non-terminal queued/live stream (the
+        drain sweep); returns ``[(stream, snapshot), ...]``.  Requires a
+        successful ``quiesce()``."""
+        with self._cond:
+            targets = [e.stream for e in self._queue] \
+                + [seq.stream for seq in self._slots if seq is not None]
+        out = []
+        for stream in targets:
+            snap = self.export_stream(stream)
+            if snap is not None:
+                out.append((stream, snap))
+        return out
+
+    def export_stream(self, stream):
+        """Extract one stream's resumable state and release its resources
+        here: emitted-token prefix, generation cursor, and an exact host
+        copy of its valid K/V pages (positions ``0..position-1``).  The
+        stream leaves this engine's accounting through ``handed_off`` —
+        it will terminate wherever ``import_stream`` lands it.  Returns
+        None when the stream is unknown here or already terminal."""
+        with self._cond:
+            if not self._quiesced.is_set():
+                raise MXNetError("export_stream requires a quiesced "
+                                 "engine: call quiesce() first")
+            entry = next((e for e in self._queue if e.stream is stream),
+                         None)
+            seq = None
+            if entry is not None:
+                self._queue.remove(entry)
+            else:
+                for i, cand in enumerate(self._slots):
+                    if cand is not None and cand.stream is stream:
+                        seq = cand
+                        self._slots[i] = None
+                        break
+            pools = self._pools
+        if entry is None and seq is None:
+            return None
+        status, tokens, _, _, _ = stream.snapshot()
+        if status is not None:
+            # terminal while waiting to drain: its counters already
+            # settled here; just return its blocks (free_seq also drops
+            # any outstanding reservation)
+            self._cache.free_seq(stream.seq_id)
+            return None
+        geometry = {
+            "block_size": self._cache.block_size,
+            "num_layers": self.model.num_layers,
+            "num_heads": self.model.num_heads,
+            "head_dim": self.model.head_dim,
+            "vocab_size": self.model.vocab_size,
+        }
+        if seq is not None and seq.snap is not None:
+            # imported here but never resumed: re-export the snapshot
+            snap = dict(seq.snap)
+        elif entry is not None and entry.snap is not None:
+            snap = dict(entry.snap)
+        elif seq is not None and seq.generated > 0:
+            need = self._cache.blocks_for_tokens(seq.position)
+            blocks = self._cache.blocks_of(seq.seq_id)[:need]
+            idx = np.asarray(blocks, np.int32)
+            k_pool, v_pool = pools
+            snap = {
+                "prompt": np.asarray(stream.prompt, np.int32).copy(),
+                "max_new_tokens": int(stream.max_new_tokens),
+                "tokens": list(tokens),
+                "geometry": geometry,
+                "position": int(seq.position),
+                "cur_token": int(seq.cur_token),
+                "generated": int(seq.generated),
+                "k": k_pool.asnumpy()[:, idx].copy(),
+                "v": v_pool.asnumpy()[:, idx].copy(),
+            }
+        else:
+            # still queued (or joined but not yet prefilled): no device
+            # state exists — the importer reruns the prompt from scratch
+            snap = {
+                "prompt": np.asarray(stream.prompt, np.int32).copy(),
+                "max_new_tokens": int(stream.max_new_tokens),
+                "tokens": list(tokens),
+                "geometry": geometry,
+                "position": 0,
+                "cur_token": 0,
+                "generated": 0,
+                "k": None,
+                "v": None,
+            }
+        self._cache.free_seq(stream.seq_id)
+        self.stats.on_handed_off()
+        self.stats.on_idle(self._live_count(), self._cache.used())
+        return snap
+
+    def import_stream(self, snap, stream=None, owner=None):
+        """Admit a snapshot exported elsewhere; the stream resumes at the
+        head of the queue with its worst-case KV blocks reserved up
+        front.  ``stream`` is the original client handle (its token
+        prefix continues seamlessly); without one, a fresh pre-seeded
+        stream is built.  ``owner`` is installed as the fencing token
+        BEFORE this call by the router (via ``stream.set_owner``) — the
+        token presented here must match it, or the import is refused
+        (the stale-zombie guard).  Raises :class:`MXNetError` on
+        geometry mismatch, no KV headroom, or a closed/draining engine —
+        the router's cue to try another survivor."""
+        geometry = snap["geometry"]
+        mine = {
+            "block_size": self._cache.block_size,
+            "num_layers": self.model.num_layers,
+            "num_heads": self.model.num_heads,
+            "head_dim": self.model.head_dim,
+            "vocab_size": self.model.vocab_size,
+        }
+        if geometry != mine:
+            raise MXNetError("snapshot geometry %r does not match engine "
+                             "%r geometry %r" % (geometry, self.name, mine))
+        prompt = np.asarray(snap["prompt"], np.int32)
+        if stream is None:
+            stream = DecodeStream(prompt, int(snap["max_new_tokens"]),
+                                  stats=self.stats)
+            if owner is not None:
+                stream.set_owner(owner)
+            with stream._cond:
+                stream._tokens.extend(int(t) for t in snap["tokens"])
+        elif stream.owner() != owner:
+            raise MXNetError("import_stream fencing token %r does not own "
+                             "the stream (owner %r)" % (owner,
+                                                        stream.owner()))
+        stream.stats = self.stats
+        need = self._cache.blocks_for_tokens(
+            len(prompt) + int(snap["max_new_tokens"]))
+        with self._cond:
+            if self._closed or self._draining or not self._running:
+                raise MXNetError("engine %r is not accepting streams"
+                                 % self.name)
+        seq_id = next(self._seq_counter)
+        stream.seq_id = seq_id
+        if not self._cache.reserve(seq_id, need):
+            raise MXNetError("engine %r has no KV headroom for %d blocks"
+                             % (self.name, need))
+        with self._cond:
+            if self._closed or self._draining or not self._running:
+                # lost a teardown race after reserving: give it back
+                self._cache.release(seq_id)
+                raise MXNetError("engine %r is not accepting streams"
+                                 % self.name)
+            self._queue.appendleft(_QEntry(stream, gen=owner, snap=snap))
+            self._cond.notify_all()
+        self.stats.on_imported()
+        return stream
+
+    def routing_signals(self):
+        """The live signals the fleet's placement score consumes — cheap,
+        lock-consistent reads, no XLA."""
+        with self._cond:
+            queue_depth = len(self._queue)
+            slots_live = sum(1 for s in self._slots if s is not None)
+            draining = self._draining or self._closed
+        snap = self.stats.snapshot()
+        return {
+            "kv_blocks_free": self._cache.available_unreserved(),
+            "kv_capacity": self._cache.capacity(),
+            "kv_block_size": self._cache.block_size,
+            "queue_depth": queue_depth,
+            "max_queue": self._max_queue,
+            "slots_live": slots_live,
+            "max_slots": self.max_slots,
+            "tokens_per_s": snap["tokens_per_s"],
+            "draining": draining,
+        }
 
     # -- reference path ---------------------------------------------------
     def generate_reference(self, prompt, max_new_tokens=None):
@@ -761,11 +1084,16 @@ class DecodeEngine:
                          "signatures": len(cache["signatures"])}
         snap["warmup"] = self.warmup_report
         snap["kv"] = self.kv_stats()
+        # live pool headroom (not the step-sampled counter): capacity and
+        # blocks neither allocated nor promised — the routing signal
+        snap["kv_capacity"] = self._cache.capacity()
+        snap["kv_blocks_free"] = self._cache.available_unreserved()
         snap["health"] = self.breaker.health()
         snap["breaker"] = self.breaker.snapshot()
         with self._cond:
             snap["queue_depth"] = len(self._queue)
             snap["slots_live"] = sum(1 for s in self._slots if s is not None)
+            snap["draining"] = self._draining
         snap["scheduling"] = self.scheduling
         return snap
 
@@ -790,9 +1118,9 @@ class DecodeEngine:
             self._queue.clear()
             live = [seq for seq in self._slots if seq is not None]
             self._slots = [None] * self.max_slots
-        for s in leftovers:
-            self._cache.release(s.seq_id)
-            if s.complete(UNAVAILABLE, error=error):
+        for e in leftovers:
+            self._cache.release(e.stream.seq_id)
+            if e.stream.complete(UNAVAILABLE, error=error, owner=e.gen):
                 self.stats.on_result(UNAVAILABLE)
         for seq in live:
             self._vacate(seq, UNAVAILABLE, error=error)

@@ -142,6 +142,12 @@ class PagedKVCache:
             self._reserved -= self._reservations.pop(seq_id, 0)
             return len(blocks)
 
+    def blocks_of(self, seq_id):
+        """The sequence's allocated page table, unpadded (the exact block
+        ids holding its K/V, logical order) — what ``export_stream`` copies."""
+        with self._lock:
+            return list(self._tables.get(seq_id, ()))
+
     def table(self, seq_id, width):
         """The sequence's page table padded to ``width`` entries with the
         trash block (0); entries past the live length are never unmasked."""

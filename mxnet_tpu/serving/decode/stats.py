@@ -7,6 +7,7 @@ shows the decode loop's occupancy next to op spans:
 
 * ``<engine>:live_seqs``      — sequences in decode slots after each step
 * ``<engine>:kv_blocks_used`` — allocated KV pool blocks after each step
+* ``<engine>:kv_blocks_free`` — absolute pool headroom (the routing signal)
 * ``<engine>:ttft_ms``        — time-to-first-token of each prefill
 * ``<engine>:tokens_per_s``   — instantaneous decode throughput per step
 
@@ -29,7 +30,7 @@ __all__ = ["DecodeStats"]
 class DecodeStats:
     """All counters for one decode engine.  Thread-safe."""
 
-    def __init__(self, engine_name):
+    def __init__(self, engine_name, kv_capacity=0):
         self._lock = threading.Lock()
         self.requests = 0            # admitted streams
         self.ok = 0
@@ -45,12 +46,18 @@ class DecodeStats:
         self.tokens_out = 0          # tokens emitted across all streams
         self.step_slot_sum = 0       # live slots summed over steps
         self.live_seqs = 0
+        self.kv_capacity = int(kv_capacity)  # allocatable pool blocks
         self.kv_blocks_used = 0
+        self.kv_blocks_free = int(kv_capacity)
+        self.tokens_per_s = 0.0      # instantaneous, from the last step
+        self.handed_off = 0          # admitted, exported to another engine
+        self.imported = 0            # admitted via import_stream
         self._ttft = LatencyWindow()
         self._step_ms = LatencyWindow()
         domain = profiler.Domain("serving")
         self._c_live = domain.new_counter("%s:live_seqs" % engine_name)
         self._c_blocks = domain.new_counter("%s:kv_blocks_used" % engine_name)
+        self._c_free = domain.new_counter("%s:kv_blocks_free" % engine_name)
         self._c_ttft = domain.new_counter("%s:ttft_ms" % engine_name)
         self._c_tps = domain.new_counter("%s:tokens_per_s" % engine_name)
 
@@ -89,10 +96,15 @@ class DecodeStats:
             self.tokens_out += tokens_emitted
             self.live_seqs = live
             self.kv_blocks_used = kv_blocks_used
+            self.kv_blocks_free = max(0, self.kv_capacity - kv_blocks_used)
+            free = self.kv_blocks_free
+            if step_ms > 0:
+                self.tokens_per_s = tokens_emitted / (step_ms / 1e3)
             self._step_ms.add(step_ms)
         if profiler.profiling_active():
             self._c_live.set_value(live)
             self._c_blocks.set_value(kv_blocks_used)
+            self._c_free.set_value(free)
             if step_ms > 0:
                 self._c_tps.set_value(tokens_emitted / (step_ms / 1e3))
 
@@ -106,9 +118,26 @@ class DecodeStats:
         with self._lock:
             self.live_seqs = live
             self.kv_blocks_used = kv_blocks_used
+            self.kv_blocks_free = max(0, self.kv_capacity - kv_blocks_used)
+            free = self.kv_blocks_free
         if profiler.profiling_active():
             self._c_live.set_value(live)
             self._c_blocks.set_value(kv_blocks_used)
+            self._c_free.set_value(free)
+
+    def on_handed_off(self):
+        """An admitted stream left this engine via ``export_stream`` — it
+        terminates elsewhere, so it leaves this engine's conservation set
+        through ``handed_off`` instead of a terminal counter."""
+        with self._lock:
+            self.handed_off += 1
+
+    def on_imported(self):
+        """A stream entered via ``import_stream`` — joins the conservation
+        set on the ``imported`` side: ``requests + imported ==
+        ok + timeouts + errors + unavailable + handed_off``."""
+        with self._lock:
+            self.imported += 1
 
     def on_result(self, status):
         from ..server import OK, TIMEOUT, ERROR, UNAVAILABLE
@@ -141,7 +170,12 @@ class DecodeStats:
                 "avg_live_slots": (self.step_slot_sum / self.steps
                                    if self.steps else 0.0),
                 "live_seqs": self.live_seqs,
+                "kv_capacity": self.kv_capacity,
                 "kv_blocks_used": self.kv_blocks_used,
+                "kv_blocks_free": self.kv_blocks_free,
+                "tokens_per_s": self.tokens_per_s,
+                "handed_off": self.handed_off,
+                "imported": self.imported,
                 "ttft_ms": self._ttft.percentiles(ps=(50, 95, 99)),
                 "step_ms": self._step_ms.percentiles(ps=(50, 95, 99)),
             }

@@ -34,10 +34,39 @@ models the replica process dying mid-request.  This is the one site where
 production code catches SimulatedCrash — the router IS the surviving
 process (see faults.py).
 
-The ``fleet`` mxstress scenario (analysis/schedule.py) is the standing
-chaos consumer: a replica is killed under storm load and zero requests may
-drop, tail latency stays bounded, and the router must re-converge HEALTHY.
-See docs/ROBUSTNESS.md ("Fleet membership") and docs/SERVING.md (topology).
+**Stateful decode tier.**  ``predict()`` traffic is stateless — any warm
+replica can serve any request — but decode streams are not: a stream's KV
+pages live on exactly one replica.  ``load_decode()`` places DecodeEngines
+the way ``load_model`` places models, and ``submit_stream()`` routes each
+NEW stream onto the replica with the most free KV blocks and the
+shallowest queue (weighted score over the engine's live
+``routing_signals()``), after which **session affinity** pins every token
+of that stream to its placement.  The lifecycle verbs then honor the
+state:
+
+* ``drain(rid)`` performs a **fenced KV handoff**: each engine on the
+  replica quiesces at a step boundary, every live stream's token prefix +
+  K/V pages are exported, the replica's lease generation bumps (the
+  fencing token — a zombie presenting the old generation can neither emit
+  nor import), and the router resumes each stream on a survivor via
+  ``import_stream`` — the merged stream is bitwise-equal to an
+  uninterrupted one.
+* ``kill_replica(rid)``/crash (no snapshot exists) terminates the
+  replica's streams UNAVAILABLE with their valid prefix within a bounded
+  deadline — never a hang — and the client re-admits with
+  ``prompt + prefix`` as the new prompt.
+* **Multi-tenant QoS**: ``set_tenant(name, weight, token_budget)`` gives
+  every tenant a weighted-fair share of the fleet's KV token capacity; an
+  over-budget tenant sheds OVERLOADED while the rest keep flowing.
+  ``scaling_advice()``/``poll_scaling()`` turn breaker + KV-utilization
+  signals into scale-out/scale-in policy hooks.
+
+The ``fleet`` and ``decode_fleet`` mxstress scenarios
+(analysis/schedule.py) are the standing chaos consumers: replicas are
+killed and drained under (multi-tenant) storm load and zero requests or
+streams may drop, prefixes stay whole, KV pools stay leak-free, and the
+router must re-converge HEALTHY.  See docs/ROBUSTNESS.md ("Fleet
+membership", "Stream handoff") and docs/SERVING.md (topology).
 """
 from __future__ import annotations
 
@@ -46,14 +75,16 @@ import time
 
 from .. import faults
 from ..base import MXNetError
+from ..kvstore_server import MembershipTable
 from .health import (CircuitBreaker, HEALTHY, DEGRADED, UNAVAILABLE_HEALTH,
-                     REJECT)
+                     REJECT, worst_health)
 from .server import (ModelServer, InferenceResult,
                      OK, TIMEOUT, ERROR, UNAVAILABLE, OVERLOADED,
                      INVALID_INPUT)
 from .stats import LatencyWindow
 
-__all__ = ["FleetRouter", "FleetStats", "LIVE", "DRAINING", "DEAD"]
+__all__ = ["FleetRouter", "FleetStats", "DecodeFleetStats",
+           "LIVE", "DRAINING", "DEAD"]
 
 # replica lifecycle states
 LIVE = "LIVE"          # routable
@@ -131,17 +162,111 @@ class FleetStats:
             }
 
 
+class DecodeFleetStats:
+    """Router-level counters for the stateful decode tier.  Thread-safe;
+    same two-tier split as FleetStats: ``requests`` counts streams the
+    router ADMITTED and every one of them reaches exactly one terminal
+    OK/TIMEOUT/ERROR/UNAVAILABLE count — across handoffs — so
+    ``requests == ok + timeouts + errors + unavailable`` is the chaos
+    gate's conservation invariant; ``shed`` (QoS/engine OVERLOADED),
+    ``invalid`` and ``unavailable_rejected`` count fast rejections that
+    never enter it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.ok = 0
+        self.timeouts = 0
+        self.errors = 0
+        self.unavailable = 0
+        self.shed = 0
+        self.invalid = 0
+        self.unavailable_rejected = 0
+        self.handoffs = 0        # streams resumed on a survivor
+        self.failovers = 0       # placement attempts re-routed
+        self.fenced = 0          # streams terminated by a fence token
+        self.tokens_out = 0      # tokens delivered across terminal streams
+        self._lat = LatencyWindow()
+        self._ttft = LatencyWindow()
+
+    def on_admitted(self):
+        with self._lock:
+            self.requests += 1
+
+    def on_shed(self):
+        with self._lock:
+            self.shed += 1
+
+    def on_invalid(self):
+        with self._lock:
+            self.invalid += 1
+
+    def on_unavailable_rejected(self):
+        with self._lock:
+            self.unavailable_rejected += 1
+
+    def on_handoff(self):
+        with self._lock:
+            self.handoffs += 1
+
+    def on_failover(self):
+        with self._lock:
+            self.failovers += 1
+
+    def on_fenced(self):
+        with self._lock:
+            self.fenced += 1
+
+    def on_result(self, status, latency_ms=None, ttft_ms=None, tokens=0):
+        with self._lock:
+            if status == OK:
+                self.ok += 1
+            elif status == TIMEOUT:
+                self.timeouts += 1
+            elif status == ERROR:
+                self.errors += 1
+            elif status == UNAVAILABLE:
+                self.unavailable += 1
+            else:
+                return   # OVERLOADED/INVALID never register a stream rec
+            self.tokens_out += int(tokens)
+            if latency_ms is not None:
+                self._lat.add(latency_ms)
+            if ttft_ms is not None:
+                self._ttft.add(ttft_ms)
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "ok": self.ok,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "unavailable": self.unavailable,
+                "shed": self.shed,
+                "invalid": self.invalid,
+                "unavailable_rejected": self.unavailable_rejected,
+                "handoffs": self.handoffs,
+                "failovers": self.failovers,
+                "fenced": self.fenced,
+                "tokens_out": self.tokens_out,
+                "latency_ms": self._lat.percentiles(),
+                "ttft_ms": self._ttft.percentiles(),
+            }
+
+
 class _Replica:
     """One replica row; every field except ``server`` is guarded by the
     router's ``_lock`` (``server`` is assigned once and never rebound)."""
 
-    __slots__ = ("rid", "server", "state", "inflight")
+    __slots__ = ("rid", "server", "state", "inflight", "gen")
 
     def __init__(self, rid, server):
         self.rid = rid
         self.server = server
         self.state = LIVE
         self.inflight = 0
+        self.gen = 0             # current lease generation (fencing token)
 
 
 class _ModelSpec:
@@ -155,6 +280,52 @@ class _ModelSpec:
         self.input_shapes = input_shapes
         self.replicas = replicas
         self.kwargs = kwargs
+
+
+class _EngineSpec:
+    """Everything needed to re-build a decode engine on a joining replica.
+    ``factory(name)`` must return a warmed DecodeEngine; ``max_new`` is
+    learned from the first committed engine (the QoS need estimate for
+    submissions that leave max_new_tokens to the engine default)."""
+
+    __slots__ = ("name", "factory", "replicas", "max_new")
+
+    def __init__(self, name, factory, replicas):
+        self.name = name
+        self.factory = factory
+        self.replicas = replicas
+        self.max_new = 0
+
+
+class _StreamRec:
+    """Router-side record of one admitted stream (the session-affinity
+    pin).  Guarded by the router's ``_lock``."""
+
+    __slots__ = ("name", "rid", "gen", "tenant", "need_tokens")
+
+    def __init__(self, name, rid, gen, tenant, need_tokens):
+        self.name = name
+        self.rid = rid
+        self.gen = gen
+        self.tenant = tenant
+        self.need_tokens = need_tokens
+
+
+class _Tenant:
+    """Per-tenant QoS accounting.  Guarded by the router's ``_lock``."""
+
+    __slots__ = ("name", "weight", "token_budget", "inflight_tokens",
+                 "admitted", "completed", "ok", "qos_sheds")
+
+    def __init__(self, name, weight=1.0, token_budget=None):
+        self.name = name
+        self.weight = float(weight)
+        self.token_budget = token_budget
+        self.inflight_tokens = 0
+        self.admitted = 0
+        self.completed = 0
+        self.ok = 0
+        self.qos_sheds = 0
 
 
 class FleetRouter:
@@ -193,6 +364,20 @@ class FleetRouter:
         self._next_rid = 0
         self._closed = False
         self.stats_sink = FleetStats()
+        # -- stateful decode tier (all under _lock, same discipline) -----
+        self._dspecs = {}       # name -> _EngineSpec
+        self._dplacement = {}   # name -> [rid, ...] (routable engines)
+        self._dengines = {}     # (name, rid) -> DecodeEngine
+        self._dbreakers = {}    # (name, rid) -> CircuitBreaker
+        self._streams = {}      # DecodeStream -> _StreamRec (affinity pins)
+        self._tenants = {}      # tenant name -> _Tenant
+        self._scaling = {"high": 0.85, "low": 0.15,
+                         "scale_out": None, "scale_in": None}
+        self.decode_stats = DecodeFleetStats()
+        # lease generations fence replica incarnations across drains and
+        # kills; its own RLock is never taken under _lock (registrations
+        # happen outside, rows cache the granted generation)
+        self._leases = MembershipTable(lease_ttl_s=3600.0)
         for _ in range(replicas):
             self.add_replica()
 
@@ -207,26 +392,48 @@ class FleetRouter:
                 raise MXNetError("fleet is stopped; create a new FleetRouter")
             rid = "r%d" % self._next_rid
             self._next_rid += 1
-            self._replicas[rid] = _Replica(rid, server)
+        gen = self._leases.register(rid).generation
+        with self._lock:
+            if self._closed:
+                raise MXNetError("fleet is stopped; create a new FleetRouter")
+            rep = _Replica(rid, server)
+            rep.gen = gen
+            self._replicas[rid] = rep
         self._rebalance()
         return rid
 
     def drain(self, rid):
-        """Stop admitting requests to ``rid``; in-flight requests finish
-        (the replica's server keeps running).  Idempotent."""
+        """Stop admitting requests to ``rid``; in-flight predicts finish
+        (the replica's server keeps running) and every live decode stream
+        is **handed off**: the replica's engines quiesce, each stream's
+        prefix + KV pages are exported, the lease generation bumps (so
+        the drained incarnation is fenced out of emitting), and each
+        stream resumes on a survivor — or terminates UNAVAILABLE with its
+        prefix when no survivor can adopt it.  Idempotent."""
         with self._lock:
             rep = _lookup_replica(self._replicas, rid)
             if rep.state == DEAD:
                 raise MXNetError("replica %s is dead" % rid)
             rep.state = DRAINING
+            engines = [(name, eng) for (name, r), eng
+                       in self._dengines.items() if r == rid]
+        if engines:
+            self._handoff_decode(rid, engines)
 
     def enable(self, rid):
-        """Undo ``drain``: restore routing to ``rid``."""
+        """Undo ``drain``: restore routing to ``rid`` and resume its
+        quiesced decode engines (a fresh lease generation was already
+        granted at drain time, so re-enabled engines emit with current
+        fencing tokens)."""
         with self._lock:
             rep = _lookup_replica(self._replicas, rid)
             if rep.state == DEAD:
                 raise MXNetError("replica %s is dead" % rid)
             rep.state = LIVE
+            engines = [eng for (name, r), eng in self._dengines.items()
+                       if r == rid]
+        for eng in engines:
+            eng.resume()
 
     def kill_replica(self, rid):
         """Abrupt replica death (the test/chaos hook): mark DEAD, drop it
@@ -319,6 +526,466 @@ class FleetRouter:
     def models(self):
         with self._lock:
             return sorted(self._specs)
+
+    # -- stateful decode tier ---------------------------------------------
+    def load_decode(self, name, factory, replicas=1):
+        """Place decode engines for ``name`` on the ``replicas``
+        least-loaded live replicas.  ``factory(name)`` must build one
+        warmed :class:`~mxnet_tpu.serving.decode.DecodeEngine` (identical
+        params per call — the fleet hands streams between copies and the
+        merged output must be bitwise-consistent).  Each engine attaches
+        to its replica's server, so a replica death tears its engines
+        down with it."""
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        with self._lock:
+            if self._closed:
+                raise MXNetError("fleet is stopped; create a new FleetRouter")
+            if name in self._dspecs or name in self._specs:
+                raise MXNetError("%r is already loaded in the fleet" % name)
+            if not any(r.state == LIVE for r in self._replicas.values()):
+                raise MXNetError("no live replicas; add_replica() first")
+            self._dspecs[name] = _EngineSpec(name, factory, int(replicas))
+            self._dplacement[name] = []
+        try:
+            self._rebalance()
+        except Exception:
+            self.unload_decode(name)
+            raise
+        with self._lock:
+            placed = bool(self._dplacement.get(name))
+        if not placed:
+            self.unload_decode(name)
+            raise MXNetError("could not place decode engine %r on any live "
+                             "replica" % name)
+
+    def unload_decode(self, name):
+        with self._lock:
+            if name not in self._dspecs:
+                raise MXNetError("no decode engine %r in the fleet; "
+                                 "loaded: %s"
+                                 % (name, sorted(self._dspecs) or "none"))
+            del self._dspecs[name]
+            rids = self._dplacement.pop(name, [])
+            engines = []
+            for rid in rids:
+                self._dbreakers.pop((name, rid), None)
+                eng = self._dengines.pop((name, rid), None)
+                rep = self._replicas.get(rid)
+                if eng is not None and rep is not None \
+                        and rep.state != DEAD:
+                    engines.append((rep.server, eng))
+        for server, eng in engines:
+            try:
+                server.detach_engine(name)
+            except MXNetError:
+                pass
+            eng.stop()
+
+    def decode_models(self):
+        with self._lock:
+            return sorted(self._dspecs)
+
+    def engine(self, name, rid):
+        """The placed engine object (tests / direct maintenance)."""
+        with self._lock:
+            eng = self._dengines.get((name, rid))
+        if eng is None:
+            raise MXNetError("no engine %r on replica %s" % (name, rid))
+        return eng
+
+    def submit_stream(self, name, prompt, max_new_tokens=None,
+                      timeout_ms=None, tenant=None, on_token=None):
+        """Admit one generation stream into the fleet; always returns a
+        DecodeStream (rejections come back already terminal, same status
+        discipline as ``DecodeEngine.submit``).
+
+        Admission is two-gated: the **tenant QoS gate** first (token
+        budget + weighted-fair share — an over-budget tenant sheds
+        OVERLOADED while others flow), then **KV-aware placement**: the
+        stream lands on the LIVE replica whose engine scores best on
+        free KV blocks / queue headroom / throughput, with bounded
+        failover past UNAVAILABLE engines.  Once admitted, the stream is
+        pinned to its placement (session affinity) and every emission is
+        fenced by ``(rid, lease_generation)``."""
+        from .decode.engine import DecodeStream
+        t_deadline = (time.monotonic() + timeout_ms / 1e3
+                      if timeout_ms is not None else None)
+        tenant = tenant if tenant is not None else "default"
+        try:
+            plen = len(prompt)
+        except TypeError:
+            plen = 1
+        with self._lock:
+            spec = self._dspecs.get(name)
+            spec_max_new = spec.max_new if spec is not None else 0
+        if spec is None:
+            raise MXNetError("no decode engine %r in the fleet; loaded: %s"
+                             % (name, sorted(self.decode_models()) or "none"))
+        need = int(plen) + int(max_new_tokens if max_new_tokens is not None
+                               else spec_max_new)
+
+        def _reject(status, counter, error):
+            counter()
+            stream = DecodeStream(None, need, t_deadline)
+            stream.complete(status, error=error)
+            return stream
+
+        # -- QoS gate: capacity signals outside _lock, verdict under it --
+        free_tokens, cap_tokens = self._decode_headroom(name)
+        with self._lock:
+            ten = self._tenants.get(tenant)
+            if ten is None:
+                ten = _Tenant(tenant)
+                self._tenants[tenant] = ten
+            total_w = sum(t.weight for t in self._tenants.values())
+            fair = (cap_tokens * ten.weight / total_w if total_w > 0
+                    else cap_tokens)
+            if ten.token_budget is not None \
+                    and ten.inflight_tokens + need > ten.token_budget:
+                ten.qos_sheds += 1
+                verdict = ("tenant %r over token budget (%d in flight + %d "
+                           "needed > %d)" % (tenant, ten.inflight_tokens,
+                                             need, ten.token_budget))
+            elif ten.inflight_tokens + need > fair and free_tokens < need:
+                ten.qos_sheds += 1
+                verdict = ("tenant %r over its weighted share (%.0f tokens) "
+                           "under contention" % (tenant, fair))
+            else:
+                verdict = None
+                ten.inflight_tokens += need
+        if verdict is not None:
+            return _reject(OVERLOADED, self.decode_stats.on_shed, verdict)
+
+        # -- KV-aware placement with bounded failover --------------------
+        def _release_tokens():
+            with self._lock:
+                t = self._tenants.get(tenant)
+                if t is not None:
+                    t.inflight_tokens = max(0, t.inflight_tokens - need)
+
+        tried = set()
+        stream = None
+        reason = "no attempts"
+        for attempt in range(self._failover_budget + 1):
+            sel, reason = self._select_decode(name, tried)
+            if sel is None:
+                break
+            rep, eng, gen, breaker = sel
+            owner = (rep.rid, gen)
+            try:
+                faults.fault_point("fleet.replica", replica=rep.rid,
+                                   model=name)
+            except faults.SimulatedCrash:
+                # same contract as _route: the crash is the REPLICA's
+                # death and this router survives it
+                self._replica_died(rep.rid)
+                tried.add(rep.rid)
+                self.decode_stats.on_failover()
+                continue
+            s = eng.submit(prompt, max_new_tokens=max_new_tokens,
+                           timeout_ms=timeout_ms, on_token=on_token,
+                           owner=owner)
+            if s.admitted:
+                breaker.on_success()
+                stream = s
+                break
+            status = s.snapshot()[0]
+            if status == INVALID_INPUT:
+                _release_tokens()
+                self.decode_stats.on_invalid()
+                return s
+            if status == UNAVAILABLE:
+                breaker.on_failure()
+            tried.add(rep.rid)           # OVERLOADED: try a freer replica
+            self.decode_stats.on_failover()
+        if stream is None:
+            _release_tokens()
+            return _reject(
+                UNAVAILABLE, self.decode_stats.on_unavailable_rejected,
+                "no routable decode replica for %r (%s)" % (name, reason))
+        # session affinity: pin the stream to wherever it actually lives
+        # NOW (a drain may already have re-owned it mid-admission)
+        ow = stream.owner()
+        rid, gen = ow if (isinstance(ow, tuple) and len(ow) == 2) \
+            else (rep.rid, gen)
+        with self._lock:
+            self._streams[stream] = _StreamRec(name, rid, gen, tenant, need)
+            ten = self._tenants.get(tenant)
+            if ten is not None:
+                ten.admitted += 1
+        self.decode_stats.on_admitted()
+        # terminal hook AFTER the rec exists: fires immediately if the
+        # stream already completed, so the rec can never leak
+        stream.on_terminal(self._stream_done)
+        return stream
+
+    def _decode_headroom(self, name):
+        """(free_tokens, capacity_tokens) across the model's LIVE
+        placements — engine signal reads, never under ``_lock``."""
+        with self._lock:
+            engines = [self._dengines[(name, rid)]
+                       for rid in self._dplacement.get(name, ())
+                       if (name, rid) in self._dengines
+                       and self._replicas[rid].state == LIVE]
+        free = cap = 0
+        for eng in engines:
+            sig = eng.routing_signals()
+            free += sig["kv_blocks_free"] * sig["kv_block_size"]
+            cap += sig["kv_capacity"] * sig["kv_block_size"]
+        return free, cap
+
+    def _select_decode(self, name, tried):
+        """Pick (replica, engine, generation, breaker) for one placement
+        attempt, or (None, reason).  Candidates are LIVE placements not
+        yet tried; the winner maximizes a weighted score over the live
+        engine signals — free KV blocks dominate (2x), queue headroom
+        next (1x), recent throughput breaks ties (0.25x) — so a new
+        stream lands where its KV reservation and queue wait are
+        cheapest."""
+        with self._lock:
+            if self._closed:
+                return None, "fleet stopped"
+            if name not in self._dspecs:
+                raise MXNetError("no decode engine %r in the fleet; "
+                                 "loaded: %s"
+                                 % (name, sorted(self._dspecs) or "none"))
+            placed = list(self._dplacement.get(name, ()))
+            if not placed:
+                return None, "no replicas host it"
+            cands = []
+            n_draining = 0
+            for rid in placed:
+                rep = self._replicas[rid]
+                if rep.state == DRAINING:
+                    n_draining += 1
+                if rid in tried or rep.state != LIVE:
+                    continue
+                cands.append((rep, self._dengines[(name, rid)], rep.gen,
+                              self._dbreakers[(name, rid)]))
+        if not cands:
+            if n_draining:
+                return None, "draining"
+            return None, "all replicas tried or dead"
+        scored = []
+        for rep, eng, gen, breaker in cands:
+            # signal reads outside _lock (engine conds are slow-path locks)
+            sig = eng.routing_signals()
+            if sig["draining"]:
+                continue
+            scored.append((rep, eng, gen, breaker, sig))
+        if not scored:
+            return None, "all engines draining"
+        max_tps = max(s[4]["tokens_per_s"] for s in scored)
+
+        def score(item):
+            sig = item[4]
+            kv_free = sig["kv_blocks_free"] / max(1, sig["kv_capacity"])
+            queue_room = 1.0 - sig["queue_depth"] / max(1, sig["max_queue"])
+            tps = sig["tokens_per_s"] / max_tps if max_tps > 0 else 0.0
+            return 2.0 * kv_free + 1.0 * queue_room + 0.25 * tps
+
+        # deterministic order: best score first, rid breaks ties
+        scored.sort(key=lambda it: (-score(it), it[0].rid))
+        for rep, eng, gen, breaker, _ in scored:
+            # admit() outside _lock, same as the predict path
+            if breaker.admit() != REJECT:
+                return (rep, eng, gen, breaker), None
+        return None, "all breakers open"
+
+    def _stream_done(self, stream):
+        """Terminal hook for every router-admitted stream: runs off every
+        other lock (complete() fires it after releasing the stream cond),
+        settles the tenant's in-flight tokens, and counts the terminal
+        status exactly once — across however many engines the stream
+        visited."""
+        status, tokens, ttft, latency, _ = stream.snapshot()
+        with self._lock:
+            rec = self._streams.pop(stream, None)
+            if rec is None:
+                return
+            ten = self._tenants.get(rec.tenant)
+            if ten is not None:
+                ten.inflight_tokens = max(
+                    0, ten.inflight_tokens - rec.need_tokens)
+                ten.completed += 1
+                if status == OK:
+                    ten.ok += 1
+        self.decode_stats.on_result(status, latency_ms=latency,
+                                    ttft_ms=ttft, tokens=len(tokens))
+
+    def _fence_terminate(self, stream, why):
+        """Terminate a stream nothing owns anymore: install a fresh
+        private fence token (so no engine incarnation can emit past this
+        point) and complete UNAVAILABLE with the prefix intact.  Never
+        called under ``_lock`` — the terminal hook takes it."""
+        token = object()
+        stream.set_owner(token)
+        if stream.complete(UNAVAILABLE, error=why, owner=token):
+            self.decode_stats.on_fenced()
+
+    def _handoff_decode(self, rid, engines):
+        """Drain-side stream migration for every engine on ``rid``.
+
+        Protocol (docs/ROBUSTNESS.md "Stream handoff"): (1) **fence** —
+        bump the replica's lease generation, so the drained incarnation's
+        ``(rid, old_gen)`` tokens go stale the moment anything is
+        re-owned; (2) **snapshot** — quiesce each engine at a step
+        boundary and export every live stream's prefix + K/V pages;
+        (3) **resume** — import each snapshot on the best survivor,
+        re-owning the stream to ``(rid2, gen2)`` first.  A wedged engine
+        (quiesce timeout) or an exhausted survivor search degrades to a
+        fenced UNAVAILABLE terminal — bounded, never a hang."""
+        new_gen = self._leases.register(rid).generation
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.gen = new_gen
+        for name, eng in engines:
+            if not eng.quiesce(timeout_s=5.0):
+                # wedged mid-step: nothing exportable; fence its streams
+                with self._lock:
+                    stuck = [s for s, rec in self._streams.items()
+                             if rec.rid == rid and rec.name == name]
+                for stream in stuck:
+                    self._fence_terminate(
+                        stream, "replica %s wedged during drain" % rid)
+                continue
+            for stream, snap in eng.export_streams():
+                self._resume_on_survivor(name, stream, snap, exclude=rid)
+
+    def _resume_on_survivor(self, name, stream, snap, exclude):
+        """Land one exported stream on the best surviving replica; on
+        exhaustion, fence-terminate it (UNAVAILABLE, prefix intact)."""
+        tried = {exclude}
+        for _ in range(self._failover_budget + 1):
+            sel, _reason = self._select_decode(name, tried)
+            if sel is None:
+                break
+            rep2, eng2, gen2, _breaker = sel
+            try:
+                # the fencing handshake: the target's generation must be
+                # current (a stale/zombie incarnation fails here), and
+                # the stream is re-owned BEFORE the import so the old
+                # engine's in-flight emissions are refused from now on
+                self._leases.check_generation(rep2.rid, gen2)
+            except MXNetError:
+                tried.add(rep2.rid)
+                continue
+            owner2 = (rep2.rid, gen2)
+            stream.set_owner(owner2)
+            try:
+                eng2.import_stream(snap, stream=stream, owner=owner2)
+            except MXNetError:
+                tried.add(rep2.rid)   # no headroom / draining: next one
+                continue
+            with self._lock:
+                rec = self._streams.get(stream)
+                if rec is not None:
+                    rec.rid = rep2.rid
+                    rec.gen = gen2
+            self.decode_stats.on_handoff()
+            return True
+        self._fence_terminate(
+            stream, "drained replica's stream found no survivor with KV "
+                    "headroom; re-admit with the emitted prefix as prompt")
+        return False
+
+    # -- multi-tenant QoS -------------------------------------------------
+    def set_tenant(self, name, weight=1.0, token_budget=None):
+        """Configure one tenant: ``weight`` is its share of the fleet's
+        KV token capacity under contention; ``token_budget`` (tokens in
+        flight, prompt + budgeted generation) is an absolute cap, None =
+        uncapped.  Unknown tenants auto-create at weight 1.0 on first
+        submission."""
+        if weight <= 0:
+            raise ValueError("tenant weight must be > 0")
+        with self._lock:
+            ten = self._tenants.get(name)
+            if ten is None:
+                self._tenants[name] = _Tenant(name, weight, token_budget)
+            else:
+                ten.weight = float(weight)
+                ten.token_budget = token_budget
+
+    def tenant_snapshot(self):
+        with self._lock:
+            return {
+                t.name: {
+                    "weight": t.weight,
+                    "token_budget": t.token_budget,
+                    "inflight_tokens": t.inflight_tokens,
+                    "admitted": t.admitted,
+                    "completed": t.completed,
+                    "ok": t.ok,
+                    "qos_sheds": t.qos_sheds,
+                } for t in self._tenants.values()
+            }
+
+    # -- scaling policy hooks ----------------------------------------------
+    def set_scaling_policy(self, scale_out=None, scale_in=None,
+                           high=0.85, low=0.15):
+        """Install scale-out/scale-in callbacks (``cb(router, advice)``)
+        and the KV-utilization / queue-fill thresholds that trigger
+        them."""
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError("need 0 <= low < high <= 1")
+        with self._lock:
+            self._scaling = {"high": float(high), "low": float(low),
+                             "scale_out": scale_out, "scale_in": scale_in}
+
+    def scaling_advice(self):
+        """Derive scale-out/hold/scale-in advice from the live breaker +
+        engine signals: sustained KV pressure or queue depth (or an
+        unhealthy breaker) says scale out; a near-idle fleet says scale
+        in."""
+        with self._lock:
+            engines = list(self._dengines.values())
+            breakers = list(self._dbreakers.values())
+            high = self._scaling["high"]
+            low = self._scaling["low"]
+        if not engines:
+            return {"action": "hold", "kv_utilization": 0.0,
+                    "queue_fill": 0.0, "unhealthy_breakers": 0,
+                    "reasons": ["no decode engines placed"]}
+        utils, fills = [], []
+        for eng in engines:
+            sig = eng.routing_signals()
+            cap = max(1, sig["kv_capacity"])
+            utils.append(1.0 - sig["kv_blocks_free"] / cap)
+            fills.append(sig["queue_depth"] / max(1, sig["max_queue"]))
+        kv_util = sum(utils) / len(utils)
+        queue_fill = max(fills)
+        unhealthy = sum(1 for b in breakers if b.health() != HEALTHY)
+        reasons = []
+        if kv_util >= high:
+            reasons.append("kv utilization %.2f >= %.2f" % (kv_util, high))
+        if queue_fill >= high:
+            reasons.append("queue fill %.2f >= %.2f" % (queue_fill, high))
+        if unhealthy:
+            reasons.append("%d unhealthy engine breaker(s)" % unhealthy)
+        if reasons:
+            action = "scale_out"
+        elif kv_util <= low and queue_fill <= low and not unhealthy:
+            action = "scale_in"
+            reasons = ["kv utilization %.2f and queue fill %.2f <= %.2f"
+                       % (kv_util, queue_fill, low)]
+        else:
+            action = "hold"
+            reasons = ["within thresholds"]
+        return {"action": action, "kv_utilization": kv_util,
+                "queue_fill": queue_fill, "unhealthy_breakers": unhealthy,
+                "reasons": reasons}
+
+    def poll_scaling(self):
+        """Evaluate ``scaling_advice()`` and invoke the matching policy
+        hook (if installed); returns the advice."""
+        advice = self.scaling_advice()
+        with self._lock:
+            cb = self._scaling.get(advice["action"])
+        if cb is not None:
+            cb(self, advice)
+        return advice
 
     # -- inference -------------------------------------------------------
     def predict(self, name, data, timeout_ms=None):
@@ -450,13 +1117,36 @@ class FleetRouter:
                 if rid in rids:
                     rids.remove(rid)
                     self._breakers.pop((name, rid), None)
+            for name, rids in self._dplacement.items():
+                if rid in rids:
+                    rids.remove(rid)
+            dkeys = [(name, r) for (name, r) in self._dengines if r == rid]
+            for key in dkeys:
+                self._dengines.pop(key, None)
+                self._dbreakers.pop(key, None)
+            affected = [s for s, rec in self._streams.items()
+                        if rec.rid == rid]
             closed = self._closed
         if not expected:
             self.stats_sink.on_replica_death()
+        # fence the dead incarnation: any zombie still holding the old
+        # generation fails check_generation on future import attempts
+        self._leases.register(rid)
         try:
             rep.server.stop()
         except Exception:
             pass   # it "crashed"; best-effort teardown of the local object
+        # the server stop above drained the attached engines: their live
+        # streams completed UNAVAILABLE with matching fencing tokens (no
+        # snapshot exists in a crash — the prefix is the client's to
+        # re-admit).  Sweep any router-registered stream that still isn't
+        # terminal (e.g. lost a submit-vs-crash race) with a fence token,
+        # so no stream on a dead replica can ever hang.
+        for stream in affected:
+            if stream.snapshot()[0] is None:
+                self._fence_terminate(
+                    stream, "replica %s died; re-admit with the emitted "
+                            "prefix as prompt" % rid)
         if not closed:
             # rebalance off the request path: the failing request has
             # already failed over to a warm copy; restoring the replication
@@ -481,10 +1171,11 @@ class FleetRouter:
                     live = [r for r in self._replicas.values()
                             if r.state == LIVE]
                     hosted = {r.rid: 0 for r in live}
-                    for rids in self._placement.values():
-                        for rid in rids:
-                            if rid in hosted:
-                                hosted[rid] += 1
+                    for placement in (self._placement, self._dplacement):
+                        for rids in placement.values():
+                            for rid in rids:
+                                if rid in hosted:
+                                    hosted[rid] += 1
                     for name in sorted(self._specs):
                         spec = self._specs[name]
                         placed = self._placement[name]
@@ -501,37 +1192,97 @@ class FleetRouter:
                         cands.sort(key=lambda r: (hosted[r.rid], r.rid))
                         task = (name, spec, cands[0])
                         break
+                    dtask = None
                     if task is None:
+                        # decode-engine deficits: same one-per-pass rule,
+                        # least-loaded counts BOTH tiers' placements
+                        for name in sorted(self._dspecs):
+                            spec = self._dspecs[name]
+                            placed = self._dplacement[name]
+                            live_placed = [
+                                rid for rid in placed
+                                if self._replicas[rid].state == LIVE]
+                            want = min(spec.replicas, len(live))
+                            if len(live_placed) >= want:
+                                continue
+                            cands = [r for r in live
+                                     if r.rid not in placed
+                                     and (name, r.rid) not in failed]
+                            if not cands:
+                                continue
+                            cands.sort(key=lambda r: (hosted[r.rid], r.rid))
+                            dtask = (name, spec, cands[0])
+                            break
+                    if task is None and dtask is None:
                         return
-                name, spec, rep = task
+                if task is not None:
+                    name, spec, rep = task
+                    try:
+                        # load + full bucket-menu warmup on the new replica,
+                        # BEFORE the placement commit below makes it routable
+                        rep.server.load_model(name, spec.block,
+                                              spec.input_shapes, **spec.kwargs)
+                    except MXNetError:
+                        failed.add((name, rep.rid))
+                        continue
+                    committed = False
+                    with self._lock:
+                        if (not self._closed and rep.state == LIVE
+                                and name in self._specs
+                                and rep.rid not in self._placement[name]):
+                            self._placement[name].append(rep.rid)
+                            self._breakers[(name, rep.rid)] = CircuitBreaker(
+                                failure_threshold=self._breaker_threshold,
+                                backoff_s=self._breaker_backoff_s,
+                                max_backoff_s=self._breaker_max_backoff_s)
+                            committed = True
+                    if committed:
+                        self.stats_sink.on_rebalance()
+                    else:
+                        # lost the race (replica died / model unloaded /
+                        # fleet stopped while warming): roll the orphan back
+                        try:
+                            rep.server.unload(name)
+                        except MXNetError:
+                            pass
+                    continue
+                # decode deficit: build + warm a fresh engine OUTSIDE the
+                # lock (factory runs prefill/decode warmup), attach it to
+                # the replica's server so replica teardown drains it, then
+                # commit the placement
+                name, spec, rep = dtask
                 try:
-                    # load + full bucket-menu warmup on the new replica,
-                    # BEFORE the placement commit below makes it routable
-                    rep.server.load_model(name, spec.block,
-                                          spec.input_shapes, **spec.kwargs)
+                    eng = spec.factory(name)
                 except MXNetError:
+                    failed.add((name, rep.rid))
+                    continue
+                try:
+                    rep.server.attach_engine(eng)
+                except MXNetError:
+                    eng.stop()
                     failed.add((name, rep.rid))
                     continue
                 committed = False
                 with self._lock:
                     if (not self._closed and rep.state == LIVE
-                            and name in self._specs
-                            and rep.rid not in self._placement[name]):
-                        self._placement[name].append(rep.rid)
-                        self._breakers[(name, rep.rid)] = CircuitBreaker(
+                            and name in self._dspecs
+                            and rep.rid not in self._dplacement[name]):
+                        self._dplacement[name].append(rep.rid)
+                        self._dengines[(name, rep.rid)] = eng
+                        self._dbreakers[(name, rep.rid)] = CircuitBreaker(
                             failure_threshold=self._breaker_threshold,
                             backoff_s=self._breaker_backoff_s,
                             max_backoff_s=self._breaker_max_backoff_s)
+                        spec.max_new = eng.max_new_tokens
                         committed = True
                 if committed:
                     self.stats_sink.on_rebalance()
                 else:
-                    # lost the race (replica died / model unloaded / fleet
-                    # stopped while warming): roll the orphan copy back
                     try:
-                        rep.server.unload(name)
+                        rep.server.detach_engine(name)
                     except MXNetError:
                         pass
+                    eng.stop()
 
     def wait_converged(self, timeout_s=10.0):
         """Block until every model has min(target, live) routable copies
@@ -545,7 +1296,11 @@ class FleetRouter:
                     len([rid for rid in self._placement[name]
                          if self._replicas[rid].state == LIVE])
                     >= min(spec.replicas, n_live)
-                    for name, spec in self._specs.items())
+                    for name, spec in self._specs.items()) and all(
+                    len([rid for rid in self._dplacement[name]
+                         if self._replicas[rid].state == LIVE])
+                    >= min(spec.replicas, n_live)
+                    for name, spec in self._dspecs.items())
             if done:
                 return True
             if time.monotonic() >= deadline:
@@ -554,40 +1309,60 @@ class FleetRouter:
 
     # -- observability ----------------------------------------------------
     def health(self, name=None):
-        """HEALTHY / DEGRADED / UNAVAILABLE for one model (or the worst
-        across the fleet).  A model with zero routable replicas is
-        UNAVAILABLE; under target, a non-LIVE placement, or any breaker
-        off HEALTHY is DEGRADED."""
+        """HEALTHY / DEGRADED / UNAVAILABLE for one model or decode
+        engine (or the worst across the fleet).  A name with zero
+        routable replicas is UNAVAILABLE; under target, a non-LIVE
+        placement, or any breaker off HEALTHY is DEGRADED.  Decode names
+        fall through to the attached engines on every placement, so a
+        replica whose engine breaker opened degrades the fleet answer
+        even before the router's own breaker notices."""
         with self._lock:
-            if name is not None and name not in self._specs:
-                raise MXNetError("no model %r in the fleet; loaded: %s"
-                                 % (name, sorted(self._specs) or "none"))
-            names = [name] if name is not None else sorted(self._specs)
+            if name is not None and name not in self._specs \
+                    and name not in self._dspecs:
+                raise MXNetError(
+                    "no model %r in the fleet; loaded: %s"
+                    % (name, sorted(set(self._specs) | set(self._dspecs))
+                       or "none"))
+            names = ([name] if name is not None
+                     else sorted(set(self._specs) | set(self._dspecs)))
             n_live = sum(1 for r in self._replicas.values()
                          if r.state == LIVE)
             rows = []
             for n in names:
-                placed = list(self._placement[n])
+                if n in self._specs:
+                    placed = list(self._placement[n])
+                    target = self._specs[n].replicas
+                    probes = [self._breakers[(n, rid)] for rid in placed
+                              if self._replicas[rid].state == LIVE]
+                else:
+                    placed = list(self._dplacement[n])
+                    target = self._dspecs[n].replicas
+                    # breaker AND engine per live placement: the engine's
+                    # own health (its internal execute breaker) rolls up
+                    probes = []
+                    for rid in placed:
+                        if self._replicas[rid].state != LIVE:
+                            continue
+                        probes.append(self._dbreakers[(n, rid)])
+                        probes.append(self._dengines[(n, rid)])
                 states = [self._replicas[rid].state for rid in placed]
-                breakers = [self._breakers[(n, rid)] for rid in placed
-                            if self._replicas[rid].state == LIVE]
-                rows.append((n, self._specs[n].replicas, states, breakers))
+                rows.append((target, states, probes))
         worst = HEALTHY
-        rank = {HEALTHY: 0, DEGRADED: 1, UNAVAILABLE_HEALTH: 2}
-        for _, target, states, breakers in rows:
+        for target, states, probes in rows:
             n_routable = sum(1 for s in states if s == LIVE)
             if n_routable == 0:
                 h = UNAVAILABLE_HEALTH
             else:
-                b_health = [b.health() for b in breakers]
-                if (any(bh != HEALTHY for bh in b_health)
+                # .health() calls outside _lock (breakers and engines
+                # take their own locks)
+                levels = [p.health() for p in probes]
+                if (worst_health(levels) != HEALTHY
                         or n_routable < min(target, max(n_live, 1))
                         or any(s != LIVE for s in states)):
                     h = DEGRADED
                 else:
                     h = HEALTHY
-            if rank[h] > rank[worst]:
-                worst = h
+            worst = worst_health((worst, h))
         return worst
 
     def stats(self):
@@ -596,7 +1371,10 @@ class FleetRouter:
             reps = {rid: {"state": rep.state, "inflight": rep.inflight,
                           "models": sorted(n for n, rids
                                            in self._placement.items()
-                                           if rid in rids)}
+                                           if rid in rids),
+                          "engines": sorted(n for n, rids
+                                            in self._dplacement.items()
+                                            if rid in rids)}
                     for rid, rep in self._replicas.items()}
             models = {}
             for name, spec in self._specs.items():
@@ -608,12 +1386,33 @@ class FleetRouter:
                                  for rid in placed
                                  if (name, rid) in self._breakers},
                 }
-        for snap in models.values():
-            snap["breakers"] = {rid: b.snapshot()
-                                for rid, b in snap["breakers"].items()}
+            dmodels = {}
+            for name, spec in self._dspecs.items():
+                placed = list(self._dplacement[name])
+                dmodels[name] = {
+                    "target": spec.replicas,
+                    "placement": placed,
+                    "breakers": {rid: self._dbreakers[(name, rid)]
+                                 for rid in placed
+                                 if (name, rid) in self._dbreakers},
+                }
+            dengines = dict(self._dengines)
+        for snaps in (models, dmodels):
+            for snap in snaps.values():
+                snap["breakers"] = {rid: b.snapshot()
+                                    for rid, b in snap["breakers"].items()}
         out = self.stats_sink.snapshot()
         out["replicas"] = reps
         out["models"] = models
+        out["decode_models"] = dmodels
+        # per-engine fall-through: the full DecodeEngine snapshot of every
+        # placement, fleet-wide (engine calls outside _lock)
+        engines_out = {}
+        for (name, rid), eng in sorted(dengines.items()):
+            engines_out.setdefault(name, {})[rid] = eng.stats_snapshot()
+        out["engines"] = engines_out
+        out["decode"] = self.decode_stats.snapshot()
+        out["tenants"] = self.tenant_snapshot()
         return out
 
     # -- lifecycle ---------------------------------------------------------

@@ -27,13 +27,25 @@ import threading
 import time
 
 __all__ = ["HEALTHY", "DEGRADED", "UNAVAILABLE_HEALTH", "CircuitBreaker",
-           "ADMIT", "PROBE", "REJECT"]
+           "ADMIT", "PROBE", "REJECT", "HEALTH_RANK", "worst_health"]
 
 # health states (UNAVAILABLE the request *status* lives in server.py;
 # UNAVAILABLE_HEALTH is the same word as a *health* level)
 HEALTHY = "HEALTHY"
 DEGRADED = "DEGRADED"
 UNAVAILABLE_HEALTH = "UNAVAILABLE"
+
+# severity order for aggregating health across replicas/engines
+HEALTH_RANK = {HEALTHY: 0, DEGRADED: 1, UNAVAILABLE_HEALTH: 2}
+
+
+def worst_health(levels):
+    """The most severe level in ``levels`` (HEALTHY when empty)."""
+    worst = HEALTHY
+    for level in levels:
+        if HEALTH_RANK.get(level, 2) > HEALTH_RANK[worst]:
+            worst = level
+    return worst
 
 # admit() decisions
 ADMIT = "admit"

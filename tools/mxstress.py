@@ -18,8 +18,14 @@ token integrity, KV-block accounting, zero steady-state recompiles, no
 deadlock.  The ``fleet`` scenario kills a serving replica under storm load
 (SimulatedCrash at ``fleet.replica``): the FleetRouter must drop zero
 requests across failovers, keep tail latency bounded, rebalance onto a
-re-warmed replica, and re-converge HEALTHY.  Exit code is non-zero iff any
-seed violated any invariant.
+re-warmed replica, and re-converge HEALTHY.  The ``decode_fleet`` scenario
+drains one replica AND kills another under a multi-tenant token-stream
+storm: drained streams hand off (prefix + KV pages, lease-generation
+fenced) to survivors and stay bitwise-equal to the uninterrupted
+reference, killed streams terminate UNAVAILABLE with valid prefixes,
+router/engine/tenant counters conserve, KV pools stay whole on survivors,
+and no tenant starves.  Exit code is non-zero iff any seed violated any
+invariant.
 
 Usage:
   python tools/mxstress.py --smoke              # 25 fixed seeds, <=20 s

@@ -15,10 +15,19 @@ Two load profiles:
   throughput, p50/p99 time-to-first-token, KV pool peak/leak, the
   steady-state recompile count, and the continuous-vs-static speedup to a
   BENCH_DECODE.json artifact.
+* ``--profile fleet-decode`` — the stateful decode fleet: the same stream
+  workload through ``FleetRouter.submit_stream`` across two replicas with
+  one replica DRAINED mid-run, so every one of its live streams hands off
+  (prefix + KV pages, lease-fenced) to the survivor; reports token
+  throughput and TTFT p50/p99 measured ACROSS the handoff, the handoff
+  count, and per-engine recompile/KV-leak gates to a
+  BENCH_FLEET_DECODE.json artifact.  The exit gate requires every stream
+  to finish OK despite the drain.
 
 Usage:
   python tools/serve_bench.py                        # full batch run
   python tools/serve_bench.py --profile decode       # full decode run
+  python tools/serve_bench.py --profile fleet-decode # drain-handoff bench
   python tools/serve_bench.py --smoke [--profile decode]  # tier-1 smokes
   python tools/serve_bench.py --clients 16 --requests 64 --out bench.json
 """
@@ -245,10 +254,140 @@ def _decode_ok(report):
     return True
 
 
+def run_fleet_decode_bench(streams, slots, block_size, max_prompt, max_new,
+                           seed, model_cfg, replicas=2):
+    """Stream workload through the fleet with one replica drained mid-run.
+
+    Every per-replica KV pool is sized to hold the WHOLE stream set, so
+    the drain is the only thing under test: with headroom guaranteed on
+    the survivor, a single mid-run ``drain()`` must hand every live
+    stream off (prefix + KV pages) and every stream must still finish OK
+    — throughput and TTFT are measured across the handoff, not around
+    it."""
+    from mxnet_tpu.serving.decode import DecodeEngine, TinyCausalLM
+    from mxnet_tpu.serving.fleet import FleetRouter
+
+    max_width = DecodeEngine.worst_case_width(max_prompt, max_new,
+                                              block_size)
+    per_stream = -(-(max_prompt + max_new) // block_size)
+    num_blocks = streams * per_stream + 1   # +1: the trash block
+
+    def factory(name):
+        model = TinyCausalLM(**model_cfg)
+        return DecodeEngine(model, name=name, max_slots=slots,
+                            block_size=block_size,
+                            max_prompt_len=max_prompt,
+                            max_new_tokens=max_new, max_queue=streams,
+                            num_blocks=num_blocks,
+                            width_blocks=[max_width])
+
+    rng = np.random.RandomState(seed)
+    vocab = model_cfg["vocab_size"]
+    prompts = [rng.randint(0, vocab,
+                           rng.randint(1, max_prompt + 1)).tolist()
+               for _ in range(streams)]
+
+    t0 = time.monotonic()
+    router = FleetRouter(replicas=replicas, failover_budget=2)
+    router.load_decode("bench-fleet", factory, replicas=replicas)
+    warmup_s = time.monotonic() - t0
+
+    drained = router.stats()["decode_models"]["bench-fleet"]["placement"][0]
+    t0 = time.monotonic()
+    handles = [router.submit_stream("bench-fleet", p,
+                                    max_new_tokens=max_new)
+               for p in prompts]
+    router.drain(drained)       # mid-run: live streams hand off
+    tokens = 0
+    ttfts = []
+    statuses = {}
+    for h in handles:
+        h.wait()
+        statuses[h.status] = statuses.get(h.status, 0) + 1
+        tokens += len(h.tokens())
+        if h.ttft_ms is not None:
+            ttfts.append(h.ttft_ms)
+    wall = time.monotonic() - t0
+
+    # settle: terminal hooks and KV frees land just after the last wait()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        d = router.decode_stats.snapshot()
+        eng = router.stats()["engines"].get("bench-fleet", {})
+        if d["requests"] == (d["ok"] + d["timeouts"] + d["errors"]
+                             + d["unavailable"]) \
+                and all(s["kv"]["used"] == 0 and s["kv"]["reserved"] == 0
+                        for s in eng.values()):
+            break
+        time.sleep(0.005)
+    decode = router.decode_stats.snapshot()
+    engines = {}
+    for rid, snap in sorted(
+            router.stats()["engines"].get("bench-fleet", {}).items()):
+        kv = snap["kv"]
+        engines[rid] = {
+            "drained": rid == drained,
+            "requests": snap["requests"],
+            "imported": snap["imported"],
+            "handed_off": snap["handed_off"],
+            "steady_state_recompiles": (snap["cache"]["recompiles"]
+                                        - snap["warmup"]["cache"]["misses"]),
+            "kv_leaked_blocks": (kv["allocated_total"] - kv["freed_total"]),
+            "kv_peak_blocks": kv["peak_used"],
+        }
+    router.stop()
+
+    from mxnet_tpu.serving.stats import LatencyWindow
+    window = LatencyWindow(capacity=max(1, len(ttfts)))
+    for ms in ttfts:
+        window.add(ms)
+    pcts = {k: round(v, 3)
+            for k, v in window.percentiles(ps=(50, 99)).items()}
+    return {
+        "profile": "fleet-decode",
+        "workload": {
+            "streams": streams,
+            "slots": slots,
+            "block_size": block_size,
+            "max_prompt_len": max_prompt,
+            "max_new_tokens": max_new,
+            "seed": seed,
+            "replicas": replicas,
+            "model": dict(model_cfg),
+        },
+        "drained_mid_run": drained,
+        "warmup_s": round(warmup_s, 3),
+        "wall_s": round(wall, 3),
+        "tokens_out": tokens,
+        "tokens_per_s": round(tokens / wall, 1) if wall else 0.0,
+        "ttft_ms": pcts,
+        "statuses": statuses,
+        "handoffs": decode["handoffs"],
+        "fenced": decode["fenced"],
+        "engines": engines,
+    }
+
+
+def _fleet_decode_ok(report):
+    """Exit gate for the fleet-decode profile: every stream OK across the
+    drain, at least one actual handoff, none fenced away, and zero
+    steady-state recompiles / leaked KV blocks on every engine."""
+    if set(report["statuses"]) != {"OK"}:
+        return False
+    if report["handoffs"] < 1 or report["fenced"]:
+        return False
+    for snap in report["engines"].values():
+        if snap["steady_state_recompiles"] != 0 or snap["kv_leaked_blocks"]:
+            return False
+    return True
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="serve_bench", description=__doc__)
-    ap.add_argument("--profile", choices=("batch", "decode"),
+    ap.add_argument("--profile", choices=("batch", "decode", "fleet-decode"),
                     default="batch")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="[fleet-decode] decode replicas (one is drained)")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=40,
                     help="requests per client")
@@ -276,9 +415,39 @@ def main(argv=None):
                     help="small fast run for tier-1 (overrides sizes)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = os.path.join(
-            REPO, "BENCH_DECODE.json" if args.profile == "decode"
-            else "BENCH_SERVE.json")
+        args.out = os.path.join(REPO, {
+            "decode": "BENCH_DECODE.json",
+            "fleet-decode": "BENCH_FLEET_DECODE.json",
+        }.get(args.profile, "BENCH_SERVE.json"))
+
+    if args.profile == "fleet-decode":
+        if args.smoke:
+            args.streams, args.slots = 12, 4
+            args.block_size, args.max_prompt, args.max_new = 4, 8, 12
+            model_cfg = dict(vocab_size=32, hidden=16, num_layers=1,
+                             num_heads=2, max_len=32, seed=7)
+        else:
+            # the single-engine decode defaults are oversized for a
+            # two-replica drain bench; scale down unless overridden
+            if args.streams == ap.get_default("streams"):
+                args.streams = 32
+            if args.max_new == ap.get_default("max_new"):
+                args.max_new = 24
+            model_cfg = dict(vocab_size=48, hidden=32, num_layers=2,
+                             num_heads=2, max_len=128, seed=7)
+        report = run_fleet_decode_bench(
+            args.streams, args.slots, args.block_size, args.max_prompt,
+            args.max_new, args.seed, model_cfg, replicas=args.replicas)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print("fleet-decode: %s tok/s  ttft p50/p99: %s/%s ms  "
+              "handoffs: %d  fenced: %d  drained: %s"
+              % (report["tokens_per_s"], report["ttft_ms"]["p50"],
+                 report["ttft_ms"]["p99"], report["handoffs"],
+                 report["fenced"], report["drained_mid_run"]))
+        print("wrote %s" % args.out)
+        return 0 if _fleet_decode_ok(report) else 1
 
     if args.profile == "decode":
         if args.smoke:
