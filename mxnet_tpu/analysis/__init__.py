@@ -1,6 +1,6 @@
 """Static analysis passes over the TPU build (``tools/mxlint.py`` front end).
 
-Four passes, one per defect class the green test suite cannot see:
+Seven passes, one per defect class the green test suite cannot see:
 
 * :mod:`.tracing_lint` — AST pass over ``mxnet_tpu/`` for tracer
   concretization, implicit host syncs inside fcompute bodies, and
@@ -15,8 +15,15 @@ Four passes, one per defect class the green test suite cannot see:
   lock-order cycle detection, thread-target hygiene.  Its dynamic twin is
   :mod:`.schedule` (``tools/mxstress.py``), a seeded adversarial-schedule
   stress harness over the threaded runtime.
+* :mod:`.dataflow` — the mxflow interprocedural engine behind the
+  ``sync`` / ``rcp`` / ``res`` pass families: device->host sync
+  reachability from declared hot regions, stealth-recompile hazards at
+  jit/CachedOp boundaries, and resource acquire/release pairing across
+  exception edges.  Sanctioned syncs carry ``# mxflow: sync-ok(<reason>)``
+  tags, cataloged in ``docs/SYNC_MAP.md``.
 
-All passes emit :class:`.common.Finding` records keyed by stable identity
+The pass registry (:data:`.common.PASS_REGISTRY`) is the single source of
+truth mapping pass names to rule-key prefixes and runners.  All passes emit :class:`.common.Finding` records keyed by stable identity
 (rule + path + scope + detail, no line numbers) so a checked-in baseline
 (``.mxlint-baseline.json``) survives unrelated edits.
 """

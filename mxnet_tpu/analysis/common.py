@@ -22,15 +22,50 @@ import re
 
 __all__ = ["Finding", "Baseline", "load_baseline", "relpath",
            "line_suppressions", "render_text", "render_json",
-           "DEFAULT_BASELINE"]
+           "DEFAULT_BASELINE", "PASS_REGISTRY", "PASSES",
+           "RULE_FAMILY_PASS", "pass_of_key", "resolve_runner"]
 
 DEFAULT_BASELINE = ".mxlint-baseline.json"
+
+# The single source of truth for the pass list.  tools/mxlint.py derives
+# its --passes choices and dispatch from this table, and the baseline
+# partitioner derives RULE_FAMILY_PASS from the ``rules`` columns, so
+# adding a pass is a one-line change here (tests/test_mxflow.py has the
+# drift test).  ``runner`` is "module:callable"; the callable takes the
+# repo root and returns findings — except when ``report`` is set, in
+# which case it returns ``(findings, report_dict)``.
+PASS_REGISTRY = {
+    "tracing": {"rules": ("TRC", "HSY", "RNG"),
+                "runner": "mxnet_tpu.analysis.tracing_lint:run"},
+    "registry": {"rules": ("REG",),
+                 "runner": "mxnet_tpu.analysis.registry_audit:audit",
+                 "report": True},
+    "cabi": {"rules": ("ABI",),
+             "runner": "mxnet_tpu.analysis.cabi_lint:run"},
+    "concur": {"rules": ("CON",),
+               "runner": "mxnet_tpu.analysis.concurrency_lint:run"},
+    "sync": {"rules": ("SYN",),
+             "runner": "mxnet_tpu.analysis.dataflow:run_sync"},
+    "rcp": {"rules": ("RCP",),
+            "runner": "mxnet_tpu.analysis.dataflow:run_rcp"},
+    "res": {"rules": ("RES",),
+            "runner": "mxnet_tpu.analysis.dataflow:run_res"},
+}
+
+PASSES = tuple(PASS_REGISTRY)
 
 # rule-family prefix -> owning pass (used to scope partial-pass baseline
 # updates so `--passes tracing --update-baseline` cannot drop the other
 # passes' suppressions)
-RULE_FAMILY_PASS = {"TRC": "tracing", "HSY": "tracing", "RNG": "tracing",
-                    "REG": "registry", "ABI": "cabi", "CON": "concur"}
+RULE_FAMILY_PASS = {fam: name for name, spec in PASS_REGISTRY.items()
+                    for fam in spec["rules"]}
+
+
+def resolve_runner(name):
+    """Import and return the runner callable of a registered pass."""
+    import importlib
+    mod_name, attr = PASS_REGISTRY[name]["runner"].split(":")
+    return getattr(importlib.import_module(mod_name), attr)
 
 
 def pass_of_key(key):

@@ -1,0 +1,1608 @@
+"""mxflow — interprocedural dataflow analysis over ``mxnet_tpu/``.
+
+Three mxlint pass families share one engine (``tools/mxlint.py --passes
+sync,rcp,res``), all enforcing the established empty-baseline
+fix-never-suppress policy:
+
+* **SYN** (pass ``sync``) — implicit device->host synchronization points
+  reachable from the declared hot regions: blocking fetch primitives
+  (``asnumpy``/``asscalar``/``wait_to_read``/``block_until_ready``/
+  ``jax.device_get``), device-tainted scalar coercion (``float``/``int``/
+  ``bool``/truth tests), and ``np.asarray``/``np.array`` on device values.
+  Every finding reports the full call chain from a hot root.
+* **RCP** (pass ``rcp``) — stealth-recompile hazards at jit/CachedOp
+  boundaries: data-dependent shapes that bypass the bucket ladders,
+  jit objects constructed per call (loops, immediate invocation, uncached
+  construction on a hot path), non-hashable/fresh-lambda static arguments,
+  and jit-captured mutable ``self`` state.
+* **RES** (pass ``res``) — acquire/release lifecycle pairing across
+  exception edges for the framework's owned resources: locks, KV block
+  reservations, lease generations, and closeable workers/pools.  The
+  static twin of mxstress's "pool whole after drain" dynamic invariants.
+
+Annotation vocabulary (comment tokens, so string literals never match):
+
+* ``mxflow: hot`` (preceded by ``#``) on or directly above a ``def`` — or
+  the ``@mxflow_hot`` decorator — declares a hot-region root: reachability
+  starts here.
+* ``mxflow: cold`` marks a function the reachability walk must not enter
+  (a deliberate call-graph cut, e.g. an error path that may sync).
+* ``mxflow: sync-ok(<reason>)`` on the offending line sanctions a sync
+  site.  The reason is mandatory; every tagged site is collected into
+  ``docs/SYNC_MAP.md`` (``tools/mxlint.py --sync-map``) — the work-list
+  ROADMAP item 4's trace-first refactor burns down.  A malformed or stale
+  tag is itself a finding (SYN003), so the catalog cannot rot.
+
+``mxnet_tpu/analysis/`` is excluded from the scan: the linters and the
+mxstress schedule harness are host-side instrumentation by definition
+(``schedule.py`` wraps ``Lock.acquire`` to inject adversarial interleavings
+— flagging the chaos harness for chaos would be noise).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import threading
+import tokenize
+
+from .common import Finding, apply_line_suppressions, relpath
+
+__all__ = ["run_sync", "run_rcp", "run_res", "analyze_source",
+           "sync_map_entries", "render_sync_map", "build_graph"]
+
+_HOT_RE = re.compile(r"mxflow:\s*hot\b")
+_COLD_RE = re.compile(r"mxflow:\s*cold\b")
+_SYNC_OK_RE = re.compile(r"mxflow:\s*sync-ok\s*\(([^)]*)\)")
+_SYNC_OK_ANY_RE = re.compile(r"mxflow:\s*sync-ok")
+_HOT_DECORATORS = ("mxflow_hot",)
+_COLD_DECORATORS = ("mxflow_cold",)
+
+# Blocking fetch primitives: a call of one of these is a device->host sync
+# no matter what the receiver turns out to be at runtime (the eager tax
+# EAGER_OVERHEAD.json measures).  ``item``/``tolist`` exist on host numpy
+# arrays too, so those require device taint on the receiver.
+_SYNC_ALWAYS = {"asnumpy", "asscalar", "wait_to_read", "block_until_ready"}
+_SYNC_TAINTED = {"item", "tolist"}
+
+# Device modules: a call through an alias of one of these yields a
+# device-resident value (taint source).
+_DEVICE_MODULES = {"jax", "jax.numpy"}
+_NUMPY_MODULES = {"numpy"}
+
+# jit/CachedOp constructors (RCP): recognized by name so fixtures and the
+# package resolve identically.
+_JIT_CTOR_NAMES = {"jit", "CachedOp"}
+_SHAPE_CTORS = {"zeros", "ones", "empty", "full", "arange"}
+
+# RES pair table.  ``recv_pat`` narrows which receivers a pair applies to:
+# ``register`` is also the op-registry decorator verb, so the lease pairing
+# only binds to lease/membership tables.
+_LOCK_ACQUIRE = "acquire"
+_LOCK_RELEASE = "release"
+_RAISE_PAIRS = (
+    # (acquire method, receiver pattern or None, release methods)
+    ("reserve", None, ("release", "free_seq")),
+    ("register", r"lease|member", ("expire", "unregister", "deregister")),
+)
+_RELEASE_METHODS = {"release", "free_seq", "expire", "unregister",
+                    "deregister"}
+_CLOSEABLE_CTORS = {"DeviceFeed": ("close",),
+                    "ThreadPool": ("close", "terminate", "shutdown"),
+                    "Pool": ("close", "terminate"),
+                    "PrefetchingIter": ("close",),
+                    "open": ("close",)}
+_CLOSE_METHODS = {"close", "terminate", "shutdown"}
+
+
+def _unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:                                  # pragma: no cover
+        return "<expr>"
+
+
+def _comment_map(source):
+    """line -> comment text (tokenize-based: string literals never match)."""
+    out = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module / function model
+# ---------------------------------------------------------------------------
+
+class _SyncSite(object):
+    __slots__ = ("line", "kind", "recv", "reason")
+
+    def __init__(self, line, kind, recv, reason):
+        self.line = line          # 1-based
+        self.kind = kind          # e.g. ".asnumpy", "float()", "np.asarray"
+        self.recv = recv          # receiver/argument text (display + key)
+        self.reason = reason      # sync-ok justification, or None
+
+
+class _Func(object):
+    __slots__ = ("key", "qual", "name", "module", "cls", "node", "lineno",
+                 "hot", "cold", "calls", "sync_sites", "local_types",
+                 "local_jit")
+
+    def __init__(self, key, qual, name, module, cls, node):
+        self.key = key
+        self.qual = qual
+        self.name = name
+        self.module = module
+        self.cls = cls            # _Class or None
+        self.node = node
+        self.lineno = node.lineno if node is not None else 0
+        self.hot = False
+        self.cold = False
+        self.calls = []           # [(callee_key, lineno)]
+        self.sync_sites = []
+        self.local_types = {}     # local var -> class key
+        self.local_jit = {}       # local var -> jit ctor Call node
+
+    @property
+    def path(self):
+        return self.module.path
+
+
+class _Class(object):
+    __slots__ = ("key", "name", "module", "node", "bases", "methods",
+                 "attr_types", "attr_jit", "mutated_attrs")
+
+    def __init__(self, key, name, module, node):
+        self.key = key
+        self.name = name
+        self.module = module
+        self.node = node
+        self.bases = []           # base name strings, resolved lazily
+        self.methods = {}         # name -> _Func
+        self.attr_types = {}      # self.X -> ("cls", class_key)
+                                  #        | ("wraps", func_key)
+        self.attr_jit = {}        # self.X -> jit ctor Call node
+        self.mutated_attrs = set()  # self.X assigned outside __init__
+
+
+class _Module(object):
+    __slots__ = ("name", "path", "tree", "lines", "comments", "mod_alias",
+                 "symbols", "functions", "classes", "aliases",
+                 "module_jit", "func_order")
+
+    def __init__(self, name, path):
+        self.name = name
+        self.path = path
+        self.tree = None
+        self.lines = []
+        self.comments = {}
+        self.mod_alias = {}       # local name -> dotted module name
+        self.symbols = {}         # local name -> (module name, symbol)
+        self.functions = {}       # name -> _Func (module level)
+        self.classes = {}         # name -> _Class
+        self.aliases = {}         # name -> func key (wrapper aliasing)
+        self.module_jit = {}      # name -> jit ctor Call node
+        self.func_order = []      # every _Func incl. methods/nested
+
+
+class Graph(object):
+    """Parsed package: modules, classes, functions, resolved call edges."""
+
+    def __init__(self):
+        self.modules = {}         # dotted name -> _Module
+        self.funcs = {}           # func key -> _Func
+        self.classes = {}         # class key -> _Class
+        self.package = None       # root package name ("mxnet_tpu")
+
+    # -- resolution helpers -------------------------------------------
+    def resolve_symbol(self, module, name):
+        """-> ("func", key) | ("cls", key) | ("mod", dotted) | None."""
+        if name in module.functions:
+            return ("func", module.functions[name].key)
+        if name in module.classes:
+            return ("cls", module.classes[name].key)
+        if name in module.aliases:
+            return ("func", module.aliases[name])
+        if name in module.mod_alias:
+            return ("mod", module.mod_alias[name])
+        if name in module.symbols:
+            tgt_mod, sym = module.symbols[name]
+            tm = self.modules.get(tgt_mod)
+            if tm is not None and tm is not module:
+                return self.resolve_symbol(tm, sym)
+        return None
+
+    def mro(self, cls, _seen=None):
+        """Package-local linearization (by-name, cycle-safe)."""
+        seen = _seen if _seen is not None else set()
+        if cls.key in seen:
+            return []
+        seen.add(cls.key)
+        out = [cls]
+        for base_name in cls.bases:
+            got = self.resolve_symbol(cls.module, base_name)
+            if got and got[0] == "cls":
+                base = self.classes.get(got[1])
+                if base is not None:
+                    out.extend(self.mro(base, seen))
+        return out
+
+    def find_method(self, cls, name):
+        for c in self.mro(cls):
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def attr_info(self, cls, attr):
+        for c in self.mro(cls):
+            if attr in c.attr_types:
+                return c.attr_types[attr]
+        return None
+
+    def attr_jit_node(self, cls, attr):
+        for c in self.mro(cls):
+            if attr in c.attr_jit:
+                return c.attr_jit[attr]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+def _module_name(rel, package_dir_rel):
+    assert rel.endswith(".py")
+    name = rel[:-3].replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[:-len(".__init__")]
+    return name
+
+
+def _dec_name(dec):
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Name):
+        return dec.id
+    if isinstance(dec, ast.Attribute):
+        return dec.attr
+    return None
+
+
+def _annotations(mod, node):
+    """(hot, cold) for a function def, from decorators or comments."""
+    hot = cold = False
+    first = node.lineno
+    for dec in node.decorator_list:
+        nm = _dec_name(dec)
+        if nm in _HOT_DECORATORS:
+            hot = True
+        if nm in _COLD_DECORATORS:
+            cold = True
+        first = min(first, dec.lineno)
+    for ln in (node.lineno, first, first - 1):
+        comment = mod.comments.get(ln, "")
+        if _HOT_RE.search(comment):
+            hot = True
+        if _COLD_RE.search(comment):
+            cold = True
+    return hot, cold
+
+
+def _register_func(graph, mod, node, cls, parent=None):
+    qual = node.name
+    if parent is not None:
+        qual = "%s.%s" % (parent.qual, node.name)
+    elif cls is not None:
+        qual = "%s.%s" % (cls.name, node.name)
+    key = "%s::%s" % (mod.path, qual)
+    fn = _Func(key, qual, node.name, mod, cls, node)
+    fn.hot, fn.cold = _annotations(mod, node)
+    graph.funcs[key] = fn
+    mod.func_order.append(fn)
+    # nested defs: separate nodes, implicit parent -> child edge (local
+    # helpers like submit_stream._reject are called by their owner)
+    for child in ast.iter_child_nodes(node):
+        for sub in ast.walk(child):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _owner_stmt(node, sub):
+                    kid = _register_func(graph, mod, sub, cls, parent=fn)
+                    fn.calls.append((kid.key, sub.lineno))
+    return fn
+
+
+def _owner_stmt(owner, sub):
+    """True iff ``sub`` is a def whose *closest* enclosing def is ``owner``."""
+    stack = [(owner, iter(ast.iter_child_nodes(owner)))]
+    # walk, cutting at nested defs: sub must be found before another def
+    def search(node):
+        for child in ast.iter_child_nodes(node):
+            if child is sub:
+                return True
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if search(child):
+                return True
+        return False
+    return search(owner)
+
+
+def _is_jit_ctor(call):
+    """'jit'|'CachedOp'|None for a Call node constructing a jit object."""
+    f = call.func
+    name = None
+    if isinstance(f, ast.Name):
+        name = f.id
+    elif isinstance(f, ast.Attribute):
+        name = f.attr
+    if name in _JIT_CTOR_NAMES:
+        return name
+    # functools.partial(jax.jit, ...) used as a decorator factory
+    if name == "partial" and call.args:
+        inner = call.args[0]
+        if isinstance(inner, ast.Attribute) and inner.attr == "jit":
+            return "jit"
+        if isinstance(inner, ast.Name) and inner.id == "jit":
+            return "jit"
+    return None
+
+
+def _parse_module(graph, name, path, rel, source):
+    mod = _Module(name, rel)
+    try:
+        mod.tree = ast.parse(source)
+    except SyntaxError as e:
+        graph.modules[name] = mod
+        mod.lines = source.splitlines()
+        fn = _Func("%s::<module>" % rel, "<module>", "<module>", mod, None,
+                   ast.parse("pass").body[0])
+        fn.sync_sites = []
+        return mod
+    mod.lines = source.splitlines()
+    mod.comments = _comment_map(source)
+
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.mod_alias[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+                if a.asname:
+                    mod.mod_alias[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = _import_base(graph, name, node)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                target = "%s.%s" % (base, a.name) if base else a.name
+                # resolved to a module vs a symbol in a second pass
+                mod.symbols[local] = (base or "", a.name)
+                mod.mod_alias.setdefault("__from__%s" % local, target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = _register_func(graph, mod, node, None)
+            mod.functions[node.name] = fn
+        elif isinstance(node, ast.ClassDef):
+            ckey = "%s::%s" % (rel, node.name)
+            cls = _Class(ckey, node.name, mod, node)
+            cls.bases = [b.id if isinstance(b, ast.Name) else b.attr
+                         for b in node.bases
+                         if isinstance(b, (ast.Name, ast.Attribute))]
+            graph.classes[ckey] = cls
+            mod.classes[node.name] = cls
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    m = _register_func(graph, mod, item, cls)
+                    cls.methods[item.name] = m
+        elif isinstance(node, ast.Assign):
+            _module_assign(mod, node)
+    graph.modules[name] = mod
+    return mod
+
+
+def _import_base(graph, mod_name, node):
+    """Dotted base module of a ``from X import ...`` statement."""
+    if node.level == 0:
+        return node.module or ""
+    is_pkg = mod_name in getattr(graph, "_packages", ())
+    pkg = mod_name if is_pkg else mod_name.rsplit(".", 1)[0]
+    parts = pkg.split(".")
+    up = node.level - 1
+    if up:
+        parts = parts[:-up] if up < len(parts) else parts[:1]
+    base = ".".join(parts)
+    if node.module:
+        base = "%s.%s" % (base, node.module)
+    return base
+
+
+def _module_assign(mod, node):
+    """Module-level ``X = ...``: jit bindings and wrapper aliases."""
+    if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+        return
+    tgt = node.targets[0].id
+    if isinstance(node.value, ast.Call):
+        if _is_jit_ctor(node.value):
+            mod.module_jit[tgt] = node.value
+            return
+        # wrapper alias: X = retry(...)(stage_batch) — any function name
+        # appearing in the RHS aliases X to it (exactly-one heuristic)
+        names = [n.id for n in ast.walk(node.value)
+                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+        cands = [n for n in dict.fromkeys(names) if n in mod.functions]
+        if len(cands) == 1:
+            mod.aliases[tgt] = mod.functions[cands[0]].key
+
+
+def _finish_symbols(graph):
+    """Second pass: decide module-vs-symbol for ``from X import y``."""
+    for mod in graph.modules.values():
+        fixed = {}
+        for local, (base, sym) in list(mod.symbols.items()):
+            dotted = "%s.%s" % (base, sym) if base else sym
+            if dotted in graph.modules or dotted in _DEVICE_MODULES \
+                    or dotted in _NUMPY_MODULES:
+                mod.mod_alias[local] = dotted
+                fixed[local] = None
+        for local in fixed:
+            del mod.symbols[local]
+        for k in [k for k in mod.mod_alias if k.startswith("__from__")]:
+            del mod.mod_alias[k]
+
+
+def _device_aliases(mod):
+    out = set()
+    for local, dotted in mod.mod_alias.items():
+        if dotted in _DEVICE_MODULES or dotted.endswith(".ndarray") \
+                or dotted == "ndarray":
+            out.add(local)
+    return out
+
+
+def _numpy_aliases(mod):
+    out = set()
+    for local, dotted in mod.mod_alias.items():
+        if dotted in _NUMPY_MODULES or dotted in ("jax.numpy",):
+            out.add(local)
+    return out
+
+
+def _collect_attr_types(graph):
+    """self.X = ... scans: attr types, wrapper aliases, jit attrs, and the
+    mutated-outside-__init__ set RCP004 keys on."""
+    for cls in graph.classes.values():
+        mod = cls.module
+        for mname, meth in cls.methods.items():
+            for node in ast.walk(meth.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    if mname != "__init__":
+                        cls.mutated_attrs.add(tgt.attr)
+                    val = node.value
+                    if not isinstance(val, ast.Call):
+                        continue
+                    ctor = _is_jit_ctor(val)
+                    if ctor:
+                        cls.attr_jit[tgt.attr] = val
+                        continue
+                    got = _call_ctor_class(graph, mod, val)
+                    if got is not None:
+                        cls.attr_types[tgt.attr] = ("cls", got)
+                        continue
+                    # wrapper alias: self.X = retry(self._impl)
+                    meths = [a.attr for a in ast.walk(val)
+                             if isinstance(a, ast.Attribute)
+                             and isinstance(a.value, ast.Name)
+                             and a.value.id == "self"
+                             and isinstance(a.ctx, ast.Load)
+                             and graph.find_method(cls, a.attr) is not None]
+                    meths = list(dict.fromkeys(meths))
+                    if len(meths) == 1:
+                        wrapped = graph.find_method(cls, meths[0])
+                        cls.attr_types[tgt.attr] = ("wraps", wrapped.key)
+
+
+def _call_ctor_class(graph, mod, call):
+    """Class key if ``call`` constructs a package-local class, else None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        got = graph.resolve_symbol(mod, f.id)
+        if got and got[0] == "cls":
+            return got[1]
+    elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        dotted = mod.mod_alias.get(f.value.id)
+        tm = graph.modules.get(dotted) if dotted else None
+        if tm is not None and f.attr in tm.classes:
+            return tm.classes[f.attr].key
+    return None
+
+
+# ---------------------------------------------------------------------------
+# call edges
+# ---------------------------------------------------------------------------
+
+def _own_nodes(fn):
+    """Walk ``fn``'s body, excluding nested function/class subtrees (they
+    are separate _Func records with their own edges)."""
+    out = []
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            out.append(child)
+            visit(child)
+    visit(fn.node)
+    return out
+
+
+def _collect_local_types(graph, fn):
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            continue
+        tgt = node.targets[0].id
+        if isinstance(node.value, ast.Call):
+            if _is_jit_ctor(node.value):
+                fn.local_jit[tgt] = node.value
+                continue
+            got = _call_ctor_class(graph, fn.module, node.value)
+            if got is not None:
+                fn.local_types[tgt] = got
+
+
+def _resolve_call(graph, fn, call):
+    """Callee _Func key for a Call node, or None if unresolvable."""
+    mod = fn.module
+    f = call.func
+    if isinstance(f, ast.Name):
+        got = graph.resolve_symbol(mod, f.id)
+        if got is None:
+            return None
+        if got[0] == "func":
+            return got[1]
+        if got[0] == "cls":
+            cls = graph.classes.get(got[1])
+            init = graph.find_method(cls, "__init__") if cls else None
+            return init.key if init else None
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    base, meth = f.value, f.attr
+    if isinstance(base, ast.Name):
+        if base.id in ("self", "cls") and fn.cls is not None:
+            m = graph.find_method(fn.cls, meth)
+            if m is not None:
+                return m.key
+            info = graph.attr_info(fn.cls, meth)
+            return _info_call_target(graph, info)
+        if base.id in fn.local_types:
+            cls = graph.classes.get(fn.local_types[base.id])
+            m = graph.find_method(cls, meth) if cls else None
+            return m.key if m else None
+        dotted = mod.mod_alias.get(base.id)
+        if dotted:
+            tm = graph.modules.get(dotted)
+            if tm is not None:
+                if meth in tm.functions:
+                    return tm.functions[meth].key
+                if meth in tm.classes:
+                    init = graph.find_method(tm.classes[meth], "__init__")
+                    return init.key if init else None
+        return None
+    if (isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self" and fn.cls is not None):
+        # self.X.meth(...) through an attr-typed member
+        info = graph.attr_info(fn.cls, base.attr)
+        if info and info[0] == "cls":
+            cls = graph.classes.get(info[1])
+            m = graph.find_method(cls, meth) if cls else None
+            return m.key if m else None
+    return None
+
+
+def _info_call_target(graph, info):
+    """Call target for *calling* an attr: wrapped func or __call__."""
+    if info is None:
+        return None
+    kind, key = info
+    if kind == "wraps":
+        return key
+    cls = graph.classes.get(key)
+    m = graph.find_method(cls, "__call__") if cls else None
+    return m.key if m else None
+
+
+def _build_edges(graph):
+    for fn in graph.funcs.values():
+        _collect_local_types(graph, fn)
+    for cls in graph.classes.values():
+        for attr, call in cls.attr_jit.items():
+            # CachedOp attr: calling it dispatches CachedOp.__call__
+            name = _is_jit_ctor(call)
+            if name == "CachedOp":
+                got = _call_ctor_class(graph, cls.module, call)
+                if got:
+                    cls.attr_types.setdefault(attr, ("cls", got))
+    for fn in graph.funcs.values():
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                key = _resolve_call(graph, fn, node)
+                if key is not None and key != fn.key:
+                    fn.calls.append((key, node.lineno))
+
+
+# ---------------------------------------------------------------------------
+# sync-site collection (SYN)
+# ---------------------------------------------------------------------------
+
+def _collect_taint(fn, device_aliases):
+    """Names holding device values (linear, two rounds; no fixpoint)."""
+    tainted = set()
+
+    def expr_tainted(e):
+        if isinstance(e, ast.Call):
+            f = e.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in _SYNC_ALWAYS or f.attr in _SYNC_TAINTED:
+                    return False          # fetched: host value now
+                root = f.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in device_aliases:
+                    return True
+                return expr_tainted(f.value)
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in tainted
+        if isinstance(e, ast.BinOp):
+            return expr_tainted(e.left) or expr_tainted(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return expr_tainted(e.operand)
+        if isinstance(e, ast.Subscript):
+            return expr_tainted(e.value)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(expr_tainted(x) for x in e.elts)
+        if isinstance(e, ast.IfExp):
+            return expr_tainted(e.body) or expr_tainted(e.orelse)
+        return False
+
+    nodes = _own_nodes(fn)
+    for _round in (0, 1):
+        for node in nodes:
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+            elif isinstance(node, ast.AugAssign) \
+                    and expr_tainted(node.value) \
+                    and isinstance(node.target, ast.Name):
+                tainted.add(node.target.id)
+    return tainted, expr_tainted
+
+
+def _collect_sync_sites(fn, device_aliases, numpy_aliases):
+    tainted, expr_tainted = _collect_taint(fn, device_aliases)
+    mod = fn.module
+    sites = []
+
+    def reason_at(line):
+        m = _SYNC_OK_RE.search(mod.comments.get(line, ""))
+        if m:
+            return m.group(1).strip() or ""
+        return None
+
+    def add(node, kind, recv):
+        sites.append(_SyncSite(node.lineno, kind, recv,
+                               reason_at(node.lineno)))
+
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in _SYNC_ALWAYS:
+                    add(node, "." + f.attr, _unparse(f.value))
+                elif f.attr in _SYNC_TAINTED and expr_tainted(f.value):
+                    add(node, "." + f.attr, _unparse(f.value))
+                elif f.attr == "device_get" \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in device_aliases:
+                    add(node, "jax.device_get",
+                        _unparse(node.args[0]) if node.args else "")
+                elif f.attr in ("asarray", "array") \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in numpy_aliases \
+                        and f.value.id not in device_aliases \
+                        and any(expr_tainted(a) for a in node.args):
+                    add(node, "np.%s" % f.attr,
+                        _unparse(node.args[0]) if node.args else "")
+            elif isinstance(f, ast.Name) and f.id in ("float", "int",
+                                                      "bool") \
+                    and node.args and expr_tainted(node.args[0]):
+                add(node, "%s()" % f.id, _unparse(node.args[0]))
+        elif isinstance(node, (ast.If, ast.While)) \
+                and expr_tainted(node.test):
+            add(node.test, "__bool__", _unparse(node.test))
+    fn.sync_sites = sites
+
+
+# ---------------------------------------------------------------------------
+# graph construction
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", "analysis"}
+_CACHE = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def build_graph(root, package_dir=None):
+    """Parse the package and build the interprocedural model (cached on the
+    file set's (path, mtime, size) fingerprint)."""
+    package_dir = package_dir or os.path.join(root, "mxnet_tpu")
+    files = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        rel_dir = os.path.relpath(dirpath, package_dir)
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__"
+                             and not (rel_dir == "." and d in _SKIP_DIRS))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                files.append(os.path.join(dirpath, fn))
+    fp = tuple((f, os.path.getmtime(f), os.path.getsize(f)) for f in files)
+    with _CACHE_LOCK:
+        cached = _CACHE.get(os.path.abspath(package_dir))
+        if cached is not None and cached[0] == fp:
+            return cached[1]
+
+    graph = Graph()
+    graph.package = os.path.basename(os.path.abspath(package_dir))
+    pkg_rel_base = relpath(package_dir, root)
+    names = {}
+    packages = set()
+    for path in files:
+        rel = relpath(path, root)
+        sub = relpath(path, package_dir)
+        dotted = "%s.%s" % (graph.package, sub[:-3].replace("/", "."))
+        if dotted.endswith(".__init__"):
+            dotted = dotted[:-len(".__init__")]
+            packages.add(dotted)
+        names[path] = dotted
+    graph._packages = packages
+    for path in files:
+        with open(path) as f:
+            source = f.read()
+        _parse_module(graph, names[path], path, relpath(path, root), source)
+    _finish_graph(graph)
+    with _CACHE_LOCK:
+        _CACHE[os.path.abspath(package_dir)] = (fp, graph)
+    return graph
+
+
+def build_graph_from_source(source, path="<fixture>"):
+    """Single-module graph (fixtures / unit tests)."""
+    graph = Graph()
+    graph.package = "<single>"
+    graph._packages = set()
+    name = os.path.basename(path)
+    if name.endswith(".py"):
+        name = name[:-3]
+    _parse_module(graph, name, path, path.replace(os.sep, "/"), source)
+    _finish_graph(graph)
+    return graph
+
+
+def _finish_graph(graph):
+    _finish_symbols(graph)
+    _collect_attr_types(graph)
+    _build_edges(graph)
+    for mod in graph.modules.values():
+        dev = _device_aliases(mod)
+        np_al = _numpy_aliases(mod)
+        for fn in mod.func_order:
+            _collect_sync_sites(fn, dev, np_al)
+
+
+# ---------------------------------------------------------------------------
+# reachability
+# ---------------------------------------------------------------------------
+
+def _reachable(graph):
+    """-> (order, parent) BFS from hot roots, cut at ``cold`` functions."""
+    roots = [f for f in graph.funcs.values() if f.hot and not f.cold]
+    parent = {f.key: None for f in roots}
+    queue = list(roots)
+    order = []
+    while queue:
+        fn = queue.pop(0)
+        order.append(fn)
+        for callee_key, _line in fn.calls:
+            callee = graph.funcs.get(callee_key)
+            if callee is None or callee.cold or callee.key in parent:
+                continue
+            parent[callee.key] = fn.key
+            queue.append(callee)
+    return order, parent
+
+
+def _chain(graph, parent, key):
+    quals = []
+    while key is not None:
+        quals.append(graph.funcs[key].qual)
+        key = parent.get(key)
+    return " -> ".join(reversed(quals))
+
+
+# ---------------------------------------------------------------------------
+# SYN pass
+# ---------------------------------------------------------------------------
+
+def _sync_findings(graph):
+    findings = []
+    order, parent = _reachable(graph)
+    seen = set()
+    for fn in order:
+        chain = _chain(graph, parent, fn.key)
+        for site in fn.sync_sites:
+            if site.reason is not None:
+                continue
+            detail = "%s@%s" % (site.kind, site.recv[:60])
+            dedup = (fn.key, detail)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            findings.append(Finding(
+                "SYN001" if site.kind.startswith((".", "jax."))
+                else "SYN002",
+                fn.path, site.line, fn.qual,
+                "implicit device->host sync `%s` on the hot path "
+                "[chain: %s]; delete it or tag the line with a "
+                "sync-ok(<reason>) mxflow comment" % (site.kind, chain),
+                detail=detail))
+    findings.extend(_tag_hygiene(graph))
+    return findings
+
+
+def _tag_hygiene(graph):
+    """SYN003: malformed or stale sync-ok tags (the catalog cannot rot)."""
+    findings = []
+    for mod in graph.modules.values():
+        tagged_lines = {}
+        for fn in mod.func_order:
+            for site in fn.sync_sites:
+                if site.reason is not None:
+                    tagged_lines.setdefault(site.line, []).append(site)
+        for line, comment in sorted(mod.comments.items()):
+            m_any = _SYNC_OK_ANY_RE.search(comment)
+            if not m_any:
+                continue
+            m = _SYNC_OK_RE.search(comment)
+            if m is None or not m.group(1).strip():
+                findings.append(Finding(
+                    "SYN003", mod.path, line, "<module>",
+                    "malformed sync-ok tag: a non-empty justification is "
+                    "required, e.g. sync-ok(ttft token fetch)",
+                    detail="malformed@L"))
+            elif line not in tagged_lines:
+                findings.append(Finding(
+                    "SYN003", mod.path, line, "<module>",
+                    "stale sync-ok tag: no sync primitive on this line "
+                    "(remove the tag, or it hides nothing)",
+                    detail="stale@%s" % m.group(1).strip()[:40]))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# RCP pass
+# ---------------------------------------------------------------------------
+
+def _jit_callee_info(graph, fn, call):
+    """If ``call`` invokes a known jit/CachedOp binding, return its ctor
+    Call node (for static-arg metadata); else None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in fn.local_jit:
+            return fn.local_jit[f.id]
+        if f.id in fn.module.module_jit:
+            return fn.module.module_jit[f.id]
+        got = graph.resolve_symbol(fn.module, f.id)
+        if got and got[0] == "func":
+            callee = graph.funcs.get(got[1])
+            if callee is not None and _jit_decorated(callee):
+                return _jit_decorator_node(callee)
+    elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id in ("self", "cls") and fn.cls is not None:
+            node = graph.attr_jit_node(fn.cls, f.attr)
+            if node is not None:
+                return node
+    return None
+
+
+def _jit_decorated(fn):
+    return any(_is_jit_ctor(d) if isinstance(d, ast.Call)
+               else _dec_name(d) == "jit"
+               for d in fn.node.decorator_list)
+
+
+def _jit_decorator_node(fn):
+    for d in fn.node.decorator_list:
+        if isinstance(d, ast.Call) and _is_jit_ctor(d):
+            return d
+    return ast.Call(func=ast.Name(id="jit", ctx=ast.Load()), args=[],
+                    keywords=[])
+
+
+def _static_positions(ctor):
+    """(set of static positions, set of static names) from a jit ctor."""
+    nums, names = set(), set()
+    for kw in ctor.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return nums, names
+
+
+def _assign_map(fn):
+    """Local single-assignment map (multi-assigned names are dropped)."""
+    out, dead = {}, set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            nm = node.targets[0].id
+            if nm in out or nm in dead:
+                out.pop(nm, None)
+                dead.add(nm)
+            else:
+                out[nm] = node.value
+        elif isinstance(node, (ast.AugAssign, ast.For)) :
+            tgt = getattr(node, "target", None)
+            if isinstance(tgt, ast.Name):
+                out.pop(tgt.id, None)
+                dead.add(tgt.id)
+    return out
+
+
+def _contains_call_named(expr, names):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            f = n.func
+            attr = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if attr in names:
+                return True
+    return False
+
+
+def _contains_len_or_shape(expr):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            return True
+    return False
+
+
+def _shape_hazard(expr, assigns, depth=0):
+    """Why ``expr`` makes the traced-argument signature vary per call, or
+    None.  The sanctioned off-ramp is a ``.bucket(...)`` ladder hop."""
+    if depth > 3:
+        return None
+    if isinstance(expr, ast.Name):
+        rhs = assigns.get(expr.id)
+        if rhs is not None:
+            return _shape_hazard(rhs, assigns, depth + 1)
+        return None
+    if isinstance(expr, ast.Subscript) \
+            and isinstance(expr.slice, ast.Slice):
+        for bound in (expr.slice.lower, expr.slice.upper):
+            if bound is None or isinstance(bound, ast.Constant):
+                continue
+            if _contains_call_named(bound, {"bucket"}):
+                continue
+            why = "slice bound `%s` varies per call" % _unparse(bound)
+            resolved = _shape_hazard(bound, assigns, depth + 1)
+            return resolved or why
+        return _shape_hazard(expr.value, assigns, depth + 1)
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        attr = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else None)
+        if attr in _SHAPE_CTORS and expr.args:
+            shape = expr.args[0]
+            dims = shape.elts if isinstance(shape, (ast.Tuple, ast.List)) \
+                else [shape]
+            for dim in dims:
+                why = _dim_hazard(dim, assigns, depth)
+                if why:
+                    return why
+            return None
+        # generic wrapper (nd.array(host[:n]), device_put(...)): the
+        # hazard rides inside the argument
+        for a in expr.args:
+            why = _shape_hazard(a, assigns, depth + 1)
+            if why:
+                return why
+        return None
+    if isinstance(expr, ast.BinOp):
+        return (_shape_hazard(expr.left, assigns, depth + 1)
+                or _shape_hazard(expr.right, assigns, depth + 1))
+    return None
+
+
+def _dim_hazard(dim, assigns, depth):
+    if isinstance(dim, ast.Constant):
+        return None
+    if isinstance(dim, ast.Name):
+        rhs = assigns.get(dim.id)
+        if rhs is None:
+            return None
+        dim = rhs
+        if depth > 3:
+            return None
+    if _contains_call_named(dim, {"bucket"}):
+        return None
+    if _contains_len_or_shape(dim):
+        return ("shape dim `%s` derives from a per-call length without a "
+                "bucket ladder hop" % _unparse(dim)[:60])
+    return None
+
+
+_NONHASHABLE = (ast.List, ast.Dict, ast.Set, ast.Lambda, ast.GeneratorExp)
+
+
+def _rcp_findings(graph):
+    findings = []
+    order, parent = _reachable(graph)
+    hot_keys = {f.key for f in order}
+
+    for fn in graph.funcs.values():
+        assigns = _assign_map(fn)
+        chain = _chain(graph, parent, fn.key) if fn.key in hot_keys \
+            else "(not hot-reachable)"
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor_kind = _is_jit_ctor(node)
+            if ctor_kind:
+                findings.extend(_rcp_ctor(graph, fn, node, ctor_kind,
+                                          hot_keys, chain))
+                continue
+            ctor = _jit_callee_info(graph, fn, node)
+            if ctor is None:
+                continue
+            nums, names = _static_positions(ctor)
+            for i, arg in enumerate(node.args):
+                if i in nums:
+                    if isinstance(arg, _NONHASHABLE):
+                        findings.append(Finding(
+                            "RCP003", fn.path, node.lineno, fn.qual,
+                            "non-hashable/fresh value `%s` at static arg "
+                            "position %d retraces on every call [chain: "
+                            "%s]" % (_unparse(arg)[:40], i, chain),
+                            detail="static@%d" % i))
+                    continue
+                why = _shape_hazard(arg, assigns)
+                if why:
+                    findings.append(Finding(
+                        "RCP001", fn.path, node.lineno, fn.qual,
+                        "stealth recompile: %s at compile boundary `%s` "
+                        "[chain: %s]" % (why, _unparse(node.func), chain),
+                        detail="shape@%d:%s" % (i, _unparse(node.func))))
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, _NONHASHABLE):
+                    findings.append(Finding(
+                        "RCP003", fn.path, node.lineno, fn.qual,
+                        "non-hashable/fresh value for static arg `%s` "
+                        "retraces on every call [chain: %s]"
+                        % (kw.arg, chain), detail="static@%s" % kw.arg))
+    findings.extend(_rcp_mutable_capture(graph))
+    return findings
+
+
+def _enclosing_loop(fn, node):
+    for outer in _own_nodes(fn):
+        if isinstance(outer, (ast.For, ast.While)):
+            for sub in ast.walk(outer):
+                if sub is node:
+                    return outer
+    return None
+
+
+def _ctor_sanctioned(fn, node):
+    """A jit ctor is cached iff its value lands somewhere that outlives the
+    call: module level, ``self``/global storage, a return, or a local that
+    is later stored/returned (the lazy-init idiom)."""
+    for stmt in _own_nodes(fn):
+        if isinstance(stmt, ast.Return) and stmt.value is node:
+            return True
+        if isinstance(stmt, ast.Assign) and stmt.value is node:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                return True                    # self.X = jit / cache[k] = jit
+            if isinstance(tgt, ast.Name):
+                local = tgt.id
+                for later in _own_nodes(fn):
+                    if isinstance(later, ast.Return) \
+                            and isinstance(later.value, ast.Name) \
+                            and later.value.id == local:
+                        return True
+                    if isinstance(later, ast.Assign) \
+                            and isinstance(later.value, ast.Name) \
+                            and later.value.id == local \
+                            and isinstance(later.targets[0],
+                                           (ast.Attribute, ast.Subscript)):
+                        return True
+    return fn.name == "__init__"
+
+
+def _rcp_ctor(graph, fn, node, kind, hot_keys, chain):
+    # decorator positions are handled via _jit_decorated; a ctor appearing
+    # in a decorator list is not in _own_nodes, so anything here is a body
+    # construction site.
+    out = []
+    label = "jax.jit" if kind == "jit" else "CachedOp"
+    # immediate invocation: jax.jit(f)(x) — compiled, used once, dropped
+    parent_call = next((n for n in _own_nodes(fn)
+                        if isinstance(n, ast.Call) and n.func is node), None)
+    if parent_call is not None:
+        out.append(Finding(
+            "RCP002", fn.path, node.lineno, fn.qual,
+            "fresh %s object invoked immediately: the compile cache dies "
+            "with the expression [chain: %s]" % (label, chain),
+            detail="immediate:%s" % label))
+        return out
+    if _enclosing_loop(fn, node) is not None:
+        out.append(Finding(
+            "RCP002", fn.path, node.lineno, fn.qual,
+            "%s constructed inside a loop: every iteration recompiles "
+            "[chain: %s]" % (label, chain), detail="loop:%s" % label))
+        return out
+    if fn.key in hot_keys and not _ctor_sanctioned(fn, node):
+        out.append(Finding(
+            "RCP002", fn.path, node.lineno, fn.qual,
+            "%s constructed on the hot path without caching (store it on "
+            "self/module or return it from a factory) [chain: %s]"
+            % (label, chain), detail="uncached:%s" % label))
+    return out
+
+
+def _rcp_mutable_capture(graph):
+    """RCP004: jit-compiled closure reads ``self.X`` that some method other
+    than __init__ mutates — baked-in-at-trace state goes stale silently."""
+    findings = []
+    for cls in graph.classes.values():
+        if not cls.mutated_attrs:
+            continue
+        jit_nodes = []
+        for meth in cls.methods.values():
+            if _jit_decorated(meth):
+                jit_nodes.append((meth, meth.node))
+            for node in _own_nodes(meth):
+                if isinstance(node, ast.Call) and _is_jit_ctor(node):
+                    for arg in node.args:
+                        target = None
+                        if isinstance(arg, ast.Lambda):
+                            target = arg
+                        elif isinstance(arg, ast.Name):
+                            local_def = next(
+                                (n for n in ast.walk(meth.node)
+                                 if isinstance(n, ast.FunctionDef)
+                                 and n.name == arg.id), None)
+                            target = local_def
+                        if target is not None:
+                            jit_nodes.append((meth, target))
+        for meth, body in jit_nodes:
+            for node in ast.walk(body):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.attr in cls.mutated_attrs:
+                    findings.append(Finding(
+                        "RCP004", meth.path, node.lineno, meth.qual,
+                        "jit-compiled closure captures mutable `self.%s` "
+                        "(assigned outside __init__): the traced value is "
+                        "frozen at compile time" % node.attr,
+                        detail="capture:%s" % node.attr))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# RES pass
+# ---------------------------------------------------------------------------
+
+class _LinearEvent(object):
+    __slots__ = ("idx", "node", "in_finally", "in_handler", "with_ctx")
+
+    def __init__(self, idx, node, in_finally, in_handler, with_ctx):
+        self.idx = idx
+        self.node = node
+        self.in_finally = in_finally
+        self.in_handler = in_handler   # inside a broad except handler
+        self.with_ctx = with_ctx
+
+
+def _broad_handler(handler):
+    """except: / except BaseException / except Exception — catches the
+    exception edge, so a release inside it covers that edge."""
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("BaseException",
+                                                "Exception"):
+            return True
+    return False
+
+
+def _linearize(fn):
+    """Pre-order walk of ``fn``'s own nodes with finally/handler/with
+    context flags."""
+    events = []
+    counter = [0]
+
+    def visit(node, in_finally, in_handler, with_ctx):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            counter[0] += 1
+            events.append(_LinearEvent(counter[0], child, in_finally,
+                                       in_handler, with_ctx))
+            if isinstance(child, ast.Try):
+                for sub in child.body + child.orelse:
+                    counter[0] += 1
+                    events.append(_LinearEvent(counter[0], sub, in_finally,
+                                               in_handler, with_ctx))
+                    visit(sub, in_finally, in_handler, with_ctx)
+                for h in child.handlers:
+                    broad = in_handler or _broad_handler(h)
+                    counter[0] += 1
+                    events.append(_LinearEvent(counter[0], h, in_finally,
+                                               broad, with_ctx))
+                    visit(h, in_finally, broad, with_ctx)
+                for sub in child.finalbody:
+                    counter[0] += 1
+                    events.append(_LinearEvent(counter[0], sub, True,
+                                               in_handler, with_ctx))
+                    visit(sub, True, in_handler, with_ctx)
+            elif isinstance(child, ast.With):
+                ctxs = [_unparse(item.context_expr)
+                        for item in child.items]
+                visit(child, in_finally, in_handler, with_ctx + ctxs)
+            else:
+                visit(child, in_finally, in_handler, with_ctx)
+    visit(fn.node, False, False, [])
+    return events
+
+
+def _method_call(node):
+    """(receiver text, method) for ``recv.meth(...)`` Call nodes."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return _unparse(node.func.value), node.func.attr
+    return None, None
+
+
+def _failure_branch(fn, acq_node):
+    """The If whose *test* contains the acquire call (``if not reserve``):
+    raises in its body are the failure path, not a leak."""
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.If):
+            for sub in ast.walk(node.test):
+                if sub is acq_node:
+                    return node
+    return None
+
+
+def _value_captured(fn, acq_node):
+    """Acquire result assigned or returned => ownership transfer (the
+    lease-generation idiom: fencing bumps are deliberately not rolled
+    back)."""
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if sub is acq_node:
+                    return True
+        if isinstance(node, ast.Assign):
+            for sub in ast.walk(node.value):
+                if sub is acq_node:
+                    return True
+    return False
+
+
+def _res_findings(graph):
+    findings = []
+    for fn in graph.funcs.values():
+        findings.extend(_res_function(fn))
+    return findings
+
+
+def _res_function(fn):
+    out = []
+    events = _linearize(fn)
+    calls = []        # (event, recv, meth)
+    raises = []       # events
+    ctors = {}        # local var -> (event, ctor name)
+    for ev in events:
+        node = ev.node
+        if isinstance(node, ast.Raise):
+            raises.append(ev)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            f = node.value.func
+            cname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if cname in _CLOSEABLE_CTORS:
+                ctors[node.targets[0].id] = (ev, cname)
+        if isinstance(node, ast.Call):
+            recv, meth = _method_call(node)
+            if meth is not None:
+                calls.append((ev, recv, meth))
+
+    def rel_events(recv, meths):
+        return [(ev, m) for ev, r, m in calls if r == recv and m in meths]
+
+    # -- locks: RES001 (not exception-safe) / RES002 (never released) ---
+    for ev, recv, meth in calls:
+        if meth != _LOCK_ACQUIRE:
+            continue
+        rels = rel_events(recv, {_LOCK_RELEASE})
+        if not rels:
+            out.append(Finding(
+                "RES002", fn.path, ev.node.lineno, fn.qual,
+                "`%s.acquire()` with no matching release in this function "
+                "— the lock leaks on every path" % recv,
+                detail="norelease@%s" % recv))
+            continue
+        safe = (any(rev.in_finally for rev, _m in rels)
+                or (any(rev.in_handler for rev, _m in rels)
+                    and any(not rev.in_handler and not rev.in_finally
+                            for rev, _m in rels)))
+        if not safe:
+            first_rel = min(rev.idx for rev, _m in rels)
+            risky = any(isinstance(e.node, ast.Call)
+                        and e.node is not ev.node
+                        and ev.idx < e.idx < first_rel
+                        for e in events)
+            if risky:
+                out.append(Finding(
+                    "RES001", fn.path, ev.node.lineno, fn.qual,
+                    "`%s.acquire()` released outside any finally while "
+                    "calls in between can raise — use `with %s:` or "
+                    "try/finally" % (recv, recv),
+                    detail="unsafe@%s" % recv))
+
+    # -- paired resources: RES004 (raise leaks the acquisition) ---------
+    for ev, recv, meth in calls:
+        pair = next((p for p in _RAISE_PAIRS if p[0] == meth), None)
+        if pair is None:
+            continue
+        if pair[1] is not None and not re.search(pair[1], recv, re.I):
+            continue
+        if _value_captured(fn, ev.node):
+            continue
+        fail_if = _failure_branch(fn, ev.node)
+        rels = rel_events(recv, set(pair[2]))
+        for rev in raises:
+            if rev.idx <= ev.idx:
+                continue
+            if fail_if is not None and any(
+                    s is rev.node for s in ast.walk(fail_if)):
+                continue
+            released_before = any(ev.idx < r.idx < rev.idx
+                                  for r, _m in rels)
+            if not released_before:
+                out.append(Finding(
+                    "RES004", fn.path, rev.node.lineno, fn.qual,
+                    "raise after `%s.%s(...)` without releasing it — the "
+                    "%s leaks on this exception edge"
+                    % (recv, meth, "reservation" if meth == "reserve"
+                       else "registration"),
+                    detail="leak@%s.%s" % (recv, meth)))
+                break   # one finding per acquisition
+
+    # -- closeables: RES003 --------------------------------------------
+    for var, (ev, cname) in ctors.items():
+        closes = [(e, r, m) for e, r, m in calls
+                  if r == var and m in _CLOSE_METHODS]
+        in_with = any(var == _unparse(item.optional_vars)
+                      for e2 in events if isinstance(e2.node, ast.With)
+                      for item in e2.node.items if item.optional_vars)
+        if in_with:
+            continue
+        escapes = _name_escapes(fn, var, ev.node)
+        if not closes:
+            if not escapes:
+                out.append(Finding(
+                    "RES003", fn.path, ev.node.lineno, fn.qual,
+                    "`%s = %s(...)` is never closed in this function and "
+                    "never escapes it — the worker/handle leaks"
+                    % (var, cname), detail="leak@%s" % var))
+            continue
+        safe = (any(e.in_finally for e, _r, _m in closes)
+                or (any(e.in_handler for e, _r, _m in closes)
+                    and any(not e.in_handler and not e.in_finally
+                            for e, _r, _m in closes)))
+        if not safe:
+            first_close = min(e.idx for e, _r, _m in closes)
+            risky = any(isinstance(e.node, ast.Call)
+                        and e.node is not ev.node.value
+                        and ev.idx < e.idx < first_close
+                        and not (_method_call(e.node)[0] == var
+                                 and _method_call(e.node)[1]
+                                 in _CLOSE_METHODS)
+                        for e in events)
+            if risky:
+                out.append(Finding(
+                    "RES003", fn.path, ev.node.lineno, fn.qual,
+                    "`%s = %s(...)` closed outside any finally while calls "
+                    "in between can raise — use `with` or try/finally"
+                    % (var, cname), detail="unsafe@%s" % var))
+
+    # -- RES005: double release on sibling statements -------------------
+    body_lists = [fn.node.body] + [
+        n.body for n in _own_nodes(fn) if hasattr(n, "body")
+        and isinstance(n, (ast.If, ast.With, ast.For, ast.While, ast.Try))]
+    for stmts in body_lists:
+        seen = {}
+        for stmt in stmts:
+            if not (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            recv, meth = _method_call(stmt.value)
+            if meth in _RELEASE_METHODS or meth in _CLOSE_METHODS:
+                sig = (recv, meth)
+                if sig in seen:
+                    out.append(Finding(
+                        "RES005", fn.path, stmt.value.lineno, fn.qual,
+                        "`%s.%s()` called twice on sibling statements — "
+                        "the second release corrupts the pool/lock state"
+                        % (recv, meth), detail="double@%s.%s" % sig))
+                seen[sig] = stmt
+    return out
+
+
+def _name_escapes(fn, var, ctor_stmt):
+    """``var`` returned, stored, or passed to another call => ownership
+    moves and this function need not close it."""
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name) \
+                and node.value.id == var:
+            return True
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == var \
+                and isinstance(node.targets[0], (ast.Attribute,
+                                                 ast.Subscript)):
+            return True
+        if isinstance(node, ast.Call):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == var:
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _postprocess(graph, findings):
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out = []
+    for path, fs in by_path.items():
+        mod = next((m for m in graph.modules.values() if m.path == path),
+                   None)
+        if mod is not None:
+            fs = apply_line_suppressions(fs, mod.lines)
+        out.extend(fs)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def run_sync(root, package_dir=None):
+    graph = build_graph(root, package_dir)
+    return _postprocess(graph, _sync_findings(graph))
+
+
+def run_rcp(root, package_dir=None):
+    graph = build_graph(root, package_dir)
+    return _postprocess(graph, _rcp_findings(graph))
+
+
+def run_res(root, package_dir=None):
+    graph = build_graph(root, package_dir)
+    return _postprocess(graph, _res_findings(graph))
+
+
+_FAMILY_RUNNERS = {"sync": _sync_findings, "rcp": _rcp_findings,
+                   "res": _res_findings}
+
+
+def analyze_source(source, path="<fixture>", families=("sync", "rcp",
+                                                       "res")):
+    """Lint one python source string (fixture/unit-test entry point)."""
+    graph = build_graph_from_source(source, path)
+    findings = []
+    for fam in families:
+        findings.extend(_FAMILY_RUNNERS[fam](graph))
+    return _postprocess(graph, findings)
+
+
+# ---------------------------------------------------------------------------
+# SYNC_MAP generation
+# ---------------------------------------------------------------------------
+
+def sync_map_entries(root, package_dir=None):
+    """Every sync-ok-tagged site, with its hot chain when one reaches it."""
+    graph = build_graph(root, package_dir)
+    order, parent = _reachable(graph)
+    hot_chain = {f.key: _chain(graph, parent, f.key) for f in order}
+    entries = []
+    for mod in sorted(graph.modules.values(), key=lambda m: m.path):
+        for fn in mod.func_order:
+            for site in fn.sync_sites:
+                if site.reason is None:
+                    continue
+                entries.append({
+                    "path": fn.path, "line": site.line, "scope": fn.qual,
+                    "kind": site.kind, "recv": site.recv,
+                    "reason": site.reason,
+                    "chain": hot_chain.get(fn.key),
+                })
+    entries.sort(key=lambda e: (e["path"], e["line"]))
+    return entries
+
+
+def render_sync_map(entries):
+    lines = [
+        "# SYNC_MAP — intentional device->host synchronization points",
+        "",
+        "Machine-generated by `python tools/mxlint.py --sync-map`; do not",
+        "edit by hand (tests/test_mxflow.py compares this file against a",
+        "fresh render).  Every entry is a site the SYN pass would flag,",
+        "sanctioned by an inline justification tag.  This catalog is the",
+        "work-list for ROADMAP item 4: the trace-first runtime refactor",
+        "deletes entries here until only protocol-mandated fetches (token",
+        "streaming, metric boundaries, serving responses) remain.  See",
+        "docs/LINT.md for the tag vocabulary and docs/PERF.md for the",
+        "per-op eager tax these sites pay.",
+        "",
+    ]
+    cur = None
+    for e in entries:
+        if e["path"] != cur:
+            if cur is not None:
+                lines.append("")
+            cur = e["path"]
+            lines.append("## %s" % cur)
+            lines.append("")
+        chain = ("hot via `%s`" % e["chain"]) if e["chain"] \
+            else "off the hot path"
+        lines.append("- L%d `%s` — `%s` on `%s` — %s — **%s**"
+                     % (e["line"], e["scope"], e["kind"], e["recv"],
+                        chain, e["reason"]))
+    lines.append("")
+    lines.append("%d sanctioned sync point(s)." % len(entries))
+    lines.append("")
+    return "\n".join(lines)

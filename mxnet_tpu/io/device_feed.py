@@ -145,9 +145,9 @@ def stage_batch(item, ctx=None, mesh=None):
             for o in obj.values():
                 sync(o)
         elif isinstance(obj, NDArray):
-            obj.wait_to_read()
+            obj.wait_to_read()  # mxflow: sync-ok(staging contract: stage_batch returns only after the transfer lands)
         elif hasattr(obj, "block_until_ready"):
-            obj.block_until_ready()
+            obj.block_until_ready()  # mxflow: sync-ok(staging contract: stage_batch returns only after the transfer lands)
     sync(staged)
     return staged
 
@@ -194,7 +194,7 @@ class _FeedState:
 _stage_with_retry = _util.retry(attempts=3, backoff=0.002)(stage_batch)
 
 
-def _feed_worker(state):
+def _feed_worker(state):  # mxflow: hot (device feed staging worker)
     try:
         it = iter(state.source)
         while not state.stop.is_set():

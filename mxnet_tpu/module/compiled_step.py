@@ -448,7 +448,7 @@ class CompiledTrainStep:
             opt._index_update_count[index] = count
             opt.num_update = max(count, opt.num_update)
 
-    def run_window(self, batches_io):
+    def run_window(self, batches_io):  # mxflow: hot (compiled train step)
         """Train on a window of 1..steps_per_call batches in ONE dispatch.
 
         ``batches_io``: one tuple of input NDArrays per batch, in the
@@ -496,8 +496,8 @@ class CompiledTrainStep:
         compiled path performs — so call it at metric_interval boundaries
         or epoch end, never per batch."""
         for m, (skey, ckey) in zip(self._metrics, self._metric_keys):
-            stat = float(_np.asarray(self.state[skey].asnumpy()))
-            count = float(_np.asarray(self.state[ckey].asnumpy()))
+            stat = float(_np.asarray(self.state[skey].asnumpy()))  # mxflow: sync-ok(metric boundary: the one sanctioned fetch of the compiled path)
+            count = float(_np.asarray(self.state[ckey].asnumpy()))  # mxflow: sync-ok(metric boundary: the one sanctioned fetch of the compiled path)
             if stat or count:
                 m._device_accumulate(stat, count)
             with autograd.pause():

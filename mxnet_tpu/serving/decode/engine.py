@@ -545,7 +545,7 @@ class DecodeEngine:
             if not already_closed:
                 raise
 
-    def _run_loop(self):
+    def _run_loop(self):  # mxflow: hot (decode prefill/step loop)
         k_pool, v_pool = self._cache.init_pools()
         while True:
             with self._cond:
@@ -692,7 +692,7 @@ class DecodeEngine:
             self._vacate(seq, ERROR, error=repr(exc))
             return k_pool, v_pool
         self.breaker.on_success()
-        logits = outs[0].asnumpy()[0]
+        logits = outs[0].asnumpy()[0]  # mxflow: sync-ok(ttft token fetch: the first sampled token must reach the host to stream it)
         token = int(np.argmax(logits))
         seq.position = len(prompt)
         seq.cur_token = token
@@ -759,7 +759,7 @@ class DecodeEngine:
             self._fail_all(exc)
             return k_pool, v_pool
         self.breaker.on_success()
-        logits = outs[0].asnumpy()
+        logits = outs[0].asnumpy()  # mxflow: sync-ok(per-step token fetch: sampled ids must reach the host to stream)
         emitted = 0
         for i, seq in enumerate(slots):
             if seq is None:
@@ -905,8 +905,8 @@ class DecodeEngine:
                 "position": int(seq.position),
                 "cur_token": int(seq.cur_token),
                 "generated": int(seq.generated),
-                "k": k_pool.asnumpy()[:, idx].copy(),
-                "v": v_pool.asnumpy()[:, idx].copy(),
+                "k": k_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(quiesced drain: K pages leave the device once per handoff)
+                "v": v_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(quiesced drain: V pages leave the device once per handoff)
             }
         else:
             # still queued (or joined but not yet prefilled): no device
@@ -1031,7 +1031,7 @@ class DecodeEngine:
         outs = self._prefill_exec(toks, np.asarray([len(prompt)], np.int32),
                                   table, k_pool, v_pool)
         k_pool, v_pool = outs[1], outs[2]
-        token = int(np.argmax(outs[0].asnumpy()[0]))
+        token = int(np.argmax(outs[0].asnumpy()[0]))  # mxflow: sync-ok(reference path: single-stream oracle, correctness over speed)
         out_tokens = [token]
         position = len(prompt)
         eos = getattr(self.model, "eos_id", None)
@@ -1048,7 +1048,7 @@ class DecodeEngine:
             outs = self._decode_exec(tokens, positions, tables, k_pool,
                                      v_pool)
             k_pool, v_pool = outs[1], outs[2]
-            token = int(np.argmax(outs[0].asnumpy()[0]))
+            token = int(np.argmax(outs[0].asnumpy()[0]))  # mxflow: sync-ok(reference path: single-stream oracle, correctness over speed)
             out_tokens.append(token)
             position += 1
         return np.asarray(out_tokens, np.int32)

@@ -594,6 +594,7 @@ class FleetRouter:
             raise MXNetError("no engine %r on replica %s" % (name, rid))
         return eng
 
+    # mxflow: hot (stream routing path)
     def submit_stream(self, name, prompt, max_new_tokens=None,
                       timeout_ms=None, tenant=None, on_token=None):
         """Admit one generation stream into the fleet; always returns a

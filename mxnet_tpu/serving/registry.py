@@ -113,7 +113,7 @@ class ServableModel:
         with autograd.pause():
             out = self._cop(self._params, *inputs)
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
-        return [o.asnumpy() for o in outs]
+        return [o.asnumpy() for o in outs]  # mxflow: sync-ok(serving boundary: predict results materialize for the response)
 
     def warmup(self):
         """Precompile every (shape variant, ladder rung) signature.

@@ -464,6 +464,6 @@ class ModelServer:
         out = []
         for x, dt in zip(items, model.dtypes):
             if isinstance(x, NDArray):
-                x = x.asnumpy()
+                x = x.asnumpy()  # mxflow: sync-ok(request admission: device handles coerce to host rows once)
             out.append(np.asarray(x, dtype=dt))
         return tuple(out)

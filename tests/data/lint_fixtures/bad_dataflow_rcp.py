@@ -1,0 +1,36 @@
+"""Known-bad fixture for the mxflow RCP pass; line numbers are asserted in
+tests/test_mxflow.py — keep edits line-stable or update the test."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Stepper:
+    def __init__(self):
+        self.scale = 1.0
+        self._op = jax.jit(lambda x: x * 2)
+
+    def set_scale(self, s):
+        self.scale = s              # mutated outside __init__ (-> RCP004)
+
+    def run(self, xs):  # mxflow: hot
+        for x in xs:
+            f = jax.jit(lambda v: v + 1)    # RCP002: jit built in a loop
+            x = f(x)
+        y = jax.jit(lambda v: v * 3)(x)     # RCP002: immediate invocation
+        g = jax.jit(lambda v: v - 1)        # RCP002: uncached on hot path
+        return g(y)
+
+    def feed(self, prompt):
+        toks = np.zeros((1, len(prompt)), np.int32)
+        return self._op(jnp.asarray(toks))  # RCP001: unbucketed shape
+
+    def jitted_scale(self):
+        return jax.jit(lambda x: x * self.scale)    # RCP004: mutable capture
+
+
+_STATIC = jax.jit(lambda mode, x: x, static_argnums=(0,))
+
+
+def call_static(x):
+    return _STATIC([1, 2], x)               # RCP003: non-hashable static arg

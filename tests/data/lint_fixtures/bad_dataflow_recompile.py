@@ -1,0 +1,20 @@
+"""Planted stealth-recompile fixture: ``drive`` feeds a per-call-varying
+slice into a CachedOp.  The RCP pass must flag it statically, and
+``CachedOp.cache_stats()`` must show one recompile per distinct length
+dynamically — tests/test_mxflow.py cross-checks that both detectors agree
+on this one ground truth.  Line numbers are asserted there."""
+import numpy as np
+
+from mxnet_tpu.cached_op import CachedOp
+from mxnet_tpu import ndarray as nd
+
+
+def drive(lengths):  # mxflow: hot
+    cop = CachedOp(lambda params, x: x * 2.0, {})   # RCP002: fresh per call
+    host = np.arange(32).astype(np.float32)
+    out = None
+    for n in lengths:
+        x = nd.array(host[:n])
+        out = cop({}, x)                # RCP001: per-call length -> recompile
+    assert out is not None
+    return cop.cache_stats()

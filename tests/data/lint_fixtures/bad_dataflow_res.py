@@ -1,0 +1,54 @@
+"""Known-bad fixture for the mxflow RES pass; line numbers are asserted in
+tests/test_mxflow.py — keep edits line-stable or update the test."""
+import threading
+
+_LOCK = threading.Lock()
+
+
+def leak_lock(q):
+    _LOCK.acquire()                 # RES002: never released
+    return q.get()
+
+
+def unsafe_lock(q):
+    _LOCK.acquire()                 # RES001: release not exception-safe
+    item = q.get()
+    _LOCK.release()
+    return item
+
+
+def leak_reservation(cache, sid, need):
+    if not cache.reserve(sid, need):
+        raise RuntimeError("no headroom")       # failure branch: not a leak
+    if need > 8:
+        raise RuntimeError("too big")           # RES004: reservation leaks
+    return sid
+
+
+class Membership:
+    def __init__(self, leases):
+        self._leases = leases
+
+    def join(self, rid, ok):
+        self._leases.register(rid)
+        if not ok:
+            raise RuntimeError("rejected")      # RES004: registration leaks
+        return rid
+
+
+def leak_feed(make_iter):
+    feed = DeviceFeed(make_iter)    # RES003: never closed, never escapes
+    return 1
+
+
+def unsafe_close(path):
+    f = open(path, "rb")            # RES003: close not exception-safe
+    data = f.read()
+    f.close()
+    return data
+
+
+def double_free(cache, sid):
+    cache.free_seq(sid)
+    cache.free_seq(sid)             # RES005: double release
+    return True

@@ -1,0 +1,47 @@
+"""Known-bad fixture for the mxflow SYN pass; line numbers are asserted in
+tests/test_mxflow.py — keep edits line-stable or update the test."""
+import numpy as np
+import jax.numpy as jnp
+
+
+def retry(fn):
+    return fn
+
+
+class Telemetry:
+    def snapshot(self, arr):
+        return arr.asnumpy()        # SYN001 via Worker.loop -> flush -> here
+
+
+class Worker:
+    def __init__(self):
+        self.stats = Telemetry()
+        self._fetch = retry(self._fetch_once)
+
+    def loop(self):  # mxflow: hot
+        x = jnp.zeros((4,))
+        self._fetch(x)
+        self.flush(x)
+        s = jnp.sum(x)
+        n = s.item()                # SYN001: .item on a device value
+        if x:                       # SYN002: __bool__ coercion syncs
+            n += 1
+        return float(x)             # SYN002: float() coercion syncs
+
+    def flush(self, arr):
+        return self.stats.snapshot(arr)
+
+    def _fetch_once(self, arr):
+        y = jnp.exp(arr)
+        return np.asarray(y)        # SYN002: np.asarray on a device value
+
+
+def tagged(arr):
+    return arr.asnumpy()  # mxflow: sync-ok()
+
+# the empty justification above is SYN003 (malformed); the tag below sits
+# on a line with no sync primitive, which is SYN003 (stale)
+
+
+def stale():
+    return 1 + 1  # mxflow: sync-ok(no sync on this line)

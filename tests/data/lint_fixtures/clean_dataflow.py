@@ -1,0 +1,85 @@
+"""Known-clean fixture for the mxflow SYN/RCP/RES passes: every pattern
+here is the sanctioned spelling of something bad_dataflow_*.py gets flagged
+for.  tests/test_mxflow.py asserts zero findings."""
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Ladder:
+    def bucket(self, n):
+        return 8 * ((int(n) + 7) // 8)
+
+
+class CleanEngine:
+    def __init__(self):
+        self._ladder = Ladder()
+        self._lock = threading.Lock()
+        self._jit_step = None
+
+    def _get_step(self):
+        # lazy-init cached on self: constructed once, not per call
+        if self._jit_step is None:
+            self._jit_step = jax.jit(lambda x: x * 2)
+        return self._jit_step
+
+    def loop(self, prompt):  # mxflow: hot
+        lb = self._ladder.bucket(len(prompt))
+        toks = np.zeros((1, lb), np.int32)      # bucketed: signature stable
+        step = self._get_step()
+        out = step(jnp.asarray(toks))
+        with self._lock:                        # with-statement: no pairing
+            pass
+        self.debug_dump(out)
+        return self.emit(out)
+
+    def emit(self, out):
+        return out.asnumpy()  # mxflow: sync-ok(token streaming fetch)
+
+    def debug_dump(self, out):  # mxflow: cold (diagnostic path may sync)
+        print(out.asnumpy())
+
+
+def make_step():
+    # factory: the jit object is returned, the caller owns the cache
+    return jax.jit(lambda x: x + 1)
+
+
+_PAD = jax.jit(lambda mode, x: x, static_argnums=(0,))
+
+
+def pad(x):
+    return _PAD("train", x)                     # hashable static arg
+
+
+def copy_file(src, dst):
+    f = open(src, "rb")
+    try:
+        data = f.read()
+    finally:
+        f.close()                               # finally: exception-safe
+    with open(dst, "wb") as g:
+        g.write(data)
+    return data
+
+
+class LeaseAdmission:
+    def __init__(self, leases):
+        self._leases = leases
+
+    def admit(self, rid):
+        gen = self._leases.register(rid)        # captured: ownership moves
+        if gen is None:
+            raise RuntimeError("no lease")
+        return gen
+
+
+def reserve_safely(cache, commit, sid, need):
+    if not cache.reserve(sid, need):
+        raise RuntimeError("no headroom")       # failure branch: no leak
+    if not commit(sid):
+        cache.release(sid)
+        raise RuntimeError("lost the race")     # released before the raise
+    return sid

@@ -1,0 +1,297 @@
+"""mxflow interprocedural-analysis tests (analysis/dataflow.py + mxlint).
+
+Four contracts, all tier-1:
+
+* every SYN/RCP/RES rule fires on its known-bad fixture at exactly the
+  marked line — with the full hot call chain in the message — and stays
+  quiet on the clean fixture (no false positives);
+* the repo itself ships with an EMPTY mxflow baseline: sync/rcp/res over
+  mxnet_tpu/ report zero findings, the declared hot regions stay
+  annotated, and docs/SYNC_MAP.md matches a fresh render;
+* the planted recompile fixture is caught BOTH statically (RCP) and
+  dynamically (CachedOp.cache_stats) — the two detectors must agree;
+* the pass registry is the single source of truth (mxlint's pass list is
+  derived from it, every runner resolves) and --since incremental mode
+  filters findings to changed files.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from mxnet_tpu.analysis import common, dataflow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "lint_fixtures")
+BASELINE = os.path.join(REPO, common.DEFAULT_BASELINE)
+MXLINT = os.path.join(REPO, "tools", "mxlint.py")
+SYNC_MAP = os.path.join(REPO, "docs", "SYNC_MAP.md")
+
+HOT_REGIONS = {
+    "mxnet_tpu/serving/decode/engine.py": "decode prefill/step loop",
+    "mxnet_tpu/module/compiled_step.py": "compiled train step",
+    "mxnet_tpu/serving/fleet.py": "stream routing path",
+    "mxnet_tpu/io/device_feed.py": "device feed staging worker",
+}
+
+
+def _fixture(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+def _pairs(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+def _analyze(source, path="inline.py"):
+    return dataflow.analyze_source(textwrap.dedent(source), path)
+
+
+def _load_fixture_module(name):
+    spec = importlib.util.spec_from_file_location(
+        name[:-3], os.path.join(FIXTURES, name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# rule-by-rule: known-bad fixtures
+# ---------------------------------------------------------------------------
+
+def test_sync_rules_fire_at_marked_lines():
+    findings = dataflow.analyze_source(
+        _fixture("bad_dataflow_sync.py"), "bad_dataflow_sync.py")
+    assert _pairs(findings) == [
+        ("SYN001", 13), ("SYN001", 26), ("SYN002", 27), ("SYN002", 29),
+        ("SYN002", 36), ("SYN003", 40), ("SYN003", 47)]
+
+
+def test_sync_findings_carry_full_call_chains():
+    findings = dataflow.analyze_source(
+        _fixture("bad_dataflow_sync.py"), "bad_dataflow_sync.py")
+    by_line = {f.line: f.message for f in findings}
+    # attr-type inference: self.stats = Telemetry() resolves flush's call
+    assert "Worker.loop -> Worker.flush -> Telemetry.snapshot" in by_line[13]
+    # wrapper aliasing: self._fetch = retry(self._fetch_once)
+    assert "Worker.loop -> Worker._fetch_once" in by_line[36]
+
+
+def test_rcp_rules_fire_at_marked_lines():
+    findings = dataflow.analyze_source(
+        _fixture("bad_dataflow_rcp.py"), "bad_dataflow_rcp.py")
+    assert _pairs(findings) == [
+        ("RCP001", 26), ("RCP002", 18), ("RCP002", 20), ("RCP002", 21),
+        ("RCP003", 36), ("RCP004", 29)]
+
+
+def test_res_rules_fire_at_marked_lines():
+    findings = dataflow.analyze_source(
+        _fixture("bad_dataflow_res.py"), "bad_dataflow_res.py")
+    assert _pairs(findings) == [
+        ("RES001", 14), ("RES002", 9), ("RES003", 40), ("RES003", 45),
+        ("RES004", 24), ("RES004", 35), ("RES005", 53)]
+
+
+def test_clean_fixture_stays_quiet():
+    findings = dataflow.analyze_source(
+        _fixture("clean_dataflow.py"), "clean_dataflow.py")
+    assert _pairs(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# annotation vocabulary round-trips
+# ---------------------------------------------------------------------------
+
+def test_hot_annotation_round_trip():
+    src = """\
+    def run(arr):  # mxflow: hot
+        return arr.asnumpy()
+    """
+    assert _pairs(_analyze(src)) == [("SYN001", 2)]
+    # same code without the hot tag is not reachable from a hot region
+    assert _pairs(_analyze(src.replace("  # mxflow: hot", ""))) == []
+
+
+def test_cold_annotation_cuts_the_walk():
+    src = """\
+    def run(arr):  # mxflow: hot
+        return dump(arr)
+
+    def dump(arr):  # mxflow: cold (diagnostics may sync)
+        return arr.asnumpy()
+    """
+    assert _pairs(_analyze(src)) == []
+
+
+def test_sync_ok_tag_sanctions_the_site():
+    src = """\
+    def run(arr):  # mxflow: hot
+        return arr.asnumpy()  # mxflow: sync-ok(token streaming fetch)
+    """
+    assert _pairs(_analyze(src)) == []
+
+
+def test_tags_inside_string_literals_are_ignored():
+    # docstrings/messages that *mention* the tag syntax must not annotate
+    src = '''\
+    def run(arr):  # mxflow: hot
+        """Explains that "# mxflow: sync-ok(reason)" sanctions a line."""
+        msg = "tag with # mxflow: cold if diagnostic"
+        return arr.asnumpy()
+    '''
+    assert _pairs(_analyze(src)) == [("SYN001", 4)]
+
+
+# ---------------------------------------------------------------------------
+# repo gates: the baseline ships EMPTY for all three mxflow passes
+# ---------------------------------------------------------------------------
+
+def test_repo_is_sync_clean():
+    assert _pairs(dataflow.run_sync(REPO)) == []
+
+
+def test_repo_is_rcp_clean():
+    assert _pairs(dataflow.run_rcp(REPO)) == []
+
+
+def test_repo_is_res_clean():
+    assert _pairs(dataflow.run_res(REPO)) == []
+
+
+def test_baseline_has_no_mxflow_entries():
+    entries = common.load_baseline(BASELINE).entries
+    mxflow = [k for k in entries
+              if common.pass_of_key(k) in ("sync", "rcp", "res")]
+    assert mxflow == [], "mxflow findings are fixed or tagged, never baselined"
+
+
+def test_declared_hot_regions_stay_annotated():
+    for rel, label in HOT_REGIONS.items():
+        with open(os.path.join(REPO, rel)) as f:
+            src = f.read()
+        assert "# mxflow: hot (%s)" % label in src, rel
+
+
+def test_sync_map_is_fresh_and_justified():
+    entries = dataflow.sync_map_entries(REPO)
+    assert entries, "the runtime has sanctioned sync points"
+    assert all(e["reason"].strip() for e in entries)
+    with open(SYNC_MAP) as f:
+        committed = f.read()
+    assert committed == dataflow.render_sync_map(entries), \
+        "docs/SYNC_MAP.md is stale: run `python tools/mxlint.py --sync-map`"
+
+
+# ---------------------------------------------------------------------------
+# mxstress cross-check: static and dynamic recompile detectors agree
+# ---------------------------------------------------------------------------
+
+def test_recompile_fixture_caught_statically():
+    findings = dataflow.analyze_source(
+        _fixture("bad_dataflow_recompile.py"), "bad_dataflow_recompile.py")
+    assert _pairs(findings) == [("RCP001", 18), ("RCP002", 13)]
+    rcp001 = [f for f in findings if f.rule == "RCP001"][0]
+    assert "slice bound `n`" in rcp001.message
+
+
+def test_recompile_fixture_caught_dynamically():
+    mod = _load_fixture_module("bad_dataflow_recompile.py")
+    stats = mod.drive([3, 5, 7])
+    # one recompile per distinct input length: the cache_stats delta is the
+    # dynamic witness for the hazard RCP001 reports statically
+    assert stats["misses"] == 3
+    assert stats["recompiles"] == stats["misses"]
+    assert len(stats["signatures"]) == 3
+    stats = mod.drive([4, 4, 4])
+    assert stats["misses"] == 1 and stats["hits"] == 2
+
+
+# ---------------------------------------------------------------------------
+# pass registry: one source of truth
+# ---------------------------------------------------------------------------
+
+def _load_mxlint():
+    spec = importlib.util.spec_from_file_location("_mxlint_under_test",
+                                                  MXLINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pass_registry_is_single_source():
+    mxlint = _load_mxlint()
+    assert tuple(mxlint.PASSES) == tuple(common.PASSES)
+    assert set(common.PASSES) == set(common.PASS_REGISTRY)
+    derived = {fam: name for name, spec in common.PASS_REGISTRY.items()
+               for fam in spec["rules"]}
+    assert common.RULE_FAMILY_PASS == derived
+    for name in common.PASSES:
+        assert callable(common.resolve_runner(name)), name
+
+
+# ---------------------------------------------------------------------------
+# CLI: --passes, --since incremental mode, ci runner
+# ---------------------------------------------------------------------------
+
+def _run_mxlint(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, MXLINT] + list(args),
+        cwd=cwd, capture_output=True, text=True)
+
+
+def test_cli_mxflow_passes_clean():
+    proc = _run_mxlint("--passes", "sync,rcp,res")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_since_mode_filters_to_changed_files(tmp_path):
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "old.py").write_text(
+        "def run(arr):  # mxflow: hot\n    return arr.asnumpy()\n")
+    root = str(tmp_path)
+    subprocess.run(["git", "init", "-q"], cwd=root, check=True)
+    subprocess.run(["git", "add", "-A"], cwd=root, check=True)
+    subprocess.run(["git", "-c", "user.name=t", "-c", "user.email=t@t",
+                    "commit", "-qm", "seed"], cwd=root, check=True)
+
+    # nothing changed vs HEAD: incremental mode runs no passes at all
+    proc = _run_mxlint("--root", root, "--since", "HEAD",
+                       "--passes", "sync", "--no-baseline", "--json")
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["findings"] == []
+
+    # an untracked file with the same violation: only it is reported
+    (pkg / "new.py").write_text(
+        "def run(arr):  # mxflow: hot\n    return arr.asnumpy()\n")
+    proc = _run_mxlint("--root", root, "--since", "HEAD",
+                       "--passes", "sync", "--no-baseline", "--json")
+    assert proc.returncode == 1, proc.stderr
+    paths = [f["path"] for f in json.loads(proc.stdout)["findings"]]
+    assert paths == ["mxnet_tpu/new.py"]
+
+    # the full run still sees both
+    proc = _run_mxlint("--root", root, "--passes", "sync", "--no-baseline",
+                       "--json")
+    assert proc.returncode == 1, proc.stderr
+    paths = sorted(f["path"] for f in json.loads(proc.stdout)["findings"])
+    assert paths == ["mxnet_tpu/new.py", "mxnet_tpu/old.py"]
+
+
+def test_since_refuses_update_baseline():
+    proc = _run_mxlint("--since", "HEAD", "--update-baseline")
+    assert proc.returncode == 2
+    assert "do not compose" in proc.stderr
+
+
+def test_ci_lint_runner():
+    script = os.path.join(REPO, "tools", "ci_lint.sh")
+    assert os.access(script, os.X_OK)
+    proc = subprocess.run(["bash", "-n", script], capture_output=True)
+    assert proc.returncode == 0, proc.stderr

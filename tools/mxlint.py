@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """mxlint — framework-native static analysis for the TPU build.
 
-Runs four passes (see docs/LINT.md) and exits non-zero iff any finding is
+Runs seven passes (see docs/LINT.md) and exits non-zero iff any finding is
 not covered by the checked-in baseline:
 
   tracing   AST pass over mxnet_tpu/ (tracer concretization, host syncs in
@@ -11,11 +11,17 @@ not covered by the checked-in baseline:
   cabi      bridge-return defensiveness pass over src/c_api.cc
   concur    concurrency-safety pass over mxnet_tpu/ (guarded-by inference,
             unguarded module globals, lock-order cycles, thread targets)
+  sync      mxflow interprocedural host-sync reachability from declared
+            hot regions (SYN; empty baseline, sync-ok tags -> SYNC_MAP)
+  rcp       mxflow stealth-recompile hazards at jit/CachedOp boundaries
+  res       mxflow resource acquire/release pairing across exception edges
 
 Usage:
   python tools/mxlint.py                      # all passes, text output
   python tools/mxlint.py --json               # machine-readable report
-  python tools/mxlint.py --passes tracing,cabi
+  python tools/mxlint.py --passes sync,rcp,res
+  python tools/mxlint.py --since HEAD~1       # findings in changed files
+  python tools/mxlint.py --sync-map           # regenerate docs/SYNC_MAP.md
   python tools/mxlint.py --update-baseline    # rewrite .mxlint-baseline.json
   python tools/mxlint.py --no-baseline        # raw findings, no suppression
 """
@@ -23,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -30,25 +37,51 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-PASSES = ("tracing", "registry", "cabi", "concur")
+
+def _load_registry():
+    """Load analysis/common.py standalone (it imports nothing from the
+    package) so --help and bad-usage errors stay instant: importing
+    ``mxnet_tpu.analysis`` proper pulls in the whole framework."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_mxlint_registry",
+        os.path.join(REPO, "mxnet_tpu", "analysis", "common.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_REGISTRY = _load_registry()
+PASSES = _REGISTRY.PASSES
+DEFAULT_SYNC_MAP = os.path.join("docs", "SYNC_MAP.md")
 
 
 def collect(passes, root):
-    """-> (findings, registry_report)."""
-    from mxnet_tpu.analysis import cabi_lint, tracing_lint
+    """-> (findings, registry_report).  Dispatch is table-driven off
+    analysis.common.PASS_REGISTRY — the one place a new pass is added."""
+    from mxnet_tpu.analysis import common
     findings, report = [], None
-    if "tracing" in passes:
-        findings.extend(tracing_lint.run(root))
-    if "cabi" in passes:
-        findings.extend(cabi_lint.run(root))
-    if "concur" in passes:
-        from mxnet_tpu.analysis import concurrency_lint
-        findings.extend(concurrency_lint.run(root))
-    if "registry" in passes:
-        from mxnet_tpu.analysis import registry_audit
-        reg_findings, report = registry_audit.audit(root)
-        findings.extend(reg_findings)
+    for name in common.PASSES:
+        if name not in passes:
+            continue
+        out = common.resolve_runner(name)(root)
+        if common.PASS_REGISTRY[name].get("report"):
+            pass_findings, report = out
+        else:
+            pass_findings = out
+        findings.extend(pass_findings)
     return findings, report
+
+
+def changed_paths(root, rev):
+    """Repo-relative posix paths changed vs ``rev`` (plus untracked)."""
+    out = subprocess.check_output(
+        ["git", "-C", root, "diff", "--name-only", rev, "--"], text=True)
+    untracked = subprocess.check_output(
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+        text=True)
+    return {p.strip() for p in out.splitlines() + untracked.splitlines()
+            if p.strip()}
 
 
 def main(argv=None):
@@ -58,6 +91,16 @@ def main(argv=None):
     ap.add_argument("--passes", default=",".join(PASSES),
                     help="comma list from {%s}" % ",".join(PASSES))
     ap.add_argument("--root", default=REPO, help="repo root to analyze")
+    ap.add_argument("--since", metavar="REV", default=None,
+                    help="incremental mode: only report findings in files "
+                         "changed vs REV (git diff + untracked); the "
+                         "registry pass is skipped unless ops or tests "
+                         "changed, and stale-key detection is off (a "
+                         "partial view cannot prove a fix)")
+    ap.add_argument("--sync-map", nargs="?", const=DEFAULT_SYNC_MAP,
+                    default=None, metavar="PATH",
+                    help="write the sanctioned host-sync catalog (default "
+                         "%s) and exit" % DEFAULT_SYNC_MAP)
     ap.add_argument("--baseline",
                     default=os.path.join(REPO, ".mxlint-baseline.json"),
                     help="baseline/suppression file "
@@ -77,9 +120,41 @@ def main(argv=None):
     # stay instant (the analysis package pulls in the full framework)
     from mxnet_tpu.analysis import common
 
+    if args.sync_map is not None:
+        from mxnet_tpu.analysis import dataflow
+        entries = dataflow.sync_map_entries(args.root)
+        path = args.sync_map
+        if not os.path.isabs(path):
+            path = os.path.join(args.root, path)
+        with open(path, "w") as f:
+            f.write(dataflow.render_sync_map(entries))
+        print("wrote %d sanctioned sync point(s) to %s"
+              % (len(entries), path))
+        return 0
+
+    changed = None
+    if args.since is not None:
+        try:
+            changed = changed_paths(args.root, args.since)
+        except (subprocess.CalledProcessError, OSError) as e:
+            ap.error("--since %s: %s" % (args.since, e))
+        if "registry" in passes and not any(
+                p.startswith(("mxnet_tpu/ops", "tests/"))
+                for p in changed):
+            # the audit joins the op registry against the test corpus;
+            # untouched ops and tests cannot change its verdict
+            passes = [p for p in passes if p != "registry"]
+        if not changed:
+            passes = []
+
     findings, report = collect(passes, args.root)
+    if changed is not None:
+        findings = [f for f in findings if f.path in changed]
 
     if args.update_baseline:
+        if args.since is not None:
+            ap.error("--since and --update-baseline do not compose: an "
+                     "incremental view must not rewrite the full baseline")
         bl = common.Baseline.from_findings(findings)
         previous = common.load_baseline(args.baseline).entries
         # carried-over keys keep their original reason text — the reason is
@@ -105,7 +180,7 @@ def main(argv=None):
     else:
         baseline = common.load_baseline(args.baseline)
         new, old, stale = baseline.partition(findings)
-        if set(passes) != set(PASSES):
+        if set(passes) != set(PASSES) or changed is not None:
             # a partial run cannot distinguish "fixed" from "not scanned"
             stale = []
 
