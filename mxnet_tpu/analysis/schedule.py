@@ -76,12 +76,21 @@ Invariants asserted (per seed)
   pools whole on every survivor, per-tenant admission conservation with
   no starvation, zero steady-state recompiles on engines that lived the
   whole seed (see ``decode_fleet_storm``).
+* **shared-prefix decode storm** (``decode_prefix``) — greedy and seeded
+  sampled streams over prompts sharing a common prefix hit the copy-on-
+  write prefix cache on chunked + speculative engines while one replica
+  drains mid-run: OK streams bitwise-equal their greedy or sampled
+  reference ACROSS the handoff (migrated streams carry refcounted shared
+  pages + sampler state), KV pools drain whole with zero leaks, the
+  prefix-hit / CoW-fork / speculation counters demonstrably advance, and
+  nothing recompiles (see ``decode_prefix_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
 and ``tests/test_faults.py``/``tests/test_fleet.py``/
-``tests/test_decode_fleet.py`` gate the fault-driven scenarios
-(``faults``, ``crash``, ``fleet``, ``decode_fleet``) on the smaller
+``tests/test_decode_fleet.py``/``tests/test_decode_prefix.py`` gate the
+fault-driven scenarios (``faults``, ``crash``, ``fleet``,
+``decode_fleet``, ``decode_prefix``) on the smaller
 ``FAULT_SMOKE_SEEDS`` set.
 """
 from __future__ import annotations
@@ -1537,11 +1546,376 @@ def decode_fleet_storm(router, name, prompts, refs, seed):
 
 
 # ---------------------------------------------------------------------------
+# scenario: shared-prefix decode storm (decode_prefix)
+# ---------------------------------------------------------------------------
+
+_DPREFIX_SHARED = (5, 3, 7, 1, 2, 6, 4, 8)      # two full prefill chunks
+_DPREFIX_PROMPTS = (
+    _DPREFIX_SHARED,                             # donor: exact duplicates
+    _DPREFIX_SHARED + (9, 2),                    # of this one force CoW
+    _DPREFIX_SHARED + (11, 3, 5, 7),
+    _DPREFIX_SHARED + (2,),
+    _DPREFIX_SHARED + (10, 1, 12, 4, 6, 2),
+)
+_DPREFIX_MAX_NEW = 6
+_DPREFIX_TEMP = 0.8
+_DPREFIX_TOPK = 6
+_DPREFIX_SEED0 = 9000   # sampled stream of prompt i uses seed 9000 + i
+
+
+def _build_decode_prefix_fixture():
+    """-> (router, engine_name, prompts, greedy_refs, sampled_refs).
+
+    Three replicas, each hosting a chunked + prefix-cached + speculative
+    decode engine built from the same seeded TinyCausalLM (identical
+    params per factory call — the handoff bitwise claim depends on it).
+    The draft IS the target model (self-draft): acceptance is high while
+    every emitted token still comes from a verify row, so a cold draft
+    after an import only lowers the acceptance rate, never the output.
+    The prompt set shares an 8-token prefix so cross-request caching,
+    CoW forks on the recomputed tail chunk, and refcounted shared-page
+    handoffs all fire under the storm."""
+    from ..serving.decode import DecodeEngine, TinyCausalLM
+    from ..serving.fleet import FleetRouter
+
+    def factory(name):
+        model = TinyCausalLM(vocab_size=24, hidden=16, num_layers=1,
+                             num_heads=2, max_len=24, seed=17)
+        # max_new_tokens leaves headroom over the storm's request size so
+        # the donor pass can run one LONGER holder stream (see the
+        # deterministic CoW pair in decode_prefix_storm)
+        return DecodeEngine(model, name=name, max_slots=2, block_size=4,
+                            num_blocks=20, max_prompt_len=14,
+                            max_new_tokens=_DPREFIX_MAX_NEW + 2,
+                            max_queue=8,
+                            prefill_chunk=4, prefix_cache=True,
+                            spec_k=2, draft_model=model,
+                            breaker_threshold=4, breaker_backoff_ms=15.0)
+
+    router = FleetRouter(replicas=3, failover_budget=2,
+                         breaker_threshold=3, breaker_backoff_ms=10.0)
+    router.load_decode("pxlm", factory, replicas=3)
+    rid0 = router.stats()["decode_models"]["pxlm"]["placement"][0]
+    eng = router.engine("pxlm", rid0)
+    refs = [eng.generate_reference(p, _DPREFIX_MAX_NEW).tolist()
+            for p in _DPREFIX_PROMPTS]
+    sam_refs = [eng.generate_reference(
+                    p, _DPREFIX_MAX_NEW, temperature=_DPREFIX_TEMP,
+                    top_k=_DPREFIX_TOPK, seed=_DPREFIX_SEED0 + i).tolist()
+                for i, p in enumerate(_DPREFIX_PROMPTS)]
+    return router, "pxlm", list(_DPREFIX_PROMPTS), refs, sam_refs
+
+
+def decode_prefix_storm(router, name, prompts, refs, sam_refs, seed):
+    """Shared-prefix storm with a mid-run replica drain (the
+    ``decode_prefix`` scenario).
+
+    A donor pass first runs the bare shared-prefix prompt on EVERY placed
+    engine so each replica's prefix registry holds the shared chunks;
+    the seeded storm then mixes greedy and explicitly-seeded sampled
+    streams over prompts that extend (or exactly duplicate) that prefix
+    while a disruptor drains one LIVE replica — migrated streams carry
+    refcounted shared pages and in-flight sampler state to a survivor.
+    Invariants:
+
+    * **no torn streams** — an OK greedy stream's tokens equal the greedy
+      reference for its own prompt bitwise; an OK sampled stream equals
+      the sampled reference for its (prompt, seed) pair (same-seed
+      replay holds across the handoff); TIMEOUT/UNAVAILABLE partials are
+      strict prefixes; a shed stream carries zero tokens;
+    * **conservation across handoffs** — router decode counters satisfy
+      ``requests == ok + timeouts + errors + unavailable`` and match the
+      client tally per status, with zero ERROR streams (no faults are
+      injected here);
+    * **shared pages stay refcounted** — after the drain every engine's
+      KV pool is whole: used == reserved == live_sequences == 0 (shared
+      pages retire to the reusable cache, counted once) and
+      ``allocated_total == freed_total``; per-engine conservation
+      ``requests + imported == ok+to+err+unavail+handed_off`` holds;
+    * **the multipliers actually fired** — fleet-wide prefix_hits,
+      cow_forks and spec_proposed all advanced (the duplicate-prompt
+      stream guarantees a full-hit CoW fork on the recomputed tail
+      chunk);
+    * **zero steady-state recompiles** — prefix attach, CoW forks,
+      sampling and the handoff all ride the warmed chunk/verify/draft
+      signatures;
+    * **repair + replay** — after enable() the placement re-converges
+      and one greedy plus one sampled probe reach OK bitwise-equal to
+      their references.
+    """
+    from ..serving import server as srv
+
+    violations = []
+    rng = random.Random(seed ^ 0x9EF1)
+    before = router.decode_stats.snapshot()
+    stats0 = router.stats()
+    before_eng = dict(stats0["engines"].get(name, {}))
+    before_roll = stats0["decode"]["prefix_spec"]
+
+    # donor pass: seed every replica's prefix registry (direct engine
+    # submits — deliberately outside the router's counters)
+    placement = stats0["decode_models"][name]["placement"]
+    for rid in placement:
+        donor = router.engine(name, rid).submit(list(prompts[0]),
+                                                _DPREFIX_MAX_NEW)
+        donor.wait(_JOIN_TIMEOUT_S)
+        status, tokens, _, _, err = donor.snapshot()
+        if status != srv.OK or list(tokens) != refs[0]:
+            violations.append("decode_prefix: donor on %s ended %r (%r)"
+                              % (rid, status, err))
+    # deterministic CoW pair on one engine: a LONGER-lived holder
+    # duplicate attaches the registered pages and holds their refcount
+    # while a second duplicate attaches behind it — whichever recomputes
+    # its tail chunk while the page is shared (refcount > 1) must fork,
+    # independent of the chaos schedule.  (Greedy decode is positionwise
+    # deterministic, so the holder's extra tokens extend refs[0].)
+    eng0 = router.engine(name, placement[0])
+    holder = eng0.submit(list(prompts[0]), _DPREFIX_MAX_NEW + 2)
+    dup = eng0.submit(list(prompts[0]), _DPREFIX_MAX_NEW)
+    for label, stream, want in (("holder", holder, None),
+                                ("dup", dup, refs[0])):
+        stream.wait(_JOIN_TIMEOUT_S)
+        status, tokens, _, _, err = stream.snapshot()
+        toks = list(tokens)
+        good = status == srv.OK and (
+            toks == want if want is not None
+            else toks[:len(refs[0])] == refs[0])
+        if not good:
+            violations.append("decode_prefix: CoW-pair %s stream ended %r "
+                              "(%r)" % (label, status, err))
+
+    n_clients, per_client = 3, 3
+    plans = []   # [(timeout_ms or None, prompt_idx, sampled), ...]
+    for c in range(n_clients):
+        plan = []
+        for s in range(per_client):
+            if c == 0 and s == 0:
+                # pinned: a greedy exact duplicate of the donor prompt —
+                # the guaranteed full-hit + CoW-fork + speculation stream
+                plan.append((None, 0, False))
+                continue
+            tmo = rng.uniform(200.0, 1500.0) if rng.random() < 0.15 \
+                else None
+            plan.append((tmo, rng.randrange(len(prompts)),
+                         rng.random() < 0.35))
+        plans.append(plan)
+    results = [[] for _ in plans]
+
+    def client(c):
+        for tmo, pi, sampled in plans[c]:
+            if sampled:
+                stream = router.submit_stream(
+                    name, list(prompts[pi]),
+                    max_new_tokens=_DPREFIX_MAX_NEW, timeout_ms=tmo,
+                    temperature=_DPREFIX_TEMP, top_k=_DPREFIX_TOPK,
+                    seed=_DPREFIX_SEED0 + pi)
+            else:
+                stream = router.submit_stream(
+                    name, list(prompts[pi]),
+                    max_new_tokens=_DPREFIX_MAX_NEW, timeout_ms=tmo)
+            if not stream.wait(_JOIN_TIMEOUT_S):
+                violations.append("decode_prefix: stream of client %d "
+                                  "never terminated" % c)
+            results[c].append((pi, sampled, stream))
+
+    drained = []
+
+    def disruptor():
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            d = router.decode_stats.snapshot()
+            if d["requests"] - before["requests"] >= 2:
+                break
+            time.sleep(0.002)
+        live = [rid for rid, state in sorted(router.replicas().items())
+                if state == "LIVE"]
+        if len(live) < 2:
+            violations.append("decode_prefix: %d live replica(s) before "
+                              "the drain (want >= 2)" % len(live))
+            return
+        rid_d = live[rng.randrange(len(live))]
+        router.drain(rid_d)   # fenced handoff: shared pages + samplers
+        drained.append(rid_d)
+
+    workers = [lambda c=c: client(c) for c in range(len(plans))]
+    workers.append(disruptor)
+    violations.extend(_spawn(workers))
+
+    # client-side status + token integrity
+    tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "ERROR": 0,
+             "UNAVAILABLE": 0, "shed": 0, "rejected": 0}
+    for c in range(len(plans)):
+        for pi, sampled, stream in results[c]:
+            status, tokens, _, _, _err = stream.snapshot()
+            if status is None:
+                violations.append("decode_prefix: client %d stream has no "
+                                  "terminal status" % c)
+                continue
+            if stream.admitted:
+                tally["admitted"] += 1
+                if status not in (srv.OK, srv.TIMEOUT, srv.ERROR,
+                                  srv.UNAVAILABLE):
+                    violations.append("decode_prefix: admitted stream "
+                                      "ended %r" % status)
+                    continue
+                tally[status] += 1
+            elif status == srv.OVERLOADED:
+                tally["shed"] += 1
+            elif status == srv.UNAVAILABLE:
+                tally["rejected"] += 1
+            else:
+                violations.append("decode_prefix: rejected stream ended %r"
+                                  % status)
+                continue
+            ref = sam_refs[pi] if sampled else refs[pi]
+            kind = "sampled" if sampled else "greedy"
+            toks = list(tokens)
+            if status == srv.OK and toks != ref:
+                violations.append(
+                    "decode_prefix: torn %s stream: client %d OK tokens "
+                    "%s != reference %s" % (kind, c, toks, ref))
+            elif status in (srv.TIMEOUT, srv.UNAVAILABLE) and \
+                    toks != ref[:len(toks)]:
+                violations.append(
+                    "decode_prefix: contaminated %s partial: client %d %s "
+                    "tokens %s not a prefix of %s"
+                    % (kind, c, status, toks, ref))
+            elif status == srv.OVERLOADED and toks:
+                violations.append("decode_prefix: shed stream carries %d "
+                                  "token(s)" % len(toks))
+
+    # router-level conservation (terminal hooks fire just after complete)
+    keys = ("requests", "ok", "timeouts", "errors", "unavailable", "shed",
+            "invalid", "unavailable_rejected")
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = router.decode_stats.snapshot()
+        d = {k: after[k] - before[k] for k in keys}
+        terminal_sum = (d["ok"] + d["timeouts"] + d["errors"]
+                        + d["unavailable"])
+        if d["requests"] == terminal_sum or time.monotonic() >= settle_until:
+            break
+        time.sleep(0.005)
+    if d["requests"] != terminal_sum:
+        violations.append("decode_prefix: lost streams: %d admitted, %d "
+                          "terminal" % (d["requests"], terminal_sum))
+    if d["requests"] != tally["admitted"]:
+        violations.append("decode_prefix: admission mismatch: router %d "
+                          "vs clients %d" % (d["requests"],
+                                             tally["admitted"]))
+    for client_key, fleet_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
+                                  ("ERROR", "errors"),
+                                  ("UNAVAILABLE", "unavailable"),
+                                  ("shed", "shed"),
+                                  ("rejected", "unavailable_rejected")):
+        if d[fleet_key] != tally[client_key]:
+            violations.append("decode_prefix: %s mismatch: router %d vs "
+                              "clients %d"
+                              % (fleet_key, d[fleet_key],
+                                 tally[client_key]))
+    if d["errors"]:
+        violations.append("decode_prefix: %d ERROR stream(s) with no "
+                          "faults injected" % d["errors"])
+
+    # shared pages stay refcounted: every pool drains whole (shared pages
+    # retire to the reusable cache — they never leak and never double-
+    # count), per-engine conservation + zero recompiles hold
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        engines = router.stats()["engines"].get(name, {})
+        if all(s["kv"]["used"] == 0 and s["kv"]["reserved"] == 0
+               and s["kv"]["live_sequences"] == 0
+               for s in engines.values()):
+            break
+        time.sleep(0.005)
+    engines = router.stats()["engines"].get(name, {})
+    for rid, s in engines.items():
+        kv = s["kv"]
+        if kv["used"] != 0 or kv["reserved"] != 0 \
+                or kv["live_sequences"] != 0:
+            violations.append("decode_prefix: KV pool not whole on %s: %r"
+                              % (rid, {k: kv[k] for k in
+                                       ("used", "reserved",
+                                        "live_sequences")}))
+        if kv["allocated_total"] != kv["freed_total"]:
+            violations.append("decode_prefix: KV leak on %s: allocated %d "
+                              "!= freed %d" % (rid, kv["allocated_total"],
+                                               kv["freed_total"]))
+        if s["requests"] + s["imported"] != (
+                s["ok"] + s["timeouts"] + s["errors"] + s["unavailable"]
+                + s["handed_off"]):
+            violations.append("decode_prefix: engine conservation broken "
+                              "on %s: req %d + imported %d != ok %d + "
+                              "to %d + err %d + unavail %d + handed %d"
+                              % (rid, s["requests"], s["imported"],
+                                 s["ok"], s["timeouts"], s["errors"],
+                                 s["unavailable"], s["handed_off"]))
+        prev = before_eng.get(rid)
+        if prev is not None and \
+                s["cache"]["recompiles"] != prev["cache"]["recompiles"]:
+            violations.append("decode_prefix: steady-state recompile on "
+                              "%s: %d -> %d"
+                              % (rid, prev["cache"]["recompiles"],
+                                 s["cache"]["recompiles"]))
+
+    # the multipliers actually fired (fleet-wide rollup deltas)
+    roll = router.stats()["decode"]["prefix_spec"]
+    for key in ("prefix_hits", "cow_forks", "spec_proposed"):
+        if roll[key] - before_roll[key] <= 0:
+            violations.append("decode_prefix: %s never advanced under the "
+                              "storm (%d -> %d)"
+                              % (key, before_roll[key], roll[key]))
+
+    # per-tenant accounting settled (everything ran as the default tenant)
+    for tname, tsnap in router.tenant_snapshot().items():
+        if tsnap["inflight_tokens"] != 0:
+            violations.append("decode_prefix: tenant %r still holds %d "
+                              "in-flight token(s) after the storm"
+                              % (tname, tsnap["inflight_tokens"]))
+
+    # repair for the next seed, then replay probes: one greedy + one
+    # sampled stream must reach OK bitwise-equal to their references
+    for rid in drained:
+        if router.replicas().get(rid) == "DRAINING":
+            router.enable(rid)
+    if not router.wait_converged(timeout_s=10.0):
+        violations.append("decode_prefix: placement never re-converged: %r"
+                          % router.stats()["decode_models"])
+    probe = router.submit_stream(name, list(prompts[0]),
+                                 max_new_tokens=_DPREFIX_MAX_NEW)
+    probe.wait(_JOIN_TIMEOUT_S)
+    status, tokens, _, _, err = probe.snapshot()
+    if status != srv.OK or list(tokens) != refs[0]:
+        violations.append("decode_prefix: post-repair greedy probe ended "
+                          "%r (%r)" % (status, err))
+    probe = router.submit_stream(name, list(prompts[1]),
+                                 max_new_tokens=_DPREFIX_MAX_NEW,
+                                 temperature=_DPREFIX_TEMP,
+                                 top_k=_DPREFIX_TOPK,
+                                 seed=_DPREFIX_SEED0 + 1)
+    probe.wait(_JOIN_TIMEOUT_S)
+    status, tokens, _, _, err = probe.snapshot()
+    if status != srv.OK or list(tokens) != sam_refs[1]:
+        violations.append("decode_prefix: post-repair sampled probe ended "
+                          "%r (%r)" % (status, err))
+    # settle so a late terminal hook can't straddle the next seed's
+    # `before` snapshot
+    settle_until = time.monotonic() + 5.0
+    while time.monotonic() < settle_until:
+        s = router.decode_stats.snapshot()
+        if s["requests"] == (s["ok"] + s["timeouts"] + s["errors"]
+                             + s["unavailable"]):
+            break
+        time.sleep(0.002)
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
-             "crash", "decode", "fleet", "decode_fleet")
+             "crash", "decode", "fleet", "decode_fleet", "decode_prefix")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -1569,6 +1943,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                          if "fleet" in scenarios else None)
         dfleet_fixture = (_build_decode_fleet_fixture()
                           if "decode_fleet" in scenarios else None)
+        dprefix_fixture = (_build_decode_prefix_fixture()
+                           if "decode_prefix" in scenarios else None)
         try:
             for seed in seeds:
                 sched.reseed(seed)
@@ -1606,6 +1982,11 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                     per_seed["decode_fleet"] = decode_fleet_storm(
                         dfleet_fixture[0], dfleet_fixture[1],
                         dfleet_fixture[2], dfleet_fixture[3], seed)
+                if dprefix_fixture is not None:
+                    per_seed["decode_prefix"] = decode_prefix_storm(
+                        dprefix_fixture[0], dprefix_fixture[1],
+                        dprefix_fixture[2], dprefix_fixture[3],
+                        dprefix_fixture[4], seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n
@@ -1623,6 +2004,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                 fleet_fixture[0].stop()
             if dfleet_fixture is not None:
                 dfleet_fixture[0].stop()
+            if dprefix_fixture is not None:
+                dprefix_fixture[0].stop()
     report["preemptions"] = sched.preemptions
     report["elapsed_s"] = time.monotonic() - t0
     return report

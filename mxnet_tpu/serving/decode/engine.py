@@ -29,6 +29,33 @@ worst-case block count (shedding OVERLOADED when the pool cannot honor
 it), blocks are allocated lazily as sequences grow and freed the moment a
 sequence finishes.
 
+Four opt-in throughput multipliers stack on that core (each off by
+default, leaving the base engine bit-identical):
+
+* ``prefill_chunk=C`` — prompts prefill in fixed ``[1, C]`` chunks, ONE
+  chunk per scheduler iteration, interleaved with decode steps: a long
+  prompt no longer stalls live streams' TTFT.  One chunk signature
+  replaces the prompt bucket ladder (same-shape kernels are what keep the
+  chunked path bitwise-reproducible), and ``generate_reference`` chunks
+  identically.
+* ``prefix_cache=True`` (requires ``prefill_chunk``) — ``reserve()``
+  attaches the longest registered shared prompt prefix (kv_cache.py chain
+  hashes), prefill skips straight to the first unshared chunk, and writes
+  into shared pages copy-on-write fork first (device pages copied, table
+  entry swapped).  A fleet-wide shared system prompt costs one prefill.
+* ``temperature``/``top_k``/``top_p``/``seed`` on ``submit()`` — seeded
+  host-side sampling (sampling.py): greedy stays the default and sampled
+  streams replay exactly (same seed => same tokens) across restarts and
+  handoffs.
+* ``spec_k=K, draft_model=...`` (requires ``prefill_chunk``) — a draft
+  model proposes K greedy tokens in one unrolled call, ONE paged verify
+  step scores K+1 positions, and the engine commits the longest agreeing
+  prefix: up to K+1 tokens for two dispatches.  Emitted tokens depend
+  only on the *target* logits chain, so speculative greedy output is
+  bitwise-equal to the sequential reference no matter what the draft
+  proposes — the draft can be wrong, stale, or freshly imported garbage
+  and only the acceptance rate moves.
+
 Every request is a :class:`DecodeStream` — tokens stream out as they are
 produced (iterator and/or ``on_token`` callback), and the terminal state
 is a status, never an exception: the same vocabulary as server.py
@@ -56,6 +83,7 @@ from ..health import CircuitBreaker, PROBE, REJECT
 from ..server import (OK, TIMEOUT, OVERLOADED, INVALID_INPUT, ERROR,
                       UNAVAILABLE)
 from .kv_cache import PagedKVCache
+from .sampling import SamplingParams, StreamSampler
 from .stats import DecodeStats
 
 __all__ = ["DecodeEngine", "DecodeStream"]
@@ -77,11 +105,12 @@ class DecodeStream:
     """
 
     def __init__(self, prompt, max_new_tokens, deadline=None, stats=None,
-                 on_token=None):
+                 on_token=None, sampling=None):
         self.prompt = prompt                 # int32 numpy copy
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline             # monotonic seconds or None
         self.stats = stats                   # engine DecodeStats handle
+        self.sampling = sampling             # SamplingParams or None=greedy
         self.seq_id = None                   # assigned at submission
         self.admitted = False
         self.t_submit = time.monotonic()
@@ -237,7 +266,7 @@ class _Seq:
     """Engine-private per-slot state for one live sequence."""
 
     __slots__ = ("stream", "seq_id", "position", "cur_token", "generated",
-                 "gen", "snap")
+                 "gen", "snap", "prefill_pos", "sampler")
 
     def __init__(self, stream, gen=None, snap=None):
         self.stream = stream
@@ -247,6 +276,8 @@ class _Seq:
         self.generated = 0
         self.gen = gen          # fencing token presented on emit/complete
         self.snap = snap        # pending import restore, cleared at resume
+        self.prefill_pos = None  # next prompt position to chunk-prefill
+        self.sampler = None     # StreamSampler when the stream samples
 
 
 class DecodeEngine:
@@ -256,7 +287,8 @@ class DecodeEngine:
                  num_blocks=None, max_prompt_len=16, max_new_tokens=32,
                  max_queue=64, scheduling="continuous", width_blocks=None,
                  warmup=True, breaker_threshold=5, breaker_backoff_ms=50.0,
-                 breaker_max_backoff_ms=2000.0):
+                 breaker_max_backoff_ms=2000.0, prefill_chunk=None,
+                 prefix_cache=False, spec_k=0, draft_model=None):
         if scheduling not in ("continuous", "static"):
             raise ValueError("scheduling must be 'continuous' or 'static'")
         self.name = name
@@ -266,11 +298,37 @@ class DecodeEngine:
         self.max_prompt_len = int(max_prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self._max_queue = int(max_queue)
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        self.prefix_cache = bool(prefix_cache)
+        self.spec_k = int(spec_k)
+        self.draft = draft_model
+        if self.prefill_chunk is not None:
+            if self.prefill_chunk <= 0 \
+                    or self.prefill_chunk % int(block_size):
+                raise ValueError("prefill_chunk must be a positive multiple "
+                                 "of block_size, got %r" % (prefill_chunk,))
+        if self.prefix_cache and self.prefill_chunk is None:
+            raise ValueError("prefix_cache requires prefill_chunk (shared "
+                             "prefixes attach at chunk boundaries)")
+        if (self.spec_k > 0) != (draft_model is not None):
+            raise ValueError("speculative decoding needs both spec_k > 0 "
+                             "and a draft_model")
+        if self.spec_k > 0 and self.prefill_chunk is None:
+            raise ValueError("speculative decoding requires prefill_chunk "
+                             "(the draft prefills through the chunk path)")
         max_total = self.max_prompt_len + self.max_new_tokens
         if max_total > model.max_len:
             raise ValueError(
                 "max_prompt_len + max_new_tokens = %d exceeds the model's "
                 "max_len %d" % (max_total, model.max_len))
+        if draft_model is not None:
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError("draft vocab %d != target vocab %d"
+                                 % (draft_model.vocab_size,
+                                    model.vocab_size))
+            if max_total > draft_model.max_len:
+                raise ValueError("draft max_len %d cannot cover %d tokens"
+                                 % (draft_model.max_len, max_total))
         # width ladder: page-table columns per decode signature.
         # ``width_blocks`` overrides the powers-of-2 default — e.g.
         # ``[engine.worst_case_width(...)]`` trades the narrow-width fast
@@ -278,6 +336,11 @@ class DecodeEngine:
         # per-step cost; tools/serve_bench.py does exactly that)
         max_width = self.worst_case_width(self.max_prompt_len,
                                           self.max_new_tokens, block_size)
+        if self.spec_k > 0:
+            # the draft's unrolled proposals write up to spec_k positions
+            # past the committed cursor; the table must index them without
+            # clamping into a neighbor's entry
+            max_width += -(-self.spec_k // int(block_size))
         self._width_ladder = BucketLadder(max_width, width_blocks)
         if self._width_ladder.max_batch < max_width:
             raise ValueError("width_blocks %r cannot cover a worst-case "
@@ -301,6 +364,25 @@ class DecodeEngine:
                            on_retry=lambda exc, i: self.stats.on_retry())
         self._prefill_exec = retry(self._prefill_once)
         self._decode_exec = retry(self._decode_once)
+        self._chunk_cop = self._chunk_exec = None
+        if self.prefill_chunk is not None:
+            self._chunk_cop = CachedOp(self._chunk_forward, self._params)
+            self._chunk_exec = retry(self._chunk_once)
+        self._verify_cop = self._verify_exec = None
+        self._draft_cop = self._draft_exec = None
+        self._draft_chunk_cop = self._draft_chunk_exec = None
+        self._draft_params = None
+        self._dpools = None      # [draft k_pool, draft v_pool], worker-only
+        if self.spec_k > 0:
+            self._draft_params = draft_model.param_dict()
+            self._verify_cop = CachedOp(self._verify_forward, self._params)
+            self._verify_exec = retry(self._verify_once)
+            self._draft_cop = CachedOp(self._draft_forward,
+                                       self._draft_params)
+            self._draft_exec = retry(self._draft_once)
+            self._draft_chunk_cop = CachedOp(self._draft_chunk_forward,
+                                             self._draft_params)
+            self._draft_chunk_exec = retry(self._draft_chunk_once)
         self.warmup_report = None
         if warmup:
             self.warmup()
@@ -366,6 +448,96 @@ class DecodeEngine:
                 nd.array(positions, dtype="int32"),
                 nd.array(tables, dtype="int32"), k_pool, v_pool)
 
+    # chunked prefill / speculative forwards: every one a FIXED shape —
+    # [1, C] chunk, [S, K+1] verify, [S] draft — so turning the features
+    # on adds a handful of warm signatures, never a steady-state compile
+    def _chunk_forward(self, params, tokens, start, length, table, k_pool,
+                       v_pool):
+        from ...ndarray import NDArray
+        p = {n: a._data for n, a in params.items()}
+        logits, kp, vp = self.model.chunk_prefill_fn(
+            p, tokens._data, start._data, length._data, table._data,
+            k_pool._data, v_pool._data)
+        return [NDArray(logits), NDArray(kp), NDArray(vp)]
+
+    def _chunk_once(self, tokens, start, length, table, k_pool, v_pool):
+        from ... import ndarray as nd
+        faults.fault_point("serving.predict", model=self.name)
+        with autograd.pause():
+            return self._chunk_cop(
+                self._params, nd.array(tokens, dtype="int32"),
+                nd.array(start, dtype="int32"),
+                nd.array(length, dtype="int32"),
+                nd.array(table, dtype="int32"), k_pool, v_pool)
+
+    def _verify_forward(self, params, tokens, positions, valids, tables,
+                        k_pool, v_pool):
+        from ...ndarray import NDArray
+        p = {n: a._data for n, a in params.items()}
+        logits, kp, vp = self.model.verify_fn(
+            p, tokens._data, positions._data, valids._data, tables._data,
+            k_pool._data, v_pool._data)
+        return [NDArray(logits), NDArray(kp), NDArray(vp)]
+
+    def _verify_once(self, tokens, positions, valids, tables, k_pool,
+                     v_pool):
+        from ... import ndarray as nd
+        faults.fault_point("serving.predict", model=self.name)
+        with autograd.pause():
+            return self._verify_cop(
+                self._params, nd.array(tokens, dtype="int32"),
+                nd.array(positions, dtype="int32"),
+                nd.array(valids, dtype="int32"),
+                nd.array(tables, dtype="int32"), k_pool, v_pool)
+
+    def _draft_forward(self, params, tokens, positions, tables, k_pool,
+                       v_pool):
+        from ...ndarray import NDArray
+        p = {n: a._data for n, a in params.items()}
+        props, kp, vp = self.draft.propose_fn(
+            p, tokens._data, positions._data, tables._data, k_pool._data,
+            v_pool._data, self.spec_k)
+        return [NDArray(props), NDArray(kp), NDArray(vp)]
+
+    def _draft_once(self, tokens, positions, tables, k_pool, v_pool):
+        from ... import ndarray as nd
+        faults.fault_point("serving.predict", model=self.name)
+        with autograd.pause():
+            return self._draft_cop(
+                self._draft_params, nd.array(tokens, dtype="int32"),
+                nd.array(positions, dtype="int32"),
+                nd.array(tables, dtype="int32"), k_pool, v_pool)
+
+    def _draft_chunk_forward(self, params, tokens, start, length, table,
+                             k_pool, v_pool):
+        from ...ndarray import NDArray
+        p = {n: a._data for n, a in params.items()}
+        logits, kp, vp = self.draft.chunk_prefill_fn(
+            p, tokens._data, start._data, length._data, table._data,
+            k_pool._data, v_pool._data)
+        return [NDArray(logits), NDArray(kp), NDArray(vp)]
+
+    def _draft_chunk_once(self, tokens, start, length, table, k_pool,
+                          v_pool):
+        from ... import ndarray as nd
+        faults.fault_point("serving.predict", model=self.name)
+        with autograd.pause():
+            return self._draft_chunk_cop(
+                self._draft_params, nd.array(tokens, dtype="int32"),
+                nd.array(start, dtype="int32"),
+                nd.array(length, dtype="int32"),
+                nd.array(table, dtype="int32"), k_pool, v_pool)
+
+    def _draft_pools(self):
+        """Fresh zeroed draft-model K/V pools (same block grid as the
+        target pools, draft head geometry)."""
+        from ... import ndarray as nd
+        shape = (self.draft.num_layers, self._cache.num_blocks,
+                 self._cache.block_size, self.draft.num_heads,
+                 self.draft.head_dim)
+        return [nd.zeros(shape, dtype="float32"),
+                nd.zeros(shape, dtype="float32")]
+
     # -- warmup ----------------------------------------------------------
     def warmup(self):
         """Precompile every prefill (prompt bucket) and decode (width
@@ -375,20 +547,50 @@ class DecodeEngine:
         k_pool, v_pool = self._cache.init_pools()
         max_w = self._width_ladder.max_batch
         n = 0
-        for lb in self._prompt_ladder:
-            toks = np.zeros((1, lb), np.int32)
-            outs = self._prefill_exec(toks, np.ones((1,), np.int32),
-                                      np.zeros((1, max_w), np.int32),
-                                      k_pool, v_pool)
+        if self.prefill_chunk is not None:
+            # one chunk signature replaces the whole prompt ladder
+            outs = self._chunk_exec(
+                np.zeros((1, self.prefill_chunk), np.int32),
+                np.zeros((1,), np.int32), np.ones((1,), np.int32),
+                np.zeros((1, max_w), np.int32), k_pool, v_pool)
             k_pool, v_pool = outs[1], outs[2]
             n += 1
-        for w in self._width_ladder:
-            outs = self._decode_exec(np.zeros((self.max_slots,), np.int32),
-                                     np.zeros((self.max_slots,), np.int32),
-                                     np.zeros((self.max_slots, w), np.int32),
-                                     k_pool, v_pool)
+        else:
+            for lb in self._prompt_ladder:
+                toks = np.zeros((1, lb), np.int32)
+                outs = self._prefill_exec(toks, np.ones((1,), np.int32),
+                                          np.zeros((1, max_w), np.int32),
+                                          k_pool, v_pool)
+                k_pool, v_pool = outs[1], outs[2]
+                n += 1
+        if self.spec_k > 0:
+            # spec engines decode through ONE verify + ONE draft signature
+            dk, dv = self._draft_pools()
+            outs = self._verify_exec(
+                np.zeros((self.max_slots, self.spec_k + 1), np.int32),
+                np.zeros((self.max_slots,), np.int32),
+                np.zeros((self.max_slots,), np.int32),
+                np.zeros((self.max_slots, max_w), np.int32),
+                k_pool, v_pool)
             k_pool, v_pool = outs[1], outs[2]
-            n += 1
+            outs = self._draft_exec(
+                np.zeros((self.max_slots,), np.int32),
+                np.zeros((self.max_slots,), np.int32),
+                np.zeros((self.max_slots, max_w), np.int32), dk, dv)
+            self._draft_chunk_exec(
+                np.zeros((1, self.prefill_chunk), np.int32),
+                np.zeros((1,), np.int32), np.ones((1,), np.int32),
+                np.zeros((1, max_w), np.int32), outs[1], outs[2])
+            n += 3
+        else:
+            for w in self._width_ladder:
+                outs = self._decode_exec(
+                    np.zeros((self.max_slots,), np.int32),
+                    np.zeros((self.max_slots,), np.int32),
+                    np.zeros((self.max_slots, w), np.int32),
+                    k_pool, v_pool)
+                k_pool, v_pool = outs[1], outs[2]
+                n += 1
         after = self.cache_stats()
         self.warmup_report = {
             "signatures": n,
@@ -399,14 +601,23 @@ class DecodeEngine:
 
     # -- admission (client threads) --------------------------------------
     def submit(self, prompt, max_new_tokens=None, timeout_ms=None,
-               on_token=None, owner=None):
+               on_token=None, owner=None, temperature=0.0, top_k=0,
+               top_p=1.0, seed=None):
         """Submit one generation request; always returns a DecodeStream.
 
         Rejections come back already terminal (OVERLOADED when the queue
         or the KV block pool cannot take the stream, INVALID_INPUT for a
-        prompt outside the menu, UNAVAILABLE when the breaker is open or
-        the engine is stopped or draining) — callers branch on ``status``,
-        never on exceptions, exactly like ModelServer.predict.
+        prompt outside the menu or sampling options out of range,
+        UNAVAILABLE when the breaker is open or the engine is stopped or
+        draining) — callers branch on ``status``, never on exceptions,
+        exactly like ModelServer.predict.
+
+        ``temperature``/``top_k``/``top_p``/``seed`` select seeded
+        host-side sampling (sampling.py); the defaults are greedy and
+        bit-identical to the pre-sampling engine.  An explicit ``seed``
+        makes the stream replay the same tokens on any engine with the
+        same params — the chaos harness and the sequential oracle lean on
+        that.
 
         ``owner`` is the router's fencing token: it is installed on the
         stream before admission and presented on every emission/terminal
@@ -417,6 +628,23 @@ class DecodeEngine:
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
         try:
+            sampling = SamplingParams(temperature, top_k, top_p, seed)
+        except ValueError as exc:
+            stream = DecodeStream(None, max_new_tokens, deadline,
+                                  stats=self.stats, on_token=on_token)
+            self.stats.on_invalid()
+            stream.complete(INVALID_INPUT, error=str(exc))
+            return stream
+        if sampling.greedy and sampling.seed is None:
+            sampling = None
+        elif sampling.seed is None:
+            # resolve on the CALLER's thread: the framework key state is
+            # thread-local, so deriving here keeps the stream reproducible
+            # under the caller's mx.random.seed (the worker thread's state
+            # is unrelated)
+            from .sampling import resolve_seed
+            sampling.seed = resolve_seed(sampling)
+        try:
             prompt = self._coerce_prompt(prompt)
         except (TypeError, ValueError) as exc:
             stream = DecodeStream(None, max_new_tokens, deadline,
@@ -425,7 +653,8 @@ class DecodeEngine:
             stream.complete(INVALID_INPUT, error=str(exc))
             return stream
         stream = DecodeStream(prompt, int(max_new_tokens), deadline,
-                              stats=self.stats, on_token=on_token)
+                              stats=self.stats, on_token=on_token,
+                              sampling=sampling)
         if owner is not None:
             stream.set_owner(owner)
         with self._cond:
@@ -547,6 +776,8 @@ class DecodeEngine:
 
     def _run_loop(self):  # mxflow: hot (decode prefill/step loop)
         k_pool, v_pool = self._cache.init_pools()
+        if self.spec_k > 0 and self._dpools is None:
+            self._dpools = self._draft_pools()
         while True:
             with self._cond:
                 # idle only when queue AND slots are empty — nothing whose
@@ -573,13 +804,19 @@ class DecodeEngine:
                 if seq.snap is not None:
                     k_pool, v_pool = self._resume_imported(seq, k_pool,
                                                            v_pool)
-                else:
+                elif self.prefill_chunk is None:
                     k_pool, v_pool = self._prefill(seq.stream, k_pool,
                                                    v_pool)
+                # chunked joiners advance below, one chunk per iteration
+            if self.prefill_chunk is not None:
+                k_pool, v_pool = self._advance_prefill(k_pool, v_pool)
             with self._cond:
                 has_live = any(self._slots)
             if has_live:
-                k_pool, v_pool = self._step(k_pool, v_pool)
+                if self.spec_k > 0:
+                    k_pool, v_pool = self._spec_step(k_pool, v_pool)
+                else:
+                    k_pool, v_pool = self._step(k_pool, v_pool)
 
     def _expire(self):
         """TIMEOUT queued and live streams whose deadline passed."""
@@ -627,16 +864,33 @@ class DecodeEngine:
                 if free_slot is None or not self._queue:
                     break
                 entry = self._queue[0]
+                res = None
                 if entry.snap is None:
                     blocks = self._cache.blocks_for_tokens(
                         len(entry.stream.prompt)
                         + entry.stream.max_new_tokens)
-                    if not self._cache.reserve(entry.stream.seq_id, blocks):
+                    if self.prefix_cache:
+                        res = self._cache.reserve(
+                            entry.stream.seq_id, blocks,
+                            prompt=entry.stream.prompt,
+                            align_tokens=self.prefill_chunk)
+                    else:
+                        res = self._cache.reserve(entry.stream.seq_id,
+                                                  blocks)
+                    if not res:
                         break   # head waits for finishing sequences' blocks
                 # imported entries pre-reserved at import_stream time
                 self._queue.popleft()
                 seq = _Seq(entry.stream, gen=entry.gen, snap=entry.snap)
+                if entry.snap is None and self.prefill_chunk is not None:
+                    # chunked prompts join mid-prefill: one chunk per
+                    # scheduler iteration, decode steps interleaved
+                    seq.prefill_pos = getattr(res, "prefix_tokens", 0)
+                if entry.snap is None and entry.stream.sampling is not None:
+                    seq.sampler = StreamSampler(entry.stream.sampling)
                 self._slots[free_slot] = seq
+            if self.prefix_cache and entry.snap is None:
+                self.stats.on_prefix(getattr(res, "shared_blocks", 0))
             joined.append(seq)
         return joined
 
@@ -693,7 +947,7 @@ class DecodeEngine:
             return k_pool, v_pool
         self.breaker.on_success()
         logits = outs[0].asnumpy()[0]  # mxflow: sync-ok(ttft token fetch: the first sampled token must reach the host to stream it)
-        token = int(np.argmax(logits))
+        token = self._select_token(seq, logits)
         seq.position = len(prompt)
         seq.cur_token = token
         seq.generated = 1
@@ -709,6 +963,110 @@ class DecodeEngine:
         self._maybe_finish(seq, token)
         self.stats.on_idle(self._live_count(), self._cache.used())
         return outs[1], outs[2]
+
+    def _select_token(self, seq, logits_row):
+        """Next token from a host logits row: argmax, or the stream's
+        seeded sampler (sampling.py) — host-side either way, so the
+        compiled kernels are identical for greedy and sampled streams."""
+        if seq.sampler is None:
+            return int(np.argmax(logits_row))
+        return seq.sampler.sample(logits_row)
+
+    def _cow_pages(self, seq, first_pos, last_pos, k_pool, v_pool):
+        """Copy-on-write guard for a write to positions [first, last]:
+        fork every shared block covering them (cache swaps the table
+        entry; we copy the device pages so the fork starts bit-identical
+        to the shared original).  Draft pools fork the same block ids —
+        the draft pool is indexed by the target's page table."""
+        from ...ndarray import NDArray
+        bs = self._cache.block_size
+        for idx in range(int(first_pos) // bs, int(last_pos) // bs + 1):
+            blk, src = self._cache.writable(seq.seq_id, idx)
+            if src is None:
+                continue
+            k_pool = NDArray(k_pool._data.at[:, blk].set(
+                k_pool._data[:, src]))
+            v_pool = NDArray(v_pool._data.at[:, blk].set(
+                v_pool._data[:, src]))
+            if self._dpools is not None:
+                dk, dv = self._dpools
+                self._dpools = [
+                    NDArray(dk._data.at[:, blk].set(dk._data[:, src])),
+                    NDArray(dv._data.at[:, blk].set(dv._data[:, src]))]
+            self.stats.on_cow_fork()
+        return k_pool, v_pool
+
+    def _advance_prefill(self, k_pool, v_pool):
+        """Run ONE prompt chunk for the oldest mid-prefill stream.
+
+        One chunk per scheduler iteration is the interleave: a long
+        prompt's chunks alternate with decode steps for live streams, so
+        their inter-token latency (and queued streams' TTFT) no longer
+        spikes behind it.  Every chunk is the same ``[1, C]`` signature —
+        prefix-cache hits just start the loop at the first unshared
+        chunk."""
+        with self._cond:
+            pending = [s for s in self._slots
+                       if s is not None and s.prefill_pos is not None]
+        if not pending:
+            return k_pool, v_pool
+        seq = min(pending, key=lambda s: s.seq_id)
+        stream = seq.stream
+        prompt = stream.prompt
+        L = len(prompt)
+        C = self.prefill_chunk
+        s0 = seq.prefill_pos
+        n = min(C, L - s0)
+        self._cache.ensure_capacity(seq.seq_id, s0 + n)
+        if self.prefix_cache:
+            k_pool, v_pool = self._cow_pages(seq, s0, s0 + n - 1,
+                                             k_pool, v_pool)
+        max_w = self._width_ladder.max_batch
+        table = np.asarray([self._cache.table(seq.seq_id, max_w)], np.int32)
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :n] = prompt[s0:s0 + n]
+        start = np.asarray([s0], np.int32)
+        length = np.asarray([n], np.int32)
+        try:
+            outs = self._chunk_exec(toks, start, length, table, k_pool,
+                                    v_pool)
+            if self.spec_k > 0:
+                dk, dv = self._dpools
+                douts = self._draft_chunk_exec(toks, start, length, table,
+                                               dk, dv)
+                self._dpools = [douts[1], douts[2]]
+        except Exception as exc:
+            self.breaker.on_failure()
+            with self._cond:
+                for i, cand in enumerate(self._slots):
+                    if cand is seq:
+                        self._slots[i] = None
+            self._vacate(seq, ERROR, error=repr(exc))
+            return k_pool, v_pool
+        self.breaker.on_success()
+        k_pool, v_pool = outs[1], outs[2]
+        if s0 + n < L:
+            seq.prefill_pos = s0 + n
+            return k_pool, v_pool
+        # final chunk: the prompt's K/V is complete — publish it for
+        # cross-request reuse, then emit the TTFT token
+        seq.prefill_pos = None
+        if self.prefix_cache:
+            self._cache.register_prefix(seq.seq_id, prompt)
+        logits = outs[0].asnumpy()[0]  # mxflow: sync-ok(ttft token fetch: the first sampled token must reach the host to stream it)
+        token = self._select_token(seq, logits)
+        seq.position = L
+        seq.cur_token = token
+        seq.generated = 1
+        stream._emit(token, owner=seq.gen)
+        _, _, ttft, _, _ = stream.snapshot()
+        if ttft is None:        # emit raced a terminal claim
+            ttft = (time.monotonic() - stream.t_submit) * 1e3
+        self.stats.on_prefill(ttft)
+        self.stats.on_tokens(1)
+        self._maybe_finish(seq, token)
+        self.stats.on_idle(self._live_count(), self._cache.used())
+        return k_pool, v_pool
 
     def _maybe_finish(self, seq, token):
         """OK-complete a sequence that hit EOS or its token budget."""
@@ -731,13 +1089,18 @@ class DecodeEngine:
         """One fixed-shape decode iteration over every live slot."""
         with self._cond:
             slots = list(self._slots)
-        live = [seq for seq in slots if seq is not None]
+        live = [seq for seq in slots
+                if seq is not None and seq.prefill_pos is None]
         if not live:
             return k_pool, v_pool
         # lazily grow page tables to cover this step's write index, then
         # pick the smallest precompiled width covering the longest one
         for seq in live:
             self._cache.ensure_capacity(seq.seq_id, seq.position + 1)
+            if self.prefix_cache:
+                k_pool, v_pool = self._cow_pages(seq, seq.position,
+                                                 seq.position, k_pool,
+                                                 v_pool)
         max_tokens = max(seq.position + 1 for seq in live)
         width = self._width_ladder.bucket(
             self._cache.blocks_for_tokens(max_tokens))
@@ -745,7 +1108,7 @@ class DecodeEngine:
         positions = np.zeros((self.max_slots,), np.int32)
         tables = np.zeros((self.max_slots, width), np.int32)
         for i, seq in enumerate(slots):
-            if seq is None:
+            if seq is None or seq.prefill_pos is not None:
                 continue
             tokens[i] = seq.cur_token
             positions[i] = seq.position
@@ -762,12 +1125,12 @@ class DecodeEngine:
         logits = outs[0].asnumpy()  # mxflow: sync-ok(per-step token fetch: sampled ids must reach the host to stream)
         emitted = 0
         for i, seq in enumerate(slots):
-            if seq is None:
+            if seq is None or seq.prefill_pos is not None:
                 continue
             with self._cond:
                 if self._slots[i] is not seq:
                     continue     # vacated mid-step (teardown race)
-            token = int(np.argmax(logits[i]))
+            token = self._select_token(seq, logits[i])
             seq.position += 1
             seq.cur_token = token
             seq.generated += 1
@@ -775,6 +1138,104 @@ class DecodeEngine:
             emitted += 1
             self._maybe_finish(seq, token)
         self.stats.on_step(len(live), emitted,
+                           (time.monotonic() - t0) * 1e3,
+                           self._cache.used())
+        return outs[1], outs[2]
+
+    def _spec_step(self, k_pool, v_pool):  # mxflow: hot (speculative verify loop)
+        """One speculative round: draft proposes K tokens in one unrolled
+        call, ONE paged verify call scores all K+1 positions, and every
+        live slot commits the longest prefix where the draft agrees with
+        the target — up to K+1 tokens for two dispatches.
+
+        Emitted tokens come exclusively from the target's logits rows
+        (row i is the target's distribution after the first i+1 round
+        tokens), so the committed sequence is the target's greedy chain
+        no matter what the draft proposed: wrong, stale, or cold draft
+        state only lowers the acceptance rate.  Sampled slots use one
+        valid row and draw from row 0 — one seeded host draw per token,
+        same replay contract as the non-speculative path."""
+        with self._cond:
+            slots = list(self._slots)
+        live = [seq for seq in slots
+                if seq is not None and seq.prefill_pos is None]
+        if not live:
+            return k_pool, v_pool
+        K1 = self.spec_k + 1
+        width = self._width_ladder.max_batch
+        valid_by = {}
+        for seq in live:
+            rem = seq.stream.max_new_tokens - seq.generated
+            v = 1 if seq.sampler is not None else max(1, min(K1, rem))
+            valid_by[id(seq)] = v
+            # verify writes K/V for every valid row; rows past the budget
+            # are invalid (trash block), so capacity never exceeds the
+            # admission reservation
+            self._cache.ensure_capacity(seq.seq_id, seq.position + v)
+            if self.prefix_cache:
+                k_pool, v_pool = self._cow_pages(
+                    seq, seq.position, seq.position + v - 1, k_pool, v_pool)
+        tokens = np.zeros((self.max_slots, K1), np.int32)
+        positions = np.zeros((self.max_slots,), np.int32)
+        valids = np.zeros((self.max_slots,), np.int32)
+        tables = np.zeros((self.max_slots, width), np.int32)
+        cur = np.zeros((self.max_slots,), np.int32)
+        for i, seq in enumerate(slots):
+            if seq is None or seq.prefill_pos is not None:
+                continue
+            positions[i] = seq.position
+            valids[i] = valid_by[id(seq)]
+            tables[i] = self._cache.table(seq.seq_id, width)
+            cur[i] = seq.cur_token
+        t0 = time.monotonic()
+        try:
+            dk, dv = self._dpools
+            douts = self._draft_exec(cur, positions, tables, dk, dv)
+            self._dpools = [douts[1], douts[2]]
+            props = douts[0].asnumpy()  # mxflow: sync-ok(draft proposals feed the verify call's token rows)
+            tokens[:, 0] = cur
+            tokens[:, 1:] = props
+            outs = self._verify_exec(tokens, positions, valids, tables,
+                                     k_pool, v_pool)
+        except Exception as exc:
+            self.breaker.on_failure()
+            self._fail_all(exc)
+            return k_pool, v_pool
+        self.breaker.on_success()
+        logits = outs[0].asnumpy()  # mxflow: sync-ok(per-round token fetch: accepted ids must reach the host to stream)
+        emitted_total = 0
+        eos = getattr(self.model, "eos_id", None)
+        for i, seq in enumerate(slots):
+            if seq is None or seq.prefill_pos is not None:
+                continue
+            with self._cond:
+                if self._slots[i] is not seq:
+                    continue     # vacated mid-round (teardown race)
+            v = int(valids[i])
+            rows = logits[i]
+            emitted = []
+            j = 0
+            while True:
+                tok = self._select_token(seq, rows[j])
+                emitted.append(tok)
+                if eos is not None and tok == eos:
+                    break
+                if j >= v - 1:
+                    break        # last valid row consumed
+                if int(tokens[i, j + 1]) != tok:
+                    break        # draft diverged: later rows scored the
+                                 # wrong token chain
+                j += 1
+            if seq.sampler is None and v > 1:
+                self.stats.on_spec(v - 1, len(emitted) - 1)
+            for tok in emitted:
+                seq.position += 1
+                seq.generated += 1
+                seq.cur_token = tok
+                seq.stream._emit(tok, owner=seq.gen)
+            emitted_total += len(emitted)
+            self._maybe_finish(seq, emitted[-1])
+        self.stats.on_step(len(live), emitted_total,
                            (time.monotonic() - t0) * 1e3,
                            self._cache.used())
         return outs[1], outs[2]
@@ -791,9 +1252,19 @@ class DecodeEngine:
         from ...ndarray import NDArray
         snap = seq.snap
         seq.snap = None
+        samp = snap.get("sampling")
+        if samp is not None:
+            params = SamplingParams(samp["temperature"], samp["top_k"],
+                                    samp["top_p"], samp["seed"])
+            seq.stream.sampling = params
+            seq.sampler = StreamSampler.restore(params, samp["seed"],
+                                                samp.get("draws", 0))
         if snap["generated"] == 0 or snap.get("k") is None:
             # exported before its prefill ran: nothing to restore — run
             # the normal prompt path on this engine
+            if self.prefill_chunk is not None:
+                seq.prefill_pos = 0
+                return k_pool, v_pool
             return self._prefill(seq.stream, k_pool, v_pool)
         position = int(snap["position"])
         self._cache.ensure_capacity(seq.seq_id, position)
@@ -887,6 +1358,16 @@ class DecodeEngine:
             "head_dim": self.model.head_dim,
             "vocab_size": self.model.vocab_size,
         }
+        sampling = None
+        if stream.sampling is not None:
+            sampling = stream.sampling.as_dict()
+            if seq is not None and seq.sampler is not None:
+                # effective seed + draws so far: the importer rebuilds the
+                # RandomState and burns the draws, continuing the exact
+                # uniform sequence this stream would have used here
+                sampling.update(seq.sampler.state())
+            else:
+                sampling.setdefault("draws", 0)
         if seq is not None and seq.snap is not None:
             # imported here but never resumed: re-export the snapshot
             snap = dict(seq.snap)
@@ -907,6 +1388,7 @@ class DecodeEngine:
                 "generated": int(seq.generated),
                 "k": k_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(quiesced drain: K pages leave the device once per handoff)
                 "v": v_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(quiesced drain: V pages leave the device once per handoff)
+                "sampling": sampling,
             }
         else:
             # still queued (or joined but not yet prefilled): no device
@@ -921,6 +1403,7 @@ class DecodeEngine:
                 "generated": 0,
                 "k": None,
                 "v": None,
+                "sampling": sampling,
             }
         self._cache.free_seq(stream.seq_id)
         self.stats.on_handed_off()
@@ -951,8 +1434,14 @@ class DecodeEngine:
                              "%r geometry %r" % (geometry, self.name, mine))
         prompt = np.asarray(snap["prompt"], np.int32)
         if stream is None:
+            sampling = None
+            samp = snap.get("sampling")
+            if samp is not None:
+                sampling = SamplingParams(samp["temperature"],
+                                          samp["top_k"], samp["top_p"],
+                                          samp["seed"])
             stream = DecodeStream(prompt, int(snap["max_new_tokens"]),
-                                  stats=self.stats)
+                                  stats=self.stats, sampling=sampling)
             if owner is not None:
                 stream.set_owner(owner)
             with stream._cond:
@@ -992,7 +1481,11 @@ class DecodeEngine:
             slots_live = sum(1 for s in self._slots if s is not None)
             draining = self._draining or self._closed
         snap = self.stats.snapshot()
+        kv = self._cache.stats()
         return {
+            # available_unreserved counts a page shared by N sequences
+            # ONCE — the fleet's headroom math sees real free blocks, not
+            # N-times-counted shared ones
             "kv_blocks_free": self._cache.available_unreserved(),
             "kv_capacity": self._cache.capacity(),
             "kv_block_size": self._cache.block_size,
@@ -1002,53 +1495,103 @@ class DecodeEngine:
             "max_slots": self.max_slots,
             "tokens_per_s": snap["tokens_per_s"],
             "draining": draining,
+            "prefix_hits": kv["prefix_hits"],
+            "prefix_blocks_shared": kv["prefix_blocks_shared"],
+            "cow_forks": kv["cow_forks"],
         }
 
     # -- reference path ---------------------------------------------------
-    def generate_reference(self, prompt, max_new_tokens=None):
-        """Greedy-decode ``prompt`` one-request-at-a-time, bypassing the
+    def generate_reference(self, prompt, max_new_tokens=None,
+                           temperature=0.0, top_k=0, top_p=1.0, seed=None):
+        """Decode ``prompt`` one-request-at-a-time, bypassing the
         scheduler: fresh private pools, the same CachedOp signatures the
-        live engine dispatches (batch ``[max_slots]`` with one live slot,
-        per-length width buckets).  This is the bitwise reference the
-        acceptance gate compares continuous-batched outputs against."""
+        live engine dispatches (batch ``[max_slots]`` with one live slot).
+        This is the bitwise reference the acceptance gate compares
+        continuous-batched outputs against, so it mirrors the engine's
+        configured kernel path exactly: chunked engines prefill through
+        the same ``[1, C]`` chunk signature, speculative engines decode
+        through the same ``[S, K+1]`` verify signature with ONE valid row
+        per call (sequential — no draft, no speculation; speculation only
+        changes how many of these rows commit per dispatch, never their
+        logits).  Sampling options replay a sampled stream: an explicit
+        ``seed`` makes the output a pure function of the arguments."""
         if max_new_tokens is None:
             max_new_tokens = self.max_new_tokens
         prompt = self._coerce_prompt(prompt)
         problem = self._validate(prompt, int(max_new_tokens))
         if problem is not None:
             raise MXNetError(problem)
-        bs = self._cache.block_size
+        sampler = None
+        params = SamplingParams(temperature, top_k, top_p, seed)
+        if not (params.greedy and params.seed is None):
+            sampler = StreamSampler(params)
+
+        def pick(row):
+            if sampler is None:
+                return int(np.argmax(row))
+            return sampler.sample(row)
+
         k_pool, v_pool = self._cache.init_pools()
         blocks = list(range(1, 1 + self._cache.blocks_for_tokens(
             len(prompt) + int(max_new_tokens))))
         have = self._cache.blocks_for_tokens(len(prompt))
-        lb = self._prompt_ladder.bucket(len(prompt))
-        toks = np.zeros((1, lb), np.int32)
-        toks[0, :len(prompt)] = prompt
         max_w = self._width_ladder.max_batch
-        table = np.zeros((1, max_w), np.int32)
-        table[0, :have] = blocks[:have]
-        outs = self._prefill_exec(toks, np.asarray([len(prompt)], np.int32),
-                                  table, k_pool, v_pool)
-        k_pool, v_pool = outs[1], outs[2]
-        token = int(np.argmax(outs[0].asnumpy()[0]))  # mxflow: sync-ok(reference path: single-stream oracle, correctness over speed)
+        if self.prefill_chunk is not None:
+            C = self.prefill_chunk
+            table = np.zeros((1, max_w), np.int32)
+            table[0, :have] = blocks[:have]
+            outs = None
+            for s0 in range(0, len(prompt), C):
+                n = min(C, len(prompt) - s0)
+                toks = np.zeros((1, C), np.int32)
+                toks[0, :n] = prompt[s0:s0 + n]
+                outs = self._chunk_exec(toks, np.asarray([s0], np.int32),
+                                        np.asarray([n], np.int32), table,
+                                        k_pool, v_pool)
+                k_pool, v_pool = outs[1], outs[2]
+        else:
+            lb = self._prompt_ladder.bucket(len(prompt))
+            toks = np.zeros((1, lb), np.int32)
+            toks[0, :len(prompt)] = prompt
+            table = np.zeros((1, max_w), np.int32)
+            table[0, :have] = blocks[:have]
+            outs = self._prefill_exec(toks,
+                                      np.asarray([len(prompt)], np.int32),
+                                      table, k_pool, v_pool)
+            k_pool, v_pool = outs[1], outs[2]
+        token = pick(outs[0].asnumpy()[0])  # mxflow: sync-ok(reference path: single-stream oracle, correctness over speed)
         out_tokens = [token]
         position = len(prompt)
         eos = getattr(self.model, "eos_id", None)
         while len(out_tokens) < int(max_new_tokens) and token != eos:
             need = self._cache.blocks_for_tokens(position + 1)
             have = max(have, need)
-            width = self._width_ladder.bucket(need)
-            tokens = np.zeros((self.max_slots,), np.int32)
-            positions = np.zeros((self.max_slots,), np.int32)
-            tables = np.zeros((self.max_slots, width), np.int32)
-            tokens[0] = token
-            positions[0] = position
-            tables[0, :have] = blocks[:have]
-            outs = self._decode_exec(tokens, positions, tables, k_pool,
-                                     v_pool)
+            if self.spec_k > 0:
+                K1 = self.spec_k + 1
+                tokens = np.zeros((self.max_slots, K1), np.int32)
+                positions = np.zeros((self.max_slots,), np.int32)
+                valids = np.zeros((self.max_slots,), np.int32)
+                tables = np.zeros((self.max_slots, max_w), np.int32)
+                tokens[0, 0] = token
+                positions[0] = position
+                valids[0] = 1
+                tables[0, :have] = blocks[:have]
+                outs = self._verify_exec(tokens, positions, valids, tables,
+                                         k_pool, v_pool)
+                row = outs[0].asnumpy()[0, 0]  # mxflow: sync-ok(reference path: single-stream oracle, correctness over speed)
+            else:
+                width = self._width_ladder.bucket(need)
+                tokens = np.zeros((self.max_slots,), np.int32)
+                positions = np.zeros((self.max_slots,), np.int32)
+                tables = np.zeros((self.max_slots, width), np.int32)
+                tokens[0] = token
+                positions[0] = position
+                tables[0, :have] = blocks[:have]
+                outs = self._decode_exec(tokens, positions, tables, k_pool,
+                                         v_pool)
+                row = outs[0].asnumpy()[0]  # mxflow: sync-ok(reference path: single-stream oracle, correctness over speed)
             k_pool, v_pool = outs[1], outs[2]
-            token = int(np.argmax(outs[0].asnumpy()[0]))  # mxflow: sync-ok(reference path: single-stream oracle, correctness over speed)
+            token = pick(row)
             out_tokens.append(token)
             position += 1
         return np.asarray(out_tokens, np.int32)
@@ -1059,8 +1602,15 @@ class DecodeEngine:
         decode CachedOps (``prefill|``/``decode|`` key prefixes)."""
         merged = {}
         hits = misses = 0
-        for prefix, cop in (("prefill", self._prefill_cop),
-                            ("decode", self._decode_cop)):
+        pairs = [("prefill", self._prefill_cop),
+                 ("decode", self._decode_cop)]
+        if self.prefill_chunk is not None:
+            pairs.append(("chunk", self._chunk_cop))
+        if self.spec_k > 0:
+            pairs.extend([("verify", self._verify_cop),
+                          ("draft", self._draft_cop),
+                          ("draft_chunk", self._draft_chunk_cop)])
+        for prefix, cop in pairs:
             st = cop.cache_stats()
             for sig, rec in st["signatures"].items():
                 merged["%s|%s" % (prefix, sig)] = dict(rec)

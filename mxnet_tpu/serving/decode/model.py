@@ -25,6 +25,30 @@ data-dependent Python control flow): the engine compiles one CachedOp
 signature per (prompt bucket) and per (table width bucket) and steady-state
 traffic must never add another.
 
+Two optional entry points unlock chunked prefill and speculative decoding
+(the engine falls back to ``prefill_fn``/``decode_fn`` when absent):
+
+* ``chunk_prefill_fn(params, tokens, start, length, table, k_pool,
+  v_pool)`` — one fixed-size prompt chunk: tokens ``[1, C]`` int32, start
+  ``[1]`` int32 (absolute position of the chunk's first token), length
+  ``[1]`` int32 (real tokens in this chunk).  Attends to cache positions
+  ``0..start+i`` through the page table (earlier chunks' K/V is READ from
+  the pool, which is what makes cross-request prefix reuse bitwise-sound),
+  scatters this chunk's K/V, and returns logits for row ``length-1``.
+* ``verify_fn(params, tokens, positions, valids, tables, k_pool,
+  v_pool)`` — the speculative verify step: tokens ``[S, K+1]`` int32 (the
+  committed token followed by K draft proposals), positions ``[S]`` int32
+  (cache index of the first token), valids ``[S]`` int32 (rows beyond
+  ``valids[s]`` write to the trash block and are ignored).  Returns logits
+  ``[S, K+1, V]`` — row ``i`` is the model's next-token distribution after
+  the first ``i+1`` tokens, so the engine accepts the longest prefix where
+  proposal ``i`` equals ``argmax(row i-1)``.
+
+Because a fixed kernel *shape* pins the XLA tiling, all-chunked prefill and
+all-verify decode reproduce the sequential reference bitwise only when the
+reference itself runs through the SAME chunk/verify signatures (one row
+valid at a time).  ``DecodeEngine.generate_reference`` does exactly that.
+
 Exactness contract (the bitwise gate in tests/test_decode.py leans on it):
 dead slots and page-table padding use masks whose excluded weights are
 EXACTLY zero (``exp(-inf) == 0``), and every per-slot computation is
@@ -159,6 +183,122 @@ class TinyCausalLM:
             h = self._mlp(p, l, h)
         logits = _rms(h) @ p["embed"].T
         return logits, k_pool, v_pool
+
+    def chunk_prefill_fn(self, p, tokens, start, length, table, k_pool,
+                         v_pool):
+        """One prompt chunk at absolute positions start..start+C-1.
+
+        Earlier chunks are consumed through the page table (gathered from
+        the pool, not recomputed), so a chunk run on top of another
+        request's shared prefix pages produces bit-identical K/V and
+        logits to a private from-scratch chunked run — the property the
+        copy-on-write prefix cache banks on.
+        """
+        import jax.numpy as jnp
+        bs = k_pool.shape[2]
+        C = tokens.shape[1]
+        W = table.shape[1]
+        T = W * bs
+        t = tokens[0]
+        pos = start[0] + jnp.arange(C)                     # absolute
+        h = p["embed"][t] + p["pos"][jnp.clip(pos, 0, self.max_len - 1)]
+        blk = table[0, pos // bs]
+        off = pos % bs
+        valid = jnp.arange(C) < length[0]
+        blk = jnp.where(valid, blk, 0)                     # pad -> trash
+        # pad rows clamp to position 0 (attend j <= 0): finite garbage,
+        # the same dead-slot discipline as decode_fn.  An all-False mask
+        # row would softmax to NaN and poison the trash block.
+        epos = jnp.where(valid, pos, 0)
+        mask = jnp.arange(T)[None, :] <= epos[:, None]     # [C, T]
+        for l in range(self.num_layers):
+            q, k, v = self._qkv(p, l, _rms(h), C)
+            k_pool = k_pool.at[l, blk, off].set(k)
+            v_pool = v_pool.at[l, blk, off].set(v)
+            kseq = k_pool[l][table[0]].reshape(T, self.num_heads,
+                                               self.head_dim)
+            vseq = v_pool[l][table[0]].reshape(T, self.num_heads,
+                                               self.head_dim)
+            scores = jnp.einsum("ihd,jhd->hij", q, kseq) \
+                / jnp.sqrt(float(self.head_dim)).astype(q.dtype)
+            scores = jnp.where(mask[None], scores, -jnp.inf)
+            w = _softmax(scores)
+            att = jnp.einsum("hij,jhd->ihd", w, vseq).reshape(
+                C, self.hidden)
+            h = h + att @ p["l%d_wo" % l]
+            h = self._mlp(p, l, h)
+        last = _rms(h[length[0] - 1])
+        logits = last @ p["embed"].T
+        return logits[None], k_pool, v_pool
+
+    def verify_fn(self, p, tokens, positions, valids, tables, k_pool,
+                  v_pool):
+        """Speculative verify: K+1 tokens per slot in one fixed-shape call.
+
+        Row ``i`` of slot ``s`` is the committed/proposed token at cache
+        position ``positions[s] + i``; rows at or past ``valids[s]`` write
+        to the trash block and attend position 0 only (finite garbage —
+        see chunk_prefill_fn).  Per-row outputs depend only on that row's
+        token, its position, and masked pool content, so a verify call
+        with one valid row reproduces ``generate_reference`` bitwise and
+        extra proposal rows never perturb the accepted prefix.
+        """
+        import jax.numpy as jnp
+        bs = k_pool.shape[2]
+        S, K1 = tokens.shape
+        W = tables.shape[1]
+        T = W * bs
+        pos = positions[:, None] + jnp.arange(K1)[None, :]   # [S, K1]
+        valid = jnp.arange(K1)[None, :] < valids[:, None]
+        h = p["embed"][tokens] \
+            + p["pos"][jnp.clip(pos, 0, self.max_len - 1)]   # [S, K1, H]
+        blk = jnp.take_along_axis(tables, pos // bs, axis=1)
+        blk = jnp.where(valid, blk, 0)                       # -> trash
+        off = pos % bs
+        epos = jnp.where(valid, pos, 0)
+        mask = jnp.arange(T)[None, None, :] <= epos[:, :, None]
+        for l in range(self.num_layers):
+            x = _rms(h)
+            q = (x @ p["l%d_wq" % l]).reshape(S, K1, self.num_heads,
+                                              self.head_dim)
+            k = (x @ p["l%d_wk" % l]).reshape(S, K1, self.num_heads,
+                                              self.head_dim)
+            v = (x @ p["l%d_wv" % l]).reshape(S, K1, self.num_heads,
+                                              self.head_dim)
+            k_pool = k_pool.at[l, blk, off].set(k)
+            v_pool = v_pool.at[l, blk, off].set(v)
+            kseq = k_pool[l][tables].reshape(S, T, self.num_heads,
+                                             self.head_dim)
+            vseq = v_pool[l][tables].reshape(S, T, self.num_heads,
+                                             self.head_dim)
+            scores = jnp.einsum("sihd,sjhd->shij", q, kseq) \
+                / jnp.sqrt(float(self.head_dim)).astype(q.dtype)
+            scores = jnp.where(mask[:, None, :, :], scores, -jnp.inf)
+            w = _softmax(scores)
+            att = jnp.einsum("shij,sjhd->sihd", w, vseq).reshape(
+                S, K1, self.hidden)
+            h = h + att @ p["l%d_wo" % l]
+            h = self._mlp(p, l, h)
+        logits = _rms(h) @ p["embed"].T                      # [S, K1, V]
+        return logits, k_pool, v_pool
+
+    def propose_fn(self, p, tokens, positions, tables, k_pool, v_pool,
+                   num_tokens):
+        """Greedy draft proposer: ``num_tokens`` unrolled decode steps with
+        the argmax on-device, so one compiled call yields K proposals.
+        ``num_tokens`` is static (baked into the signature).  Returns
+        (proposals ``[S, num_tokens]`` int32, k_pool', v_pool')."""
+        import jax.numpy as jnp
+        cur = tokens
+        pos = positions
+        outs = []
+        for _ in range(int(num_tokens)):
+            logits, k_pool, v_pool = self.decode_fn(
+                p, cur, pos, tables, k_pool, v_pool)
+            cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            outs.append(cur)
+            pos = pos + 1
+        return jnp.stack(outs, axis=1), k_pool, v_pool
 
 
 def _softmax(scores):

@@ -10,6 +10,8 @@ shows the decode loop's occupancy next to op spans:
 * ``<engine>:kv_blocks_free`` — absolute pool headroom (the routing signal)
 * ``<engine>:ttft_ms``        — time-to-first-token of each prefill
 * ``<engine>:tokens_per_s``   — instantaneous decode throughput per step
+* ``<engine>:prefix_blocks_shared`` — KV blocks attached via prefix hits
+* ``<engine>:spec_accept_rate``     — draft tokens accepted / proposed
 
 Conservation contract (the chaos scenario's invariant): ``requests`` counts
 ADMITTED streams and every one of them reaches exactly one terminal
@@ -52,6 +54,12 @@ class DecodeStats:
         self.tokens_per_s = 0.0      # instantaneous, from the last step
         self.handed_off = 0          # admitted, exported to another engine
         self.imported = 0            # admitted via import_stream
+        self.prefix_hits = 0         # admissions that attached shared pages
+        self.prefix_blocks_shared = 0  # blocks attached by those hits
+        self.cow_forks = 0           # shared pages privatized on write
+        self.spec_rounds = 0         # speculative verify dispatches scored
+        self.spec_proposed = 0       # draft tokens offered for verification
+        self.spec_accepted = 0       # draft tokens the target agreed with
         self._ttft = LatencyWindow()
         self._step_ms = LatencyWindow()
         domain = profiler.Domain("serving")
@@ -60,6 +68,10 @@ class DecodeStats:
         self._c_free = domain.new_counter("%s:kv_blocks_free" % engine_name)
         self._c_ttft = domain.new_counter("%s:ttft_ms" % engine_name)
         self._c_tps = domain.new_counter("%s:tokens_per_s" % engine_name)
+        self._c_shared = domain.new_counter(
+            "%s:prefix_blocks_shared" % engine_name)
+        self._c_accept = domain.new_counter(
+            "%s:spec_accept_rate" % engine_name)
 
     # -- event hooks ----------------------------------------------------
     def on_admitted(self):
@@ -125,6 +137,36 @@ class DecodeStats:
             self._c_blocks.set_value(kv_blocks_used)
             self._c_free.set_value(free)
 
+    def on_prefix(self, blocks_shared):
+        """A fresh admission resolved its prompt against the prefix
+        registry: ``blocks_shared`` pages attached without prefill work
+        (0 means the lookup missed — only hits count)."""
+        if blocks_shared <= 0:
+            return
+        with self._lock:
+            self.prefix_hits += 1
+            self.prefix_blocks_shared += blocks_shared
+            shared = self.prefix_blocks_shared
+        if profiler.profiling_active():
+            self._c_shared.set_value(shared)
+
+    def on_cow_fork(self):
+        """A shared page was privatized on first divergent write."""
+        with self._lock:
+            self.cow_forks += 1
+
+    def on_spec(self, proposed, accepted):
+        """One speculative round settled for one greedy slot: ``proposed``
+        draft tokens were verified, ``accepted`` agreed with the target."""
+        with self._lock:
+            self.spec_rounds += 1
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            rate = (self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0)
+        if profiler.profiling_active():
+            self._c_accept.set_value(rate)
+
     def on_handed_off(self):
         """An admitted stream left this engine via ``export_stream`` — it
         terminates elsewhere, so it leaves this engine's conservation set
@@ -176,6 +218,14 @@ class DecodeStats:
                 "tokens_per_s": self.tokens_per_s,
                 "handed_off": self.handed_off,
                 "imported": self.imported,
+                "prefix_hits": self.prefix_hits,
+                "prefix_blocks_shared": self.prefix_blocks_shared,
+                "cow_forks": self.cow_forks,
+                "spec_rounds": self.spec_rounds,
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "spec_accept_rate": (self.spec_accepted / self.spec_proposed
+                                     if self.spec_proposed else 0.0),
                 "ttft_ms": self._ttft.percentiles(ps=(50, 95, 99)),
                 "step_ms": self._step_ms.percentiles(ps=(50, 95, 99)),
             }

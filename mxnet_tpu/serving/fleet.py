@@ -596,7 +596,8 @@ class FleetRouter:
 
     # mxflow: hot (stream routing path)
     def submit_stream(self, name, prompt, max_new_tokens=None,
-                      timeout_ms=None, tenant=None, on_token=None):
+                      timeout_ms=None, tenant=None, on_token=None,
+                      temperature=0.0, top_k=0, top_p=1.0, seed=None):
         """Admit one generation stream into the fleet; always returns a
         DecodeStream (rejections come back already terminal, same status
         discipline as ``DecodeEngine.submit``).
@@ -686,7 +687,8 @@ class FleetRouter:
                 continue
             s = eng.submit(prompt, max_new_tokens=max_new_tokens,
                            timeout_ms=timeout_ms, on_token=on_token,
-                           owner=owner)
+                           owner=owner, temperature=temperature,
+                           top_k=top_k, top_p=top_p, seed=seed)
             if s.admitted:
                 breaker.on_success()
                 stream = s
@@ -1413,6 +1415,16 @@ class FleetRouter:
             engines_out.setdefault(name, {})[rid] = eng.stats_snapshot()
         out["engines"] = engines_out
         out["decode"] = self.decode_stats.snapshot()
+        # fleet-wide prefix-cache / speculation rollup (headroom math
+        # already counts shared pages once via each engine's
+        # available_unreserved signal)
+        roll = {"prefix_hits": 0, "prefix_blocks_shared": 0,
+                "cow_forks": 0, "spec_proposed": 0, "spec_accepted": 0}
+        for per_model in engines_out.values():
+            for snap in per_model.values():
+                for key in roll:
+                    roll[key] += snap.get(key, 0)
+        out["decode"]["prefix_spec"] = roll
         out["tenants"] = self.tenant_snapshot()
         return out
 

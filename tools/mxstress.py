@@ -24,8 +24,13 @@ storm: drained streams hand off (prefix + KV pages, lease-generation
 fenced) to survivors and stay bitwise-equal to the uninterrupted
 reference, killed streams terminate UNAVAILABLE with valid prefixes,
 router/engine/tenant counters conserve, KV pools stay whole on survivors,
-and no tenant starves.  Exit code is non-zero iff any seed violated any
-invariant.
+and no tenant starves.  The ``decode_prefix`` scenario storms chunked +
+prefix-cached + speculative engines with shared-prefix prompts (greedy
+and seeded sampled) while one replica drains mid-run: migrated streams
+carry refcounted shared KV pages and sampler state, outputs stay bitwise
+equal to their references, pools drain whole, the prefix-hit/CoW-fork/
+speculation counters advance, and nothing recompiles.  Exit code is
+non-zero iff any seed violated any invariant.
 
 Usage:
   python tools/mxstress.py --smoke              # 25 fixed seeds, <=20 s
