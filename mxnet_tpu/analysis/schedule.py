@@ -84,14 +84,22 @@ Invariants asserted (per seed)
   pages + sampler state), KV pools drain whole with zero leaks, the
   prefix-hit / CoW-fork / speculation counters demonstrably advance, and
   nothing recompiles (see ``decode_prefix_storm``).
+* **sharded decode storm** (``sharded_decode``) — greedy and seeded
+  sampled streams over tensor-parallel mesh-backed engines
+  (``ShardedDecodeModel(tp=2)``, head-sharded K/V pools) while one
+  replica drains mid-run: the sharded→sharded handoff keeps OK streams
+  bitwise-equal to the SINGLE-DEVICE reference, every engine's pool
+  drains whole on every shard (host accounting + tp_degree signals),
+  router/engine conservation holds, and the warmed shard_map signatures
+  never recompile (see ``sharded_decode_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
 and ``tests/test_faults.py``/``tests/test_fleet.py``/
-``tests/test_decode_fleet.py``/``tests/test_decode_prefix.py`` gate the
-fault-driven scenarios (``faults``, ``crash``, ``fleet``,
-``decode_fleet``, ``decode_prefix``) on the smaller
-``FAULT_SMOKE_SEEDS`` set.
+``tests/test_decode_fleet.py``/``tests/test_decode_prefix.py``/
+``tests/test_sharded_decode.py`` gate the fault-driven scenarios
+(``faults``, ``crash``, ``fleet``, ``decode_fleet``, ``decode_prefix``,
+``sharded_decode``) on the smaller ``FAULT_SMOKE_SEEDS`` set.
 """
 from __future__ import annotations
 
@@ -1911,11 +1919,314 @@ def decode_prefix_storm(router, name, prompts, refs, sam_refs, seed):
 
 
 # ---------------------------------------------------------------------------
+# scenario: tensor-parallel sharded decode storm (sharded_decode)
+# ---------------------------------------------------------------------------
+
+_DSHARD_PROMPTS = ((5, 3, 7, 1), (2, 6, 4), (9, 8, 1, 2, 3), (7, 7),
+                   (1, 2, 3, 4, 5, 6))
+_DSHARD_MAX_NEW = 5
+_DSHARD_TEMP = 0.8
+_DSHARD_TOPK = 6
+_DSHARD_SEED0 = 11000   # sampled stream of prompt i uses seed 11000 + i
+
+
+def _build_sharded_decode_fixture():
+    """-> (router, engine_name, prompts, greedy_refs, sampled_refs).
+
+    Two replicas, each hosting a DecodeEngine over
+    ``ShardedDecodeModel(tp=2)`` — head-sharded K/V pools, gathered
+    compute — declared ``tp=2`` to the router so the device-footprint
+    accounting is live under the storm.  The references come from an
+    UNSHARDED engine over the same seeded weights: the scenario's bitwise
+    claim is sharded-vs-single-device, held across a mid-storm
+    sharded→sharded handoff."""
+    from ..serving.decode import (DecodeEngine, ShardedDecodeModel,
+                                  TinyCausalLM)
+    from ..serving.fleet import FleetRouter
+
+    model_kw = dict(vocab_size=24, hidden=16, num_layers=1, num_heads=2,
+                    max_len=24, seed=17)
+    engine_kw = dict(max_slots=2, block_size=4, num_blocks=20,
+                     max_prompt_len=8, max_new_tokens=_DSHARD_MAX_NEW,
+                     max_queue=8, breaker_threshold=4,
+                     breaker_backoff_ms=15.0)
+
+    def factory(name):
+        model = ShardedDecodeModel(TinyCausalLM(**model_kw), tp=2)
+        return DecodeEngine(model, name=name, **engine_kw)
+
+    router = FleetRouter(replicas=2, failover_budget=2,
+                         breaker_threshold=3, breaker_backoff_ms=10.0)
+    router.load_decode("shlm", factory, replicas=2, tp=2)
+    ref_eng = DecodeEngine(TinyCausalLM(**model_kw), name="shref",
+                           **engine_kw)
+    try:
+        refs = [ref_eng.generate_reference(list(p),
+                                           _DSHARD_MAX_NEW).tolist()
+                for p in _DSHARD_PROMPTS]
+        sam_refs = [ref_eng.generate_reference(
+                        list(p), _DSHARD_MAX_NEW, temperature=_DSHARD_TEMP,
+                        top_k=_DSHARD_TOPK,
+                        seed=_DSHARD_SEED0 + i).tolist()
+                    for i, p in enumerate(_DSHARD_PROMPTS)]
+    finally:
+        ref_eng.stop()
+    return router, "shlm", [list(p) for p in _DSHARD_PROMPTS], refs, sam_refs
+
+
+def sharded_decode_storm(router, name, prompts, refs, sam_refs, seed):
+    """Storm over mesh-backed engines with a mid-run drain (the
+    ``sharded_decode`` scenario).
+
+    Greedy and explicitly-seeded sampled streams run against tp=2
+    engines while a disruptor drains one LIVE replica, forcing a
+    sharded→sharded handoff (exported pages host-gather to the full head
+    axis, the importer re-shards them).  Invariants:
+
+    * **no torn streams** — an OK stream's tokens equal the SINGLE-DEVICE
+      reference for its (prompt, seed) bitwise, across the handoff;
+      TIMEOUT/UNAVAILABLE partials are strict prefixes; shed streams
+      carry zero tokens;
+    * **conservation** — router decode counters satisfy ``requests ==
+      ok + timeouts + errors + unavailable`` and match the client tally,
+      with zero ERROR streams; per-engine ``requests + imported ==
+      terminal + handed_off`` holds;
+    * **pools whole on every shard** — after the storm each engine's KV
+      accounting drains to used == reserved == live_sequences == 0 with
+      ``allocated_total == freed_total`` (the head-sharded device pool is
+      one array: the host accounting covers all shards at once), and
+      every engine still reports ``tp_degree == 2``;
+    * **zero steady-state recompiles** — sampling, the handoff and the
+      drain all ride the warmed shard_map signatures;
+    * **repair + replay** — after enable() the placement re-converges
+      and one greedy plus one sampled probe reach OK bitwise-equal to
+      the single-device references.
+    """
+    from ..serving import server as srv
+
+    violations = []
+    rng = random.Random(seed ^ 0x5A4D)
+    before = router.decode_stats.snapshot()
+    stats0 = router.stats()
+    before_eng = dict(stats0["engines"].get(name, {}))
+
+    n_clients, per_client = 3, 2
+    plans = []   # [(timeout_ms or None, prompt_idx, sampled), ...]
+    for c in range(n_clients):
+        plan = []
+        for s in range(per_client):
+            tmo = rng.uniform(200.0, 1500.0) if rng.random() < 0.15 \
+                else None
+            plan.append((tmo, rng.randrange(len(prompts)),
+                         rng.random() < 0.35))
+        plans.append(plan)
+    results = [[] for _ in plans]
+
+    def client(c):
+        for tmo, pi, sampled in plans[c]:
+            if sampled:
+                stream = router.submit_stream(
+                    name, list(prompts[pi]),
+                    max_new_tokens=_DSHARD_MAX_NEW, timeout_ms=tmo,
+                    temperature=_DSHARD_TEMP, top_k=_DSHARD_TOPK,
+                    seed=_DSHARD_SEED0 + pi)
+            else:
+                stream = router.submit_stream(
+                    name, list(prompts[pi]),
+                    max_new_tokens=_DSHARD_MAX_NEW, timeout_ms=tmo)
+            if not stream.wait(_JOIN_TIMEOUT_S):
+                violations.append("sharded_decode: stream of client %d "
+                                  "never terminated" % c)
+            results[c].append((pi, sampled, stream))
+
+    drained = []
+
+    def disruptor():
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            d = router.decode_stats.snapshot()
+            if d["requests"] - before["requests"] >= 2:
+                break
+            time.sleep(0.002)
+        live = [rid for rid, state in sorted(router.replicas().items())
+                if state == "LIVE"]
+        if len(live) < 2:
+            violations.append("sharded_decode: %d live replica(s) before "
+                              "the drain (want >= 2)" % len(live))
+            return
+        rid_d = live[rng.randrange(len(live))]
+        router.drain(rid_d)   # sharded→sharded fenced handoff
+        drained.append(rid_d)
+
+    workers = [lambda c=c: client(c) for c in range(len(plans))]
+    workers.append(disruptor)
+    violations.extend(_spawn(workers))
+
+    # client-side status + token integrity vs the single-device reference
+    tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "ERROR": 0,
+             "UNAVAILABLE": 0, "shed": 0, "rejected": 0}
+    for c in range(len(plans)):
+        for pi, sampled, stream in results[c]:
+            status, tokens, _, _, _err = stream.snapshot()
+            if status is None:
+                violations.append("sharded_decode: client %d stream has "
+                                  "no terminal status" % c)
+                continue
+            if stream.admitted:
+                tally["admitted"] += 1
+                if status not in (srv.OK, srv.TIMEOUT, srv.ERROR,
+                                  srv.UNAVAILABLE):
+                    violations.append("sharded_decode: admitted stream "
+                                      "ended %r" % status)
+                    continue
+                tally[status] += 1
+            elif status == srv.OVERLOADED:
+                tally["shed"] += 1
+            elif status == srv.UNAVAILABLE:
+                tally["rejected"] += 1
+            else:
+                violations.append("sharded_decode: rejected stream ended "
+                                  "%r" % status)
+                continue
+            ref = sam_refs[pi] if sampled else refs[pi]
+            kind = "sampled" if sampled else "greedy"
+            toks = list(tokens)
+            if status == srv.OK and toks != ref:
+                violations.append(
+                    "sharded_decode: torn %s stream: client %d OK tokens "
+                    "%s != single-device reference %s" % (kind, c, toks,
+                                                          ref))
+            elif status in (srv.TIMEOUT, srv.UNAVAILABLE) and \
+                    toks != ref[:len(toks)]:
+                violations.append(
+                    "sharded_decode: contaminated %s partial: client %d "
+                    "%s tokens %s not a prefix of %s"
+                    % (kind, c, status, toks, ref))
+            elif status == srv.OVERLOADED and toks:
+                violations.append("sharded_decode: shed stream carries %d "
+                                  "token(s)" % len(toks))
+
+    # router-level conservation
+    keys = ("requests", "ok", "timeouts", "errors", "unavailable", "shed",
+            "invalid", "unavailable_rejected")
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = router.decode_stats.snapshot()
+        d = {k: after[k] - before[k] for k in keys}
+        terminal_sum = (d["ok"] + d["timeouts"] + d["errors"]
+                        + d["unavailable"])
+        if d["requests"] == terminal_sum or time.monotonic() >= settle_until:
+            break
+        time.sleep(0.005)
+    if d["requests"] != terminal_sum:
+        violations.append("sharded_decode: lost streams: %d admitted, %d "
+                          "terminal" % (d["requests"], terminal_sum))
+    if d["requests"] != tally["admitted"]:
+        violations.append("sharded_decode: admission mismatch: router %d "
+                          "vs clients %d" % (d["requests"],
+                                             tally["admitted"]))
+    for client_key, fleet_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
+                                  ("ERROR", "errors"),
+                                  ("UNAVAILABLE", "unavailable"),
+                                  ("shed", "shed"),
+                                  ("rejected", "unavailable_rejected")):
+        if d[fleet_key] != tally[client_key]:
+            violations.append("sharded_decode: %s mismatch: router %d vs "
+                              "clients %d"
+                              % (fleet_key, d[fleet_key],
+                                 tally[client_key]))
+    if d["errors"]:
+        violations.append("sharded_decode: %d ERROR stream(s) with no "
+                          "faults injected" % d["errors"])
+
+    # pools whole on every shard + per-engine conservation + recompiles
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        engines = router.stats()["engines"].get(name, {})
+        if all(s["kv"]["used"] == 0 and s["kv"]["reserved"] == 0
+               and s["kv"]["live_sequences"] == 0
+               for s in engines.values()):
+            break
+        time.sleep(0.005)
+    engines = router.stats()["engines"].get(name, {})
+    for rid, s in engines.items():
+        kv = s["kv"]
+        if kv["used"] != 0 or kv["reserved"] != 0 \
+                or kv["live_sequences"] != 0:
+            violations.append("sharded_decode: KV pool not whole on %s: %r"
+                              % (rid, {k: kv[k] for k in
+                                       ("used", "reserved",
+                                        "live_sequences")}))
+        if kv["allocated_total"] != kv["freed_total"]:
+            violations.append("sharded_decode: KV leak on %s: allocated "
+                              "%d != freed %d"
+                              % (rid, kv["allocated_total"],
+                                 kv["freed_total"]))
+        if s["requests"] + s["imported"] != (
+                s["ok"] + s["timeouts"] + s["errors"] + s["unavailable"]
+                + s["handed_off"]):
+            violations.append("sharded_decode: engine conservation broken "
+                              "on %s: req %d + imported %d != ok %d + "
+                              "to %d + err %d + unavail %d + handed %d"
+                              % (rid, s["requests"], s["imported"],
+                                 s["ok"], s["timeouts"], s["errors"],
+                                 s["unavailable"], s["handed_off"]))
+        if s["tp_degree"] != 2:
+            violations.append("sharded_decode: engine on %s reports "
+                              "tp_degree %d (want 2)"
+                              % (rid, s["tp_degree"]))
+        prev = before_eng.get(rid)
+        if prev is not None and \
+                s["cache"]["recompiles"] != prev["cache"]["recompiles"]:
+            violations.append("sharded_decode: steady-state recompile on "
+                              "%s: %d -> %d"
+                              % (rid, prev["cache"]["recompiles"],
+                                 s["cache"]["recompiles"]))
+
+    # repair for the next seed, then replay probes against the
+    # single-device references
+    for rid in drained:
+        if router.replicas().get(rid) == "DRAINING":
+            router.enable(rid)
+    if not router.wait_converged(timeout_s=10.0):
+        violations.append("sharded_decode: placement never re-converged: "
+                          "%r" % router.stats()["decode_models"])
+    probe = router.submit_stream(name, list(prompts[0]),
+                                 max_new_tokens=_DSHARD_MAX_NEW)
+    probe.wait(_JOIN_TIMEOUT_S)
+    status, tokens, _, _, err = probe.snapshot()
+    if status != srv.OK or list(tokens) != refs[0]:
+        violations.append("sharded_decode: post-repair greedy probe ended "
+                          "%r (%r)" % (status, err))
+    probe = router.submit_stream(name, list(prompts[1]),
+                                 max_new_tokens=_DSHARD_MAX_NEW,
+                                 temperature=_DSHARD_TEMP,
+                                 top_k=_DSHARD_TOPK,
+                                 seed=_DSHARD_SEED0 + 1)
+    probe.wait(_JOIN_TIMEOUT_S)
+    status, tokens, _, _, err = probe.snapshot()
+    if status != srv.OK or list(tokens) != sam_refs[1]:
+        violations.append("sharded_decode: post-repair sampled probe "
+                          "ended %r (%r)" % (status, err))
+    # settle so a late terminal hook can't straddle the next seed's
+    # `before` snapshot
+    settle_until = time.monotonic() + 5.0
+    while time.monotonic() < settle_until:
+        s = router.decode_stats.snapshot()
+        if s["requests"] == (s["ok"] + s["timeouts"] + s["errors"]
+                             + s["unavailable"]):
+            break
+        time.sleep(0.002)
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
-             "crash", "decode", "fleet", "decode_fleet", "decode_prefix")
+             "crash", "decode", "fleet", "decode_fleet", "decode_prefix",
+             "sharded_decode")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -1945,6 +2256,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                           if "decode_fleet" in scenarios else None)
         dprefix_fixture = (_build_decode_prefix_fixture()
                            if "decode_prefix" in scenarios else None)
+        dshard_fixture = (_build_sharded_decode_fixture()
+                          if "sharded_decode" in scenarios else None)
         try:
             for seed in seeds:
                 sched.reseed(seed)
@@ -1987,6 +2300,11 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                         dprefix_fixture[0], dprefix_fixture[1],
                         dprefix_fixture[2], dprefix_fixture[3],
                         dprefix_fixture[4], seed)
+                if dshard_fixture is not None:
+                    per_seed["sharded_decode"] = sharded_decode_storm(
+                        dshard_fixture[0], dshard_fixture[1],
+                        dshard_fixture[2], dshard_fixture[3],
+                        dshard_fixture[4], seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n
@@ -2006,6 +2324,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                 dfleet_fixture[0].stop()
             if dprefix_fixture is not None:
                 dprefix_fixture[0].stop()
+            if dshard_fixture is not None:
+                dshard_fixture[0].stop()
     report["preemptions"] = sched.preemptions
     report["elapsed_s"] = time.monotonic() - t0
     return report

@@ -222,6 +222,14 @@ class CachedOp:
         param_handles = [param_dict[n] for n in self._param_names]
         param_vals = [p._data for p in param_handles]
         input_vals = [x._data for x in inputs]
+        place = self._flags.get("place_inputs")
+        if place is not None:
+            # mesh-sharded models (serving/decode/sharding.py): one jit
+            # call cannot mix single-device-committed and mesh-committed
+            # operands, so the model pins every operand's placement —
+            # already-mesh-resident values pass through untouched
+            param_vals = [place(v) for v in param_vals]
+            input_vals = [place(v) for v in input_vals]
         key = _random.next_key()
         vals = tuple(param_vals) + tuple(input_vals) + (key,)
         ctx = inputs[0].context if inputs else param_handles[0].context

@@ -16,10 +16,15 @@ per-stream.  See docs/SERVING.md#autoregressive-decode.
     assert stream.status == "OK"
     engine.stop()
 """
+from .adapter import GluonCausalLMAdapter, TinyGluonLM
 from .engine import DecodeEngine, DecodeStream
 from .kv_cache import PagedKVCache
 from .model import TinyCausalLM
+from .sharding import (ShardedDecodeModel, decode_mesh,
+                       expert_sharded_ffn, long_context_attention)
 from .stats import DecodeStats
 
 __all__ = ["DecodeEngine", "DecodeStream", "PagedKVCache", "TinyCausalLM",
-           "DecodeStats"]
+           "DecodeStats", "ShardedDecodeModel", "decode_mesh",
+           "long_context_attention", "expert_sharded_ffn",
+           "GluonCausalLMAdapter", "TinyGluonLM"]

@@ -1,0 +1,363 @@
+"""Gluon-block -> paged-KV decode-model adapter.
+
+The decode engine speaks the paged-KV contract (model.py), not the Gluon
+batch-forward convention — a Gluon causal LM cannot serve as-is because
+an autoregressive step must read and write paged pool state.  What a
+trained block DOES carry is everything the contract kernels need: the
+weights.  :class:`GluonCausalLMAdapter` turns any hybridizable or
+**exported** (``HybridBlock.export`` -> ``SymbolBlock.imports``) causal
+LM of the reference architecture into a full contract model:
+
+* **role discovery** maps ``collect_params()`` names onto kernel roles by
+  suffix — ``embed_weight``, ``pos_weight`` and per-layer
+  ``l{i}_{wq|wk|wv|wo|w1|w2}_weight`` (any block/name-scope prefix) — or
+  through an explicit ``layer_map`` when a block names things its own
+  way.  Missing or ambiguous roles raise ValueErrors naming the
+  candidates, never a shape error inside a compiled kernel.
+* **live handles**: ``param_dict()`` returns each ``Parameter.data()``
+  NDArray, so the engine's CachedOps see weight updates the same way a
+  hybridized block does — no copies, no snapshots.
+* **layout adaptation happens inside the trace**: Gluon ``Dense`` stores
+  ``[units, in_units]`` (FullyConnected computes ``x @ W.T``) while the
+  contract kernels take ``[in, out]``; the adapter transposes at trace
+  time, so XLA folds the transpose into the matmul's dimension numbers
+  and the live handle is still the block's own storage.
+* the serving kernels are the PROVEN ones — the adapter delegates to
+  ``TinyCausalLM``'s prefill/decode/chunk/verify/propose suite over the
+  adapted weights, so the exactness contract (exact-zero masking,
+  row-independence, fixed signatures) holds by construction and the
+  whole composed stack (prefix cache, CoW, chunked prefill, speculative
+  verify, export/import handoff, ShardedDecodeModel) applies unchanged.
+* ``partition_specs()`` emits Gluon-layout specs per layer kind, so
+  ``ShardedDecodeModel`` shards adapted weights exactly like native
+  contract models (attention/wide projections on the ``tp`` axis).
+
+``num_heads`` must be supplied — a weight file cannot reveal how a
+square attention projection splits into heads.  Everything else
+(vocab/hidden/layer count/max_len) is read off the discovered shapes.
+
+:class:`TinyGluonLM` is the in-tree demo block: the same pre-norm
+transformer as ``TinyCausalLM`` written as a ``HybridBlock`` over
+``F.Embedding``/``F.FullyConnected``/``F.batch_dot`` symbol-compatible
+ops, so it hybridizes, exports and re-imports — the export round-trip
+the adapter tests serve end-to-end.
+"""
+from __future__ import annotations
+
+import re
+
+from ...gluon.block import HybridBlock
+from .model import TinyCausalLM
+
+__all__ = ["GluonCausalLMAdapter", "TinyGluonLM", "discover_roles",
+           "copy_reference_weights", "DENSE_ROLES"]
+
+DENSE_ROLES = ("wq", "wk", "wv", "wo", "w1", "w2")
+
+_LAYER_RE = re.compile(r"(?:^|_)l(\d+)_(wq|wk|wv|wo|w1|w2)_weight$")
+_EMBED_RE = re.compile(r"(?:^|_)embed_weight$")
+_POS_RE = re.compile(r"(?:^|_)pos_weight$")
+
+
+def discover_roles(names, layer_map=None):
+    """Map parameter names onto kernel roles by suffix.
+
+    Returns ``{role: name}`` with roles ``embed``, ``pos`` and
+    ``l{i}_{wq|...}``.  ``layer_map`` entries override discovery (and are
+    checked against ``names``).  Raises ValueError naming every candidate
+    on ambiguity and the missing role otherwise.
+    """
+    roles = {}
+    for name in names:
+        m = _LAYER_RE.search(name)
+        if m:
+            role = "l%d_%s" % (int(m.group(1)), m.group(2))
+        elif _EMBED_RE.search(name):
+            role = "embed"
+        elif _POS_RE.search(name):
+            role = "pos"
+        else:
+            continue
+        if role in roles:
+            raise ValueError(
+                "GluonCausalLMAdapter: role %r is ambiguous: both %r and "
+                "%r match; pass layer_map={...} to pick one"
+                % (role, roles[role], name))
+        roles[role] = name
+    if layer_map:
+        known = set(names)
+        for role, name in layer_map.items():
+            if name not in known:
+                raise ValueError(
+                    "GluonCausalLMAdapter: layer_map maps role %r to %r, "
+                    "which is not among the block's parameters"
+                    % (role, name))
+            roles[role] = name
+    for role in ("embed", "pos"):
+        if role not in roles:
+            raise ValueError(
+                "GluonCausalLMAdapter: no parameter matches role %r "
+                "(expected a name ending in %r_weight); found %r"
+                % (role, role, sorted(names)))
+    return roles
+
+
+class GluonCausalLMAdapter:
+    """Serve a Gluon causal LM through the paged-KV decode contract."""
+
+    def __init__(self, block, num_heads, eos_id=None, layer_map=None):
+        params = {name: p for name, p in block.collect_params().items()}
+        roles = discover_roles(list(params), layer_map)
+
+        layers = set()
+        for role in roles:
+            m = re.match(r"l(\d+)_", role)
+            if m:
+                layers.add(int(m.group(1)))
+        num_layers = (max(layers) + 1) if layers else 0
+        if not num_layers:
+            raise ValueError(
+                "GluonCausalLMAdapter: no l{i}_{wq|wk|wv|wo|w1|w2}_weight "
+                "layer parameters found; found %r" % (sorted(params),))
+        for l in range(num_layers):
+            for r in DENSE_ROLES:
+                if "l%d_%s" % (l, r) not in roles:
+                    raise ValueError(
+                        "GluonCausalLMAdapter: layer %d is missing role %r "
+                        "(layers must be contiguous and complete; found %r)"
+                        % (l, r, sorted(roles)))
+
+        self._role_params = {role: params[name]
+                             for role, name in roles.items()}
+        self.role_names = dict(roles)
+
+        embed = self._role_params["embed"].data()
+        pos = self._role_params["pos"].data()
+        if len(embed.shape) != 2 or len(pos.shape) != 2:
+            raise ValueError(
+                "GluonCausalLMAdapter: embed %r / pos %r must be rank-2 "
+                "[vocab, hidden] / [max_len, hidden]"
+                % (embed.shape, pos.shape))
+        vocab_size, hidden = embed.shape
+        if pos.shape[1] != hidden:
+            raise ValueError(
+                "GluonCausalLMAdapter: pos hidden size %d does not match "
+                "embed hidden size %d" % (pos.shape[1], hidden))
+        if hidden % int(num_heads):
+            raise ValueError(
+                "GluonCausalLMAdapter: hidden size %d is not divisible by "
+                "num_heads %d" % (hidden, int(num_heads)))
+        ff = None
+        for l in range(num_layers):
+            for r in ("wq", "wk", "wv", "wo"):
+                shp = self._role_params["l%d_%s" % (l, r)].data().shape
+                if tuple(shp) != (hidden, hidden):
+                    raise ValueError(
+                        "GluonCausalLMAdapter: l%d_%s has shape %r, want "
+                        "[hidden, hidden] = %r"
+                        % (l, r, tuple(shp), (hidden, hidden)))
+            w1 = self._role_params["l%d_w1" % l].data().shape
+            w2 = self._role_params["l%d_w2" % l].data().shape
+            if len(w1) != 2 or w1[1] != hidden:
+                raise ValueError(
+                    "GluonCausalLMAdapter: l%d_w1 has shape %r, want the "
+                    "Gluon [ff, hidden] layout with hidden=%d"
+                    % (l, tuple(w1), hidden))
+            if ff is None:
+                ff = w1[0]
+            if tuple(w1) != (ff, hidden) or tuple(w2) != (hidden, ff):
+                raise ValueError(
+                    "GluonCausalLMAdapter: layer %d MLP shapes w1=%r w2=%r "
+                    "are inconsistent with ff width %d"
+                    % (l, tuple(w1), tuple(w2), ff))
+
+        self.vocab_size = int(vocab_size)
+        self.hidden = int(hidden)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.hidden // self.num_heads
+        self.max_len = int(pos.shape[0])
+        self.ff = int(ff)
+        self.eos_id = eos_id
+        # kernel skeleton: TinyCausalLM's fns read only geometry attrs and
+        # the param dict they are handed, so a no-__init__ instance IS the
+        # proven kernel suite over the adapted weights
+        kern = TinyCausalLM.__new__(TinyCausalLM)
+        kern.vocab_size = self.vocab_size
+        kern.hidden = self.hidden
+        kern.num_layers = self.num_layers
+        kern.num_heads = self.num_heads
+        kern.head_dim = self.head_dim
+        kern.max_len = self.max_len
+        kern.eos_id = eos_id
+        kern.context_attention = None
+        kern._params = {}
+        self._kern = kern
+
+    # -- contract surface ------------------------------------------------
+    def param_dict(self):
+        """Live Gluon Parameter storage, keyed by role."""
+        return {role: p.data() for role, p in self._role_params.items()}
+
+    def _contract(self, p):
+        """Adapt Gluon-layout weights to the kernel layout inside the
+        trace: Dense kernels are ``[units, in]`` (y = x @ W.T), the
+        contract kernels contract ``x @ W`` — transpose here so XLA folds
+        it into the dot and the live handles stay untouched."""
+        out = {"embed": p["embed"], "pos": p["pos"]}
+        for l in range(self.num_layers):
+            for r in DENSE_ROLES:
+                key = "l%d_%s" % (l, r)
+                out[key] = p[key].T
+        return out
+
+    def prefill_fn(self, p, tokens, length, table, k_pool, v_pool):
+        return self._kern.prefill_fn(self._contract(p), tokens, length,
+                                     table, k_pool, v_pool)
+
+    def decode_fn(self, p, tokens, positions, tables, k_pool, v_pool):
+        return self._kern.decode_fn(self._contract(p), tokens, positions,
+                                    tables, k_pool, v_pool)
+
+    def chunk_prefill_fn(self, p, tokens, start, length, table, k_pool,
+                         v_pool):
+        return self._kern.chunk_prefill_fn(self._contract(p), tokens, start,
+                                           length, table, k_pool, v_pool)
+
+    def verify_fn(self, p, tokens, positions, valids, tables, k_pool,
+                  v_pool):
+        return self._kern.verify_fn(self._contract(p), tokens, positions,
+                                    valids, tables, k_pool, v_pool)
+
+    def propose_fn(self, p, tokens, positions, tables, k_pool, v_pool,
+                   num_tokens):
+        return self._kern.propose_fn(self._contract(p), tokens, positions,
+                                     tables, k_pool, v_pool, num_tokens)
+
+    def partition_specs(self):
+        """Weight sharding for ShardedDecodeModel, in the GLUON layout:
+        q/k/v and the MLP up-projection split their ``units`` (head/wide)
+        axis over 'tp'; the output projections split the matching input
+        axis; embed/pos split the hidden axis."""
+        from jax.sharding import PartitionSpec as P
+        specs = {"embed": P(None, "tp"), "pos": P(None, "tp")}
+        for l in range(self.num_layers):
+            specs["l%d_wq" % l] = P("tp", None)
+            specs["l%d_wk" % l] = P("tp", None)
+            specs["l%d_wv" % l] = P("tp", None)
+            specs["l%d_wo" % l] = P(None, "tp")
+            specs["l%d_w1" % l] = P("tp", None)
+            specs["l%d_w2" % l] = P(None, "tp")
+        return specs
+
+
+# ---------------------------------------------------------------------------
+# demo block
+# ---------------------------------------------------------------------------
+
+class TinyGluonLM(HybridBlock):
+    """The ``TinyCausalLM`` architecture as an exportable HybridBlock.
+
+    Forward maps tokens ``[B, T]`` to logits ``[B, T, V]`` through
+    symbol-compatible ops only (Embedding, FullyConnected, batch_dot,
+    softmax, arange/slice_like for the causal mask), so the block
+    hybridizes AND ``export()``s; ``SymbolBlock.imports`` of the result
+    re-serves through :class:`GluonCausalLMAdapter` with bit-identical
+    weights.  Parameters carry the adapter's role names.  The batch
+    forward masks with -1e30 (exp underflows to exact zero after the
+    max-shift) — serving exactness still comes from the adapter's paged
+    kernels, not this forward.
+    """
+
+    def __init__(self, vocab_size=48, hidden=32, num_layers=2, num_heads=2,
+                 max_len=128, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if hidden % num_heads:
+            raise ValueError("hidden must divide into num_heads")
+        self._vocab = int(vocab_size)
+        self._hidden = int(hidden)
+        self._layers = int(num_layers)
+        self._heads = int(num_heads)
+        self._max_len = int(max_len)
+        shapes = {"embed_weight": (self._vocab, self._hidden),
+                  "pos_weight": (self._max_len, self._hidden)}
+        for l in range(self._layers):
+            for r in ("wq", "wk", "wv", "wo"):
+                shapes["l%d_%s_weight" % (l, r)] = (self._hidden,
+                                                    self._hidden)
+            shapes["l%d_w1_weight" % l] = (2 * self._hidden, self._hidden)
+            shapes["l%d_w2_weight" % l] = (self._hidden, 2 * self._hidden)
+        for name, shape in shapes.items():
+            setattr(self, name, self.params.get(name, shape=shape))
+
+    def _rms(self, F, x):
+        denom = F.sqrt(F.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+        return F.broadcast_div(x, denom)
+
+    def hybrid_forward(self, F, tokens, **params):
+        H, nh = self._hidden, self._heads
+        d = H // nh
+        # [T, B, H] layout throughout: slice_like against axis 0 gives the
+        # length-T position slice without knowing T at graph-build time
+        emb = F.Embedding(F.transpose(tokens, axes=(1, 0)),
+                          params["embed_weight"],
+                          input_dim=self._vocab, output_dim=H)
+        pos = F.slice_like(params["pos_weight"], emb, axes=(0,))
+        h = F.broadcast_add(emb, F.expand_dims(pos, axis=1))
+        ar = F.slice_like(F.arange(start=0, stop=self._max_len), emb,
+                          axes=(0,))
+        # attend = 1.0 where query position i >= key position j
+        attend = F.broadcast_greater_equal(F.expand_dims(ar, axis=1),
+                                           F.expand_dims(ar, axis=0))
+        negmask = F.expand_dims((attend - 1.0) * 1e30, axis=0)  # [1, T, T]
+        for l in range(self._layers):
+            x = self._rms(F, h)
+            qkv = []
+            for r in ("wq", "wk", "wv"):
+                y = F.FullyConnected(x, params["l%d_%s_weight" % (l, r)],
+                                     num_hidden=H, no_bias=True,
+                                     flatten=False)       # [T, B, H]
+                y = F.reshape(y, shape=(0, 0, nh, d))
+                y = F.transpose(y, axes=(1, 2, 0, 3))     # [B, nh, T, d]
+                qkv.append(F.reshape(y, shape=(-3, -2)))  # [B*nh, T, d]
+            q, k, v = qkv
+            scores = F.batch_dot(q, k, transpose_b=True) / float(d) ** 0.5
+            w = F.softmax(F.broadcast_add(scores, negmask), axis=-1)
+            att = F.batch_dot(w, v)                       # [B*nh, T, d]
+            att = F.reshape(att, shape=(-4, -1, nh, 0, 0))
+            att = F.transpose(att, axes=(2, 0, 1, 3))     # [T, B, nh, d]
+            att = F.reshape(att, shape=(0, 0, -3))
+            h = h + F.FullyConnected(att, params["l%d_wo_weight" % l],
+                                     num_hidden=H, no_bias=True,
+                                     flatten=False)
+            g = F.FullyConnected(self._rms(F, h),
+                                 params["l%d_w1_weight" % l],
+                                 num_hidden=2 * H, no_bias=True,
+                                 flatten=False)
+            h = h + F.FullyConnected(F.LeakyReLU(g, act_type="gelu"),
+                                     params["l%d_w2_weight" % l],
+                                     num_hidden=H, no_bias=True,
+                                     flatten=False)
+        logits = F.FullyConnected(self._rms(F, h), params["embed_weight"],
+                                  num_hidden=self._vocab, no_bias=True,
+                                  flatten=False)          # [T, B, V]
+        return F.transpose(logits, axes=(1, 0, 2))
+
+
+def copy_reference_weights(block, ref):
+    """Load a ``TinyCausalLM``'s weights into a role-named Gluon block,
+    transposing dense kernels into the Gluon ``[units, in]`` layout.
+
+    The bitwise test fixture: after this, ``GluonCausalLMAdapter(block,
+    ref.num_heads)`` computes with value-identical arrays to ``ref``
+    (transpose of a transpose), so adapted serving must reproduce the
+    native model's streams exactly.
+    """
+    params = {name: p for name, p in block.collect_params().items()}
+    roles = discover_roles(list(params))
+    src = ref.param_dict()
+    for role, name in roles.items():
+        val = src[role]
+        if role not in ("embed", "pos"):
+            val = val.T
+        params[name].set_data(val)

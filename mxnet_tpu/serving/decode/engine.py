@@ -353,20 +353,32 @@ class DecodeEngine:
         self._cache = PagedKVCache(model.num_layers, num_blocks, block_size,
                                    model.num_heads, model.head_dim)
         self._params = model.param_dict()
-        self.stats = DecodeStats(name, kv_capacity=self._cache.capacity())
+        # mesh footprint: a sharded model (sharding.py) spans tp devices;
+        # the fleet's placement and scaling advice count them through here
+        self.tp_degree = int(getattr(model, "tp_degree", 1))
+        self.stats = DecodeStats(name, kv_capacity=self._cache.capacity(),
+                                 tp_degree=self.tp_degree)
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold,
             backoff_s=breaker_backoff_ms / 1e3,
             max_backoff_s=breaker_max_backoff_ms / 1e3)
-        self._prefill_cop = CachedOp(self._prefill_forward, self._params)
-        self._decode_cop = CachedOp(self._decode_forward, self._params)
+        # a mesh-sharded model (sharding.py) pins operand placement per
+        # dispatch; unsharded models leave the hook absent and the flag
+        # costs nothing
+        mflags = self._placement_flags(model)
+        dflags = self._placement_flags(draft_model)
+        self._prefill_cop = CachedOp(self._prefill_forward, self._params,
+                                     flags=mflags)
+        self._decode_cop = CachedOp(self._decode_forward, self._params,
+                                    flags=mflags)
         retry = util.retry(attempts=_EXEC_ATTEMPTS, backoff=_EXEC_BACKOFF_S,
                            on_retry=lambda exc, i: self.stats.on_retry())
         self._prefill_exec = retry(self._prefill_once)
         self._decode_exec = retry(self._decode_once)
         self._chunk_cop = self._chunk_exec = None
         if self.prefill_chunk is not None:
-            self._chunk_cop = CachedOp(self._chunk_forward, self._params)
+            self._chunk_cop = CachedOp(self._chunk_forward, self._params,
+                                       flags=mflags)
             self._chunk_exec = retry(self._chunk_once)
         self._verify_cop = self._verify_exec = None
         self._draft_cop = self._draft_exec = None
@@ -375,13 +387,15 @@ class DecodeEngine:
         self._dpools = None      # [draft k_pool, draft v_pool], worker-only
         if self.spec_k > 0:
             self._draft_params = draft_model.param_dict()
-            self._verify_cop = CachedOp(self._verify_forward, self._params)
+            self._verify_cop = CachedOp(self._verify_forward, self._params,
+                                        flags=mflags)
             self._verify_exec = retry(self._verify_once)
             self._draft_cop = CachedOp(self._draft_forward,
-                                       self._draft_params)
+                                       self._draft_params, flags=dflags)
             self._draft_exec = retry(self._draft_once)
             self._draft_chunk_cop = CachedOp(self._draft_chunk_forward,
-                                             self._draft_params)
+                                             self._draft_params,
+                                             flags=dflags)
             self._draft_chunk_exec = retry(self._draft_chunk_once)
         self.warmup_report = None
         if warmup:
@@ -528,15 +542,36 @@ class DecodeEngine:
                 nd.array(length, dtype="int32"),
                 nd.array(table, dtype="int32"), k_pool, v_pool)
 
+    @staticmethod
+    def _placement_flags(model):
+        place = getattr(model, "place_inputs", None)
+        return {"place_inputs": place} if place is not None else None
+
+    @staticmethod
+    def _zeros_pools(model, shape):
+        """A pair of fresh zeroed pools for ``shape``; a sharded model
+        places them head-sharded over its mesh (sharding.py), the default
+        is plain device zeros."""
+        zeros = getattr(model, "zeros_pool", None)
+        if zeros is not None:
+            return [zeros(shape), zeros(shape)]
+        from ... import ndarray as nd
+        return [nd.zeros(shape, dtype="float32"),
+                nd.zeros(shape, dtype="float32")]
+
+    def _init_pools(self):
+        """Fresh target-model K/V pools on the model's placement."""
+        if getattr(self.model, "zeros_pool", None) is None:
+            return self._cache.init_pools()
+        return self._zeros_pools(self.model, self._cache.pool_shape())
+
     def _draft_pools(self):
         """Fresh zeroed draft-model K/V pools (same block grid as the
         target pools, draft head geometry)."""
-        from ... import ndarray as nd
         shape = (self.draft.num_layers, self._cache.num_blocks,
                  self._cache.block_size, self.draft.num_heads,
                  self.draft.head_dim)
-        return [nd.zeros(shape, dtype="float32"),
-                nd.zeros(shape, dtype="float32")]
+        return self._zeros_pools(self.draft, shape)
 
     # -- warmup ----------------------------------------------------------
     def warmup(self):
@@ -544,7 +579,7 @@ class DecodeEngine:
         bucket) signature against throwaway pools.  Steady-state traffic
         then never misses: ``cache_stats()`` must stay flat."""
         before = self.cache_stats()["misses"]
-        k_pool, v_pool = self._cache.init_pools()
+        k_pool, v_pool = self._init_pools()
         max_w = self._width_ladder.max_batch
         n = 0
         if self.prefill_chunk is not None:
@@ -775,7 +810,7 @@ class DecodeEngine:
                 raise
 
     def _run_loop(self):  # mxflow: hot (decode prefill/step loop)
-        k_pool, v_pool = self._cache.init_pools()
+        k_pool, v_pool = self._init_pools()
         if self.spec_k > 0 and self._dpools is None:
             self._dpools = self._draft_pools()
         while True:
@@ -1494,6 +1529,7 @@ class DecodeEngine:
             "slots_live": slots_live,
             "max_slots": self.max_slots,
             "tokens_per_s": snap["tokens_per_s"],
+            "tp_degree": self.tp_degree,
             "draining": draining,
             "prefix_hits": kv["prefix_hits"],
             "prefix_blocks_shared": kv["prefix_blocks_shared"],
@@ -1531,7 +1567,7 @@ class DecodeEngine:
                 return int(np.argmax(row))
             return sampler.sample(row)
 
-        k_pool, v_pool = self._cache.init_pools()
+        k_pool, v_pool = self._init_pools()
         blocks = list(range(1, 1 + self._cache.blocks_for_tokens(
             len(prompt) + int(max_new_tokens))))
         have = self._cache.blocks_for_tokens(len(prompt))

@@ -76,9 +76,13 @@ class TinyCausalLM:
     """Small causal transformer LM with paged-KV prefill/decode kernels."""
 
     def __init__(self, vocab_size=48, hidden=32, num_layers=2, num_heads=2,
-                 max_len=128, seed=0, eos_id=None):
+                 max_len=128, seed=0, eos_id=None, context_attention=None):
         if hidden % num_heads:
             raise ValueError("hidden must divide into num_heads")
+        # name of a bound mesh axis ('sp') to split prompt attention over
+        # via the fused ulysses/ring kernels; requires running inside
+        # ShardedDecodeModel(sp=n).  None = the bitwise dense path.
+        self.context_attention = context_attention
         self.vocab_size = int(vocab_size)
         self.hidden = int(hidden)
         self.num_layers = int(num_layers)
@@ -106,6 +110,22 @@ class TinyCausalLM:
 
     def param_dict(self):
         return dict(self._params)
+
+    def partition_specs(self):
+        """Weight sharding over the serving mesh's 'tp' axis (consumed by
+        serving.decode.sharding.ShardedDecodeModel): attention and MLP
+        projections split on a hidden-sized axis — always divisible, since
+        the head count must divide tp and hidden = heads * head_dim."""
+        from jax.sharding import PartitionSpec as P
+        specs = {"embed": P(None, "tp"), "pos": P(None, "tp")}
+        for l in range(self.num_layers):
+            specs["l%d_wq" % l] = P(None, "tp")
+            specs["l%d_wk" % l] = P(None, "tp")
+            specs["l%d_wv" % l] = P(None, "tp")
+            specs["l%d_wo" % l] = P("tp", None)
+            specs["l%d_w1" % l] = P(None, "tp")
+            specs["l%d_w2" % l] = P("tp", None)
+        return specs
 
     # ------------------------------------------------------------------
     def _qkv(self, p, l, x, n_rows):
@@ -139,16 +159,46 @@ class TinyCausalLM:
             # never admits before a decode write overwrites them
             k_pool = k_pool.at[l, blk, off].set(k)
             v_pool = v_pool.at[l, blk, off].set(v)
-            scores = jnp.einsum("ihd,jhd->hij", q, k) \
-                / jnp.sqrt(float(self.head_dim)).astype(q.dtype)
-            scores = jnp.where(causal[None], scores, -jnp.inf)
-            w = _softmax(scores)
-            att = jnp.einsum("hij,jhd->ihd", w, v).reshape(L, self.hidden)
+            if self.context_attention is None:
+                scores = jnp.einsum("ihd,jhd->hij", q, k) \
+                    / jnp.sqrt(float(self.head_dim)).astype(q.dtype)
+                scores = jnp.where(causal[None], scores, -jnp.inf)
+                w = _softmax(scores)
+                att = jnp.einsum("hij,jhd->ihd", w, v).reshape(
+                    L, self.hidden)
+            else:
+                att = self._fused_context_attention(q, k, v, causal)
             h = h + att @ p["l%d_wo" % l]
             h = self._mlp(p, l, h)
         last = _rms(h[length[0] - 1])
         logits = last @ p["embed"].T
         return logits[None], k_pool, v_pool
+
+    def _fused_context_attention(self, q, k, v, causal):
+        """Whole-prompt attention through the fused sequence-parallel
+        kernels (sharding.long_context_attention): the sequence axis
+        splits over the ``context_attention`` mesh axis, Ulysses when the
+        head count divides it, streaming ring otherwise.  Allclose — NOT
+        bitwise — to the dense path (both kernels mask with -1e30 and the
+        ring streams its softmax), and only traceable inside a shard_map
+        that binds the axis (ShardedDecodeModel(sp=n)).  Prompt buckets
+        the axis extent does not divide run the dense math below."""
+        import jax.numpy as jnp
+        from .sharding import long_context_attention
+        L = q.shape[0]
+
+        def dense(q4, k4, v4):
+            s = jnp.einsum("bhid,bhjd->bhij", q4, k4) \
+                / jnp.sqrt(float(self.head_dim)).astype(q4.dtype)
+            s = jnp.where(causal[None, None], s, -jnp.inf)
+            return jnp.einsum("bhij,bhjd->bhid", _softmax(s), v4)
+
+        q4, k4, v4 = (jnp.transpose(x, (1, 0, 2))[None]
+                      for x in (q, k, v))
+        att4 = long_context_attention(q4, k4, v4, causal=True,
+                                      axis_name=self.context_attention,
+                                      fallback=dense)
+        return jnp.transpose(att4[0], (1, 0, 2)).reshape(L, self.hidden)
 
     def decode_fn(self, p, tokens, positions, tables, k_pool, v_pool):
         """One fixed-shape decode step for every slot (live or dead)."""

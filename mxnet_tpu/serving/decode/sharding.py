@@ -1,0 +1,372 @@
+"""Tensor/sequence-sharded decode: any decode-model contract over a mesh.
+
+A model whose K/V pool or weights exceed one device serves through
+:class:`ShardedDecodeModel`, a wrapper that satisfies the SAME contract
+as the model it wraps (model.py docstring) but stores its state sharded
+over a ``tp`` mesh axis:
+
+* **paged K/V pools are head-sharded device arrays** — the pool keeps the
+  contract layout ``[layers, blocks, block_size, heads, dim]`` but the
+  heads axis is split ``heads/tp`` per device (page tables and the
+  block-0 trash-block convention are replicated, so the PagedKVCache
+  host-side accounting is untouched);
+* **weights are sharded per the model's ``partition_specs()``** — one
+  PartitionSpec per parameter (attention projections by head, MLP by the
+  wide axis), unresolvable or absent specs replicate;
+* **every contract fn runs as a ``shard_map``** over the mesh: each
+  device all-gathers the shards it needs *at use*, runs the inner
+  model's kernel on the full operand, and slices the K/V carry back to
+  its local head shard.  The gathered compute is replicated — arithmetic
+  identical to the single-device run — which is what makes sharded
+  decode BITWISE-equal to the unsharded reference (the PR 10 lesson:
+  GSPMD-propagated partitioning re-tiles reductions and breaks bitwise;
+  gather-at-use moves data, never changes the math).  The persistent
+  footprint is 1/tp per device; the transient gather is the price, and
+  the fused ``sp`` path below is the escape hatch when it matters.
+
+Long-context attention routes through the dormant ``parallel/`` kernels:
+:func:`long_context_attention` is an inside-``shard_map`` router that
+splits the sequence over an ``sp`` axis and dispatches Ulysses all-to-all
+head sharding (`ulysses.py`) when heads divide the axis, streaming ring
+attention (`ring_attention.py`) otherwise, then gathers the full output
+back.  MoE feed-forward layers shard experts the same way through
+:func:`expert_sharded_ffn` (`moe.py`).  Both are the *fused* production
+paths: numerically allclose to the dense reference (they mask with -1e30
+and stream the softmax), so a model opts in per layer — the default
+gather-at-use path keeps the bitwise gate.
+
+Sharding-shape validation happens HERE, eagerly, with ValueErrors naming
+both extents (the `shard_batch` convention) — never as a shape error
+inside ``shard_map``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["ShardedDecodeModel", "decode_mesh", "long_context_attention",
+           "expert_sharded_ffn", "check_tp_divisible",
+           "check_pool_matches_mesh", "POOL_HEAD_AXIS"]
+
+# contract pool layout [layers, blocks, block_size, heads, dim]: the axis
+# the 'tp' shards split
+POOL_HEAD_AXIS = 3
+
+
+def check_tp_divisible(name, extent, tp, what="head count", axis="tp"):
+    """Raise ValueError naming both extents unless ``extent % tp == 0``."""
+    if int(extent) % int(tp):
+        raise ValueError(
+            "%s: %s of %d is not divisible by the mesh %r axis extent %d"
+            % (name, what, int(extent), axis, int(tp)))
+    return int(extent) // int(tp)
+
+
+def check_pool_matches_mesh(name, pool_shape, mesh):
+    """A K/V pool is head-shardable over ``mesh`` iff its head axis
+    divides the 'tp' extent; raise naming both extents otherwise."""
+    tp = int(mesh.shape["tp"])
+    if len(pool_shape) != 5:
+        raise ValueError(
+            "%s: pool shape %r is not the contract layout "
+            "[layers, blocks, block_size, heads, dim]"
+            % (name, tuple(pool_shape)))
+    check_tp_divisible(name, pool_shape[POOL_HEAD_AXIS], tp,
+                       what="pool head axis")
+    return tp
+
+
+def decode_mesh(tp, sp=1, devices=None):
+    """Build the ('tp', 'sp') serving mesh over EXACTLY tp*sp devices.
+
+    ``make_mesh`` folds leftover devices into the leading axis — right
+    for training (use everything), wrong for serving where a tp=2 engine
+    must consume exactly 2 devices so the fleet can place others on the
+    rest.  Raises ValueError naming both extents when the machine cannot
+    honor the request."""
+    import jax
+    from jax.sharding import Mesh
+    tp, sp = int(tp), int(sp)
+    if tp < 1 or sp < 1:
+        raise ValueError("decode_mesh: tp=%d, sp=%d must both be >= 1"
+                         % (tp, sp))
+    if devices is None:
+        devices = jax.devices()
+    need = tp * sp
+    if len(devices) < need:
+        raise ValueError(
+            "decode_mesh: tp=%d x sp=%d needs %d device(s); only %d "
+            "available" % (tp, sp, need, len(devices)))
+    dev = _np.array(devices[:need]).reshape(tp, sp)
+    return Mesh(dev, ("tp", "sp"))
+
+
+# ---------------------------------------------------------------------------
+# fused long-context / MoE paths (inside-shard_map helpers)
+# ---------------------------------------------------------------------------
+
+def long_context_attention(q, k, v, causal=True, axis_name="sp",
+                           fallback=None):
+    """Sequence-parallel attention for use INSIDE a shard_map body.
+
+    Takes the FULL ``[B, H, T, D]`` operands (replicated across the
+    ``sp`` members, as the gather-at-use serving path leaves them),
+    splits the sequence so each member computes its T/n slice through
+    the Ulysses all-to-all kernel when ``H % n == 0`` — one head group
+    per member, full sequence per head — or the streaming ring kernel
+    otherwise, then all-gathers the slices back to the full output every
+    member returns.  Numerically allclose (NOT bitwise) to dense masked
+    attention: both kernels mask with -1e30 and the ring streams its
+    softmax.  T must divide the axis extent; when it does not (short
+    prompt buckets) the call routes to ``fallback(q, k, v)`` if given —
+    the model's own dense attention — and raises the ValueError naming
+    both extents otherwise."""
+    import jax
+    from ...parallel import allgather, axis_size, ring_attention, \
+        ulysses_attention_local
+    n = axis_size(axis_name)
+    T = q.shape[2]
+    if fallback is not None and (n == 1 or T % n):
+        return fallback(q, k, v)
+    loc = check_tp_divisible("long_context_attention", T, n,
+                             what="sequence length", axis=axis_name)
+    i = jax.lax.axis_index(axis_name)
+    ql, kl, vl = (jax.lax.dynamic_slice_in_dim(x, i * loc, loc, axis=2)
+                  for x in (q, k, v))
+    if q.shape[1] % n == 0:
+        out = ulysses_attention_local(ql, kl, vl, axis_name=axis_name,
+                                      causal=causal)
+    else:
+        out = ring_attention(ql, kl, vl, axis_name=axis_name,
+                             causal=causal)
+    return allgather(out, axis_name, axis=2, tiled=True)
+
+
+def expert_sharded_ffn(expert_fn, expert_params, gate_w, x, axis_name="sp",
+                       k=2, capacity_factor=2.0):
+    """Expert-parallel MoE feed-forward for use INSIDE a shard_map body.
+
+    ``x`` is a ``[tokens, hidden]`` batch replicated across the axis
+    members; experts dispatch through ``moe_apply`` (GShard dense
+    dispatch, Switch overflow) with the expert set spread over the axis.
+    The token count must divide the axis extent (moe_apply shards the
+    token batch; ValueError names both extents here, not inside the
+    collective)."""
+    from ...parallel import axis_size
+    from ...parallel.moe import moe_apply
+    n = axis_size(axis_name)
+    check_tp_divisible("expert_sharded_ffn", x.shape[0], n,
+                       what="token count", axis=axis_name)
+    check_tp_divisible("expert_sharded_ffn", gate_w.shape[-1], n,
+                       what="expert count", axis=axis_name)
+    return moe_apply(expert_fn, expert_params, gate_w, x,
+                     axis_name=axis_name, k=k,
+                     capacity_factor=capacity_factor)
+
+
+# ---------------------------------------------------------------------------
+# the sharded contract wrapper
+# ---------------------------------------------------------------------------
+
+class ShardedDecodeModel:
+    """Run a decode-model contract storage-sharded over a ('tp','sp') mesh.
+
+    Satisfies the full contract of the wrapped model (same attrs, same
+    fn signatures, ``chunk_prefill_fn``/``verify_fn``/``propose_fn``
+    present iff the inner model has them), so DecodeEngine, the prefix
+    cache, speculative decode, export/import handoff and the sequential
+    reference all compose unchanged.  Three extra hooks the engine picks
+    up when present:
+
+    * ``zeros_pool(shape)`` — fresh head-sharded K/V pool storage;
+    * ``place_inputs(x)`` — pins per-step host inputs replicated on the
+      mesh (a jit call cannot mix single-device-committed and
+      mesh-committed operands);
+    * ``tp_degree`` / ``sp_degree`` — the fleet's device-footprint
+      accounting (`FleetRouter.load_decode(..., tp=k)`).
+
+    Exported pages (`export_stream`) host-gather to the full head axis,
+    so sharded→sharded and sharded→unsharded handoffs are bitwise
+    round trips with no geometry change.
+    """
+
+    def __init__(self, model, tp=2, sp=1, devices=None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ...ndarray import NDArray
+        self._inner = model
+        self.tp = int(tp)
+        self.sp = int(sp)
+        self.tp_degree = self.tp
+        self.sp_degree = self.sp
+        # contract geometry proxies (export/import geometry dicts and the
+        # PagedKVCache pool grid come from these)
+        self.vocab_size = model.vocab_size
+        self.num_layers = model.num_layers
+        self.num_heads = model.num_heads
+        self.head_dim = model.head_dim
+        self.max_len = model.max_len
+        self.eos_id = getattr(model, "eos_id", None)
+        self._local_heads = check_tp_divisible(
+            type(model).__name__, model.num_heads, self.tp)
+        self.mesh = decode_mesh(self.tp, self.sp, devices)
+        if int(self.mesh.shape["tp"]) != self.tp:
+            raise ValueError(
+                "ShardedDecodeModel: mesh 'tp' extent %d does not match "
+                "the requested tp degree %d"
+                % (int(self.mesh.shape["tp"]), self.tp))
+        # no trailing None: shard_map normalizes its out_specs that way,
+        # and jit's executable cache keys on sharding EQUALITY — a fresh
+        # zeros_pool must carry the byte-same sharding as a pool carried
+        # out of a step, or the first post-warmup step stealth-recompiles
+        self._pool_sharding = NamedSharding(
+            self.mesh, P(None, None, None, "tp"))
+        self._replicated = NamedSharding(self.mesh, P())
+
+        # resolve one PartitionSpec per parameter and place the weights
+        raw = {}
+        if hasattr(model, "partition_specs"):
+            raw = dict(model.partition_specs())
+        inner_params = model.param_dict()
+        self._pspecs = {}
+        self._params = {}
+        for name in sorted(inner_params):
+            spec = self._check_spec(name, raw.get(name),
+                                    inner_params[name].shape)
+            self._pspecs[name] = spec
+            self._params[name] = NDArray(jax.device_put(
+                inner_params[name]._data, NamedSharding(self.mesh, spec)))
+
+        self._prefill_sm = self._build("prefill_fn", 3)
+        self._decode_sm = self._build("decode_fn", 3)
+        if hasattr(model, "chunk_prefill_fn"):
+            self._chunk_sm = self._build("chunk_prefill_fn", 4)
+            self.chunk_prefill_fn = self._make_call(self._chunk_sm, 4)
+        if hasattr(model, "verify_fn"):
+            self._verify_sm = self._build("verify_fn", 4)
+            self.verify_fn = self._make_call(self._verify_sm, 4)
+        if hasattr(model, "propose_fn"):
+            self._propose_sms = {}
+            self.propose_fn = self._propose_call
+
+    # -- contract surface ------------------------------------------------
+    def param_dict(self):
+        """Live mesh-sharded parameter handles (same-name contract)."""
+        return dict(self._params)
+
+    def prefill_fn(self, p, tokens, length, table, k_pool, v_pool):
+        return self._prefill_sm(p, (tokens, length, table), k_pool, v_pool)
+
+    def decode_fn(self, p, tokens, positions, tables, k_pool, v_pool):
+        return self._decode_sm(p, (tokens, positions, tables), k_pool,
+                               v_pool)
+
+    def _propose_call(self, p, tokens, positions, tables, k_pool, v_pool,
+                      num_tokens):
+        sm = self._propose_sms.get(int(num_tokens))
+        if sm is None:
+            inner = self._inner
+
+            def fn(pf, toks, pos, tabs, kf, vf, _n=int(num_tokens)):
+                return inner.propose_fn(pf, toks, pos, tabs, kf, vf, _n)
+
+            sm = self._build_fn(fn, 3)
+            self._propose_sms[int(num_tokens)] = sm
+        return sm(p, (tokens, positions, tables), k_pool, v_pool)
+
+    # -- engine hooks ----------------------------------------------------
+    def zeros_pool(self, shape):
+        """Fresh zeroed head-sharded pool storage for ``shape`` (the
+        contract layout; the head axis must divide tp)."""
+        import jax
+        import jax.numpy as jnp
+        from ...ndarray import NDArray
+        check_pool_matches_mesh(type(self._inner).__name__, shape,
+                                self.mesh)
+        return NDArray(jax.device_put(jnp.zeros(shape, jnp.float32),
+                                      self._pool_sharding))
+
+    def place_inputs(self, x):
+        """Pin a per-step operand on the serving mesh (replicated) unless
+        it already lives there; mesh-resident pools/params pass through
+        untouched so their shardings stay byte-stable across steps."""
+        import jax
+        from jax.sharding import NamedSharding
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
+            return x
+        return jax.device_put(x, self._replicated)
+
+    # -- internals -------------------------------------------------------
+    def _check_spec(self, name, spec, shape):
+        """Validate a parameter PartitionSpec eagerly: only the 'tp' axis,
+        one axis name per dim, and the dim must divide the extent."""
+        from jax.sharding import PartitionSpec as P
+        if spec is None:
+            return P()
+        entries = tuple(spec)
+        if len(entries) > len(shape):
+            raise ValueError(
+                "%s: partition spec %r has %d entries for a rank-%d "
+                "parameter" % (name, spec, len(entries), len(shape)))
+        for dim, ax in enumerate(entries):
+            if ax is None:
+                continue
+            if ax != "tp":
+                raise ValueError(
+                    "%s: partition spec %r names axis %r; decode weight "
+                    "sharding supports only the 'tp' mesh axis"
+                    % (name, spec, ax))
+            check_tp_divisible(name, shape[dim], self.tp,
+                               what="dim %d extent" % dim)
+        return P(*entries)
+
+    def _build(self, fn_name, n_small):
+        inner_fn = getattr(self._inner, fn_name)
+        return self._build_fn(inner_fn, n_small)
+
+    def _build_fn(self, inner_fn, n_small):
+        """shard_map the contract fn: gather shards at use, run the inner
+        kernel on full operands (replicated math => bitwise), slice the
+        K/V carries back to the local head shard."""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from ...parallel import allgather
+        pool_spec = P(None, None, None, "tp")
+        pspecs = dict(self._pspecs)
+        lh = self._local_heads
+
+        def gathered(v, spec):
+            for dim, ax in enumerate(tuple(spec)):
+                if ax is not None:
+                    v = allgather(v, ax, axis=dim, tiled=True)
+            return v
+
+        def body(p_local, small, k_local, v_local):
+            p_full = {n: gathered(v, pspecs[n])
+                      for n, v in p_local.items()}
+            k_full = allgather(k_local, "tp", axis=POOL_HEAD_AXIS,
+                               tiled=True)
+            v_full = allgather(v_local, "tp", axis=POOL_HEAD_AXIS,
+                               tiled=True)
+            out, kp, vp = inner_fn(p_full, *small, k_full, v_full)
+            i = jax.lax.axis_index("tp")
+            kp = jax.lax.dynamic_slice_in_dim(kp, i * lh, lh,
+                                              axis=POOL_HEAD_AXIS)
+            vp = jax.lax.dynamic_slice_in_dim(vp, i * lh, lh,
+                                              axis=POOL_HEAD_AXIS)
+            return out, kp, vp
+
+        return shard_map(
+            body, mesh=self.mesh,
+            in_specs=(pspecs, tuple(P() for _ in range(n_small)),
+                      pool_spec, pool_spec),
+            out_specs=(P(), pool_spec, pool_spec),
+            check_rep=False)
+
+    @staticmethod
+    def _make_call(sm, n_small):
+        def call(p, *args):
+            return sm(p, tuple(args[:n_small]), args[n_small],
+                      args[n_small + 1])
+        return call

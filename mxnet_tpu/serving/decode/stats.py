@@ -32,7 +32,7 @@ __all__ = ["DecodeStats"]
 class DecodeStats:
     """All counters for one decode engine.  Thread-safe."""
 
-    def __init__(self, engine_name, kv_capacity=0):
+    def __init__(self, engine_name, kv_capacity=0, tp_degree=1):
         self._lock = threading.Lock()
         self.requests = 0            # admitted streams
         self.ok = 0
@@ -49,6 +49,7 @@ class DecodeStats:
         self.step_slot_sum = 0       # live slots summed over steps
         self.live_seqs = 0
         self.kv_capacity = int(kv_capacity)  # allocatable pool blocks
+        self.tp_degree = int(tp_degree)      # mesh devices this engine spans
         self.kv_blocks_used = 0
         self.kv_blocks_free = int(kv_capacity)
         self.tokens_per_s = 0.0      # instantaneous, from the last step
@@ -72,6 +73,10 @@ class DecodeStats:
             "%s:prefix_blocks_shared" % engine_name)
         self._c_accept = domain.new_counter(
             "%s:spec_accept_rate" % engine_name)
+        # static for the engine's life: set once so every profiler dump
+        # carries the device footprint next to the per-step gauges
+        self._c_tp = domain.new_counter("%s:tp_degree" % engine_name)
+        self._c_tp.set_value(self.tp_degree)
 
     # -- event hooks ----------------------------------------------------
     def on_admitted(self):
@@ -213,6 +218,7 @@ class DecodeStats:
                                    if self.steps else 0.0),
                 "live_seqs": self.live_seqs,
                 "kv_capacity": self.kv_capacity,
+                "tp_degree": self.tp_degree,
                 "kv_blocks_used": self.kv_blocks_used,
                 "kv_blocks_free": self.kv_blocks_free,
                 "tokens_per_s": self.tokens_per_s,

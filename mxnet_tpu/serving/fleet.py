@@ -286,15 +286,19 @@ class _EngineSpec:
     """Everything needed to re-build a decode engine on a joining replica.
     ``factory(name)`` must return a warmed DecodeEngine; ``max_new`` is
     learned from the first committed engine (the QoS need estimate for
-    submissions that leave max_new_tokens to the engine default)."""
+    submissions that leave max_new_tokens to the engine default).  ``tp``
+    is the declared tensor-parallel degree: every placement of this
+    engine spans that many mesh devices (1 = unsharded), checked against
+    the built engine's ``tp_degree``."""
 
-    __slots__ = ("name", "factory", "replicas", "max_new")
+    __slots__ = ("name", "factory", "replicas", "max_new", "tp")
 
-    def __init__(self, name, factory, replicas):
+    def __init__(self, name, factory, replicas, tp=None):
         self.name = name
         self.factory = factory
         self.replicas = replicas
         self.max_new = 0
+        self.tp = tp
 
 
 class _StreamRec:
@@ -528,16 +532,28 @@ class FleetRouter:
             return sorted(self._specs)
 
     # -- stateful decode tier ---------------------------------------------
-    def load_decode(self, name, factory, replicas=1):
+    def load_decode(self, name, factory, replicas=1, tp=None):
         """Place decode engines for ``name`` on the ``replicas``
         least-loaded live replicas.  ``factory(name)`` must build one
         warmed :class:`~mxnet_tpu.serving.decode.DecodeEngine` (identical
         params per call — the fleet hands streams between copies and the
         merged output must be bitwise-consistent).  Each engine attaches
         to its replica's server, so a replica death tears its engines
-        down with it."""
+        down with it.
+
+        ``tp`` declares the engine's tensor-parallel degree: a tp=k
+        engine is mesh-backed (the factory wraps its model in
+        ``ShardedDecodeModel(tp=k)``) and consumes k devices per
+        placement in ``scaling_advice()``'s footprint accounting.  The
+        built engine's ``tp_degree`` must match the declaration —
+        mismatch fails the load with an MXNetError naming both.  KV
+        headroom needs no tp awareness: the engine reports its logical
+        pool once (the pool is head-SHARDED over the mesh, not
+        replicated), so summing placements never double-counts shards."""
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
+        if tp is not None and int(tp) < 1:
+            raise ValueError("tp must be >= 1 (or None for unsharded)")
         with self._lock:
             if self._closed:
                 raise MXNetError("fleet is stopped; create a new FleetRouter")
@@ -545,7 +561,9 @@ class FleetRouter:
                 raise MXNetError("%r is already loaded in the fleet" % name)
             if not any(r.state == LIVE for r in self._replicas.values()):
                 raise MXNetError("no live replicas; add_replica() first")
-            self._dspecs[name] = _EngineSpec(name, factory, int(replicas))
+            self._dspecs[name] = _EngineSpec(
+                name, factory, int(replicas),
+                tp=None if tp is None else int(tp))
             self._dplacement[name] = []
         try:
             self._rebalance()
@@ -941,7 +959,12 @@ class FleetRouter:
         """Derive scale-out/hold/scale-in advice from the live breaker +
         engine signals: sustained KV pressure or queue depth (or an
         unhealthy breaker) says scale out; a near-idle fleet says scale
-        in."""
+        in.  The advice also carries the mesh footprint — a tp=k engine
+        placement consumes k devices — so policies can see when scale-out
+        would overcommit the device budget."""
+        import jax
+
+        devices_total = jax.local_device_count()
         with self._lock:
             engines = list(self._dengines.values())
             breakers = list(self._dbreakers.values())
@@ -950,13 +973,16 @@ class FleetRouter:
         if not engines:
             return {"action": "hold", "kv_utilization": 0.0,
                     "queue_fill": 0.0, "unhealthy_breakers": 0,
+                    "devices_in_use": 0, "devices_total": devices_total,
                     "reasons": ["no decode engines placed"]}
         utils, fills = [], []
+        devices_in_use = 0
         for eng in engines:
             sig = eng.routing_signals()
             cap = max(1, sig["kv_capacity"])
             utils.append(1.0 - sig["kv_blocks_free"] / cap)
             fills.append(sig["queue_depth"] / max(1, sig["max_queue"]))
+            devices_in_use += max(1, int(sig.get("tp_degree", 1)))
         kv_util = sum(utils) / len(utils)
         queue_fill = max(fills)
         unhealthy = sum(1 for b in breakers if b.health() != HEALTHY)
@@ -976,8 +1002,13 @@ class FleetRouter:
         else:
             action = "hold"
             reasons = ["within thresholds"]
+        if action == "scale_out" and devices_in_use >= devices_total:
+            reasons.append("device budget exhausted: %d/%d devices in use"
+                           % (devices_in_use, devices_total))
         return {"action": action, "kv_utilization": kv_util,
                 "queue_fill": queue_fill, "unhealthy_breakers": unhealthy,
+                "devices_in_use": devices_in_use,
+                "devices_total": devices_total,
                 "reasons": reasons}
 
     def poll_scaling(self):
@@ -1259,6 +1290,19 @@ class FleetRouter:
                 except MXNetError:
                     failed.add((name, rep.rid))
                     continue
+                built_tp = int(getattr(eng, "tp_degree", 1))
+                if spec.tp is not None and built_tp != spec.tp:
+                    # a misdeclared degree corrupts the fleet's device
+                    # accounting, so fail the load loudly (the factory is
+                    # deterministic: the first, synchronous placement in
+                    # load_decode() hits this before any background pass)
+                    eng.stop()
+                    raise MXNetError(
+                        "decode engine %r was loaded with tp=%d but its "
+                        "factory built an engine with tp_degree=%d; wrap "
+                        "the factory's model in ShardedDecodeModel(tp=%d) "
+                        "or fix the load_decode(tp=...) declaration"
+                        % (name, spec.tp, built_tp, spec.tp))
                 try:
                     rep.server.attach_engine(eng)
                 except MXNetError:

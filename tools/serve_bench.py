@@ -32,12 +32,24 @@ Two load profiles:
   a BENCH_PREFIX_SPEC.json artifact.  The full-size exit gate requires
   >= 1.5x tok/s over the no-prefix-cache path and fewer full-prompt
   prefills than streams.
+* ``--profile sharded-decode`` — tensor-parallel serving at an EQUAL
+  device budget: the same mixed prompt/output-length stream workload
+  (with a seeded-sampling minority) through tp (default 2) unsharded
+  engines splitting the streams round-robin, then through ONE
+  ``ShardedDecodeModel(tp=...)`` engine with head-sharded K/V pools
+  taking every stream — both legs consume the same number of devices.
+  Reports tok/s, TTFT p50/p99, per-leg device counts, and the hard
+  correctness gates to a BENCH_SHARDED_DECODE.json artifact: every
+  stream OK, zero steady-state recompiles, zero leaked KV blocks, and
+  every OK stream (greedy AND sampled) BITWISE-equal to the
+  single-device reference on both legs.
 
 Usage:
   python tools/serve_bench.py                        # full batch run
   python tools/serve_bench.py --profile decode       # full decode run
   python tools/serve_bench.py --profile fleet-decode # drain-handoff bench
   python tools/serve_bench.py --profile prefix-spec  # stacked multipliers
+  python tools/serve_bench.py --profile sharded-decode  # tp=2 vs tp=1
   python tools/serve_bench.py --smoke [--profile decode]  # tier-1 smokes
   python tools/serve_bench.py --clients 16 --requests 64 --out bench.json
 """
@@ -562,13 +574,172 @@ def _prefix_spec_ok(report, require_speedup=True):
     return True
 
 
+def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
+                             max_new, seed, model_cfg, tp=2):
+    """Tensor-parallel vs replicated decode at an equal device budget.
+
+    The ``tp1`` leg runs ``tp`` independent single-device engines and
+    splits the stream list round-robin across them; the ``tp2`` leg runs
+    ONE engine over ``ShardedDecodeModel(tp=tp)`` — head-sharded K/V
+    pools, gathered compute — and takes every stream.  Both legs consume
+    exactly ``tp`` devices, see the identical seeded workload (mixed
+    prompt and output lengths, every 4th stream seeded-sampled), and are
+    held to the same bar: every stream's tokens BITWISE-equal to the
+    single-device reference for its (prompt, budget, sampling) triple.
+    The sharded leg's throughput is not expected to win on virtual CPU
+    devices (the all-gathers are real, the FLOPs savings are not); the
+    artifact's value is the correctness gates riding a real workload."""
+    from mxnet_tpu.serving.decode import (DecodeEngine, ShardedDecodeModel,
+                                          TinyCausalLM)
+
+    max_width = DecodeEngine.worst_case_width(max_prompt, max_new,
+                                              block_size)
+    per_stream = -(-(max_prompt + max_new) // block_size)
+    rng = np.random.RandomState(seed)
+    vocab = model_cfg["vocab_size"]
+    prompts = [rng.randint(0, vocab,
+                           rng.randint(1, max_prompt + 1)).tolist()
+               for _ in range(streams)]
+    budgets = [int(rng.randint(2, max_new + 1)) for _ in range(streams)]
+    sampling = [{"temperature": 0.8, "top_k": 8, "seed": 2000 + i}
+                if i % 4 == 3 else {} for i in range(streams)]
+
+    # single-device references: the bitwise bar for BOTH legs
+    ref_eng = DecodeEngine(TinyCausalLM(**model_cfg), name="bench-shard-ref",
+                           max_slots=slots, block_size=block_size,
+                           max_prompt_len=max_prompt,
+                           max_new_tokens=max_new, max_queue=streams,
+                           num_blocks=streams * per_stream + 1,
+                           width_blocks=[max_width])
+    try:
+        refs = [ref_eng.generate_reference(p, b, **opts).tolist()
+                for p, b, opts in zip(prompts, budgets, sampling)]
+    finally:
+        ref_eng.stop()
+
+    def one(tp_degree, n_engines):
+        share = -(-streams // n_engines)
+
+        def build(i):
+            model = TinyCausalLM(**model_cfg)
+            if tp_degree > 1:
+                model = ShardedDecodeModel(model, tp=tp_degree)
+            return DecodeEngine(model,
+                                name="bench-shard-tp%d-%d" % (tp_degree, i),
+                                max_slots=slots, block_size=block_size,
+                                max_prompt_len=max_prompt,
+                                max_new_tokens=max_new, max_queue=streams,
+                                num_blocks=share * per_stream + 1,
+                                width_blocks=[max_width])
+
+        t0 = time.monotonic()
+        engines = [build(i) for i in range(n_engines)]
+        warmup_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        handles = [engines[i % n_engines].submit(p, max_new_tokens=b,
+                                                 **opts)
+                   for i, (p, b, opts) in enumerate(zip(prompts, budgets,
+                                                        sampling))]
+        tokens = 0
+        ttfts = []
+        statuses = {}
+        bitwise = True
+        for i, h in enumerate(handles):
+            h.wait()
+            statuses[h.status] = statuses.get(h.status, 0) + 1
+            toks = list(h.tokens())
+            tokens += len(toks)
+            if h.status == "OK" and toks != refs[i]:
+                bitwise = False
+            if h.ttft_ms is not None:
+                ttfts.append(h.ttft_ms)
+        wall = time.monotonic() - t0
+        recompiles = leaked = peak = devices = 0
+        for e in engines:
+            snap = e.stats_snapshot()
+            kv = e.kv_stats()
+            recompiles += (snap["cache"]["recompiles"]
+                           - snap["warmup"]["cache"]["misses"])
+            leaked += kv["allocated_total"] - kv["freed_total"]
+            peak += kv["peak_used"]
+            devices += e.tp_degree
+            e.stop()
+        from mxnet_tpu.serving.stats import LatencyWindow
+        window = LatencyWindow(capacity=max(1, len(ttfts)))
+        for ms in ttfts:
+            window.add(ms)
+        pcts = {k: round(v, 3)
+                for k, v in window.percentiles(ps=(50, 99)).items()}
+        return {
+            "tp_degree": tp_degree,
+            "engines": n_engines,
+            "devices": devices,
+            "warmup_s": round(warmup_s, 3),
+            "wall_s": round(wall, 3),
+            "tokens_out": tokens,
+            "tokens_per_s": round(tokens / wall, 1) if wall else 0.0,
+            "ttft_ms": pcts,
+            "statuses": statuses,
+            "bitwise_equal_reference": bitwise,
+            "steady_state_recompiles": recompiles,
+            "kv_peak_blocks": peak,
+            "kv_leaked_blocks": leaked,
+        }
+
+    tp1 = one(1, tp)
+    tp2 = one(tp, 1)
+    return {
+        "profile": "sharded-decode",
+        "workload": {
+            "streams": streams,
+            "slots": slots,
+            "block_size": block_size,
+            "max_prompt_len": max_prompt,
+            "max_new_tokens": max_new,
+            "sampled_every": 4,
+            "tp": tp,
+            "seed": seed,
+            "model": dict(model_cfg),
+        },
+        "tp1": tp1,
+        "tp2": tp2,
+        "relative_tokens_per_s": (round(tp2["tokens_per_s"]
+                                        / tp1["tokens_per_s"], 3)
+                                  if tp1["tokens_per_s"] else 0.0),
+    }
+
+
+def _sharded_decode_ok(report):
+    """Exit gate for the sharded-decode profile: on BOTH equal-device
+    legs every stream finishes OK, every OK stream (greedy and sampled)
+    is bitwise-equal to the single-device reference, and zero
+    steady-state recompiles / leaked KV blocks; the legs must actually
+    consume the same device count and the sharded leg must report the
+    declared tp_degree."""
+    for leg in (report["tp1"], report["tp2"]):
+        if set(leg["statuses"]) != {"OK"}:
+            return False
+        if not leg["bitwise_equal_reference"]:
+            return False
+        if leg["steady_state_recompiles"] != 0 or leg["kv_leaked_blocks"]:
+            return False
+    if report["tp1"]["devices"] != report["tp2"]["devices"]:
+        return False
+    if report["tp2"]["tp_degree"] != report["workload"]["tp"]:
+        return False
+    return True
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="serve_bench", description=__doc__)
     ap.add_argument("--profile", choices=("batch", "decode", "fleet-decode",
-                                          "prefix-spec"),
+                                          "prefix-spec", "sharded-decode"),
                     default="batch")
     ap.add_argument("--replicas", type=int, default=2,
                     help="[fleet-decode] decode replicas (one is drained)")
+    ap.add_argument("--tp", type=int, default=2,
+                    help="[sharded-decode] tensor-parallel degree (also "
+                         "the unsharded leg's engine count)")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=40,
                     help="requests per client")
@@ -600,7 +771,43 @@ def main(argv=None):
             "decode": "BENCH_DECODE.json",
             "fleet-decode": "BENCH_FLEET_DECODE.json",
             "prefix-spec": "BENCH_PREFIX_SPEC.json",
+            "sharded-decode": "BENCH_SHARDED_DECODE.json",
         }.get(args.profile, "BENCH_SERVE.json"))
+
+    if args.profile == "sharded-decode":
+        # the mesh needs real (virtual) devices — set before jax loads
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        if args.smoke:
+            args.streams, args.slots = 12, 4
+            args.block_size, args.max_prompt, args.max_new = 4, 8, 12
+            model_cfg = dict(vocab_size=32, hidden=16, num_layers=1,
+                             num_heads=2, max_len=32, seed=7)
+        else:
+            # the single-engine decode defaults are oversized for a
+            # two-leg comparison bench; scale down unless overridden
+            if args.streams == ap.get_default("streams"):
+                args.streams = 32
+            if args.max_new == ap.get_default("max_new"):
+                args.max_new = 24
+            model_cfg = dict(vocab_size=48, hidden=32, num_layers=2,
+                             num_heads=2, max_len=128, seed=7)
+        report = run_sharded_decode_bench(
+            args.streams, args.slots, args.block_size, args.max_prompt,
+            args.max_new, args.seed, model_cfg, tp=args.tp)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        for key in ("tp1", "tp2"):
+            leg = report[key]
+            print("%s: %d engine(s) x tp=%d (%d device(s))  %s tok/s  "
+                  "ttft p50/p99: %s/%s ms  bitwise: %s"
+                  % (key, leg["engines"], leg["tp_degree"], leg["devices"],
+                     leg["tokens_per_s"], leg["ttft_ms"]["p50"],
+                     leg["ttft_ms"]["p99"], leg["bitwise_equal_reference"]))
+        print("relative: %sx  wrote %s"
+              % (report["relative_tokens_per_s"], args.out))
+        return 0 if _sharded_decode_ok(report) else 1
 
     if args.profile == "prefix-spec":
         if args.smoke:
