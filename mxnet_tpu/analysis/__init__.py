@@ -1,6 +1,6 @@
 """Static analysis passes over the TPU build (``tools/mxlint.py`` front end).
 
-Seven passes, one per defect class the green test suite cannot see:
+Eight passes, one per defect class the green test suite cannot see:
 
 * :mod:`.tracing_lint` — AST pass over ``mxnet_tpu/`` for tracer
   concretization, implicit host syncs inside fcompute bodies, and
@@ -21,6 +21,16 @@ Seven passes, one per defect class the green test suite cannot see:
   jit/CachedOp boundaries, and resource acquire/release pairing across
   exception edges.  Sanctioned syncs carry ``# mxflow: sync-ok(<reason>)``
   tags, cataloged in ``docs/SYNC_MAP.md``.
+* :mod:`.sharding_lint` — the mxshard SPMD pass (``spd``): propagates
+  mesh axes, ``P(...)`` partition specs, and ``shard_map`` region
+  boundaries across ``parallel/`` and ``serving/decode/``, then enforces
+  collective sanctions (``# mxshard: gather-ok(...)``), per-region
+  collective budgets (``# mxshard: budget(psum=1)``), axis-name validity,
+  eager divisibility guards, bitwise-path reduction hygiene, and
+  loop-carry re-shard detection.  Its dynamic twin is the per-(kind,
+  axis) counter table in :mod:`mxnet_tpu.parallel.collectives`; the two
+  are pinned to one ground truth in ``tests/test_mxshard.py`` and the
+  sanction catalog is ``docs/COLLECTIVE_MAP.md``.
 
 The pass registry (:data:`.common.PASS_REGISTRY`) is the single source of
 truth mapping pass names to rule-key prefixes and runners.  All passes emit :class:`.common.Finding` records keyed by stable identity

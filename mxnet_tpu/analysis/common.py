@@ -50,6 +50,8 @@ PASS_REGISTRY = {
             "runner": "mxnet_tpu.analysis.dataflow:run_rcp"},
     "res": {"rules": ("RES",),
             "runner": "mxnet_tpu.analysis.dataflow:run_res"},
+    "spd": {"rules": ("SPD",),
+            "runner": "mxnet_tpu.analysis.sharding_lint:run"},
 }
 
 PASSES = tuple(PASS_REGISTRY)

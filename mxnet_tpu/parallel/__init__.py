@@ -19,7 +19,9 @@ The reference only ships DP + manual model parallelism + sparse-PS semantics
 from .mesh import (make_mesh, default_mesh, data_parallel_spec, replicated_spec,
                    local_device_count, MeshConfig)
 from .collectives import (allreduce, allgather, reduce_scatter, ppermute_ring,
-                          barrier_sync, axis_size)
+                          barrier_sync, axis_size, pmean, all_to_all, ppermute,
+                          collective_counters, reset_collective_counters,
+                          collective_totals)
 from .data_parallel import make_data_parallel_train_step, shard_batch
 from .zero import (init_shard_update_state, make_sharded_update_step,
                    quantized_reduce_scatter, padded_size, flatten_param,

@@ -1,20 +1,106 @@
-"""Collective wrappers.
+"""Collective wrappers + the runtime collective-cost twin of the spd lint.
 
 The analog of the reference's Comm hierarchy + NCCL + ps-lite (SURVEY §5
 "Distributed communication backend"): every cross-device data movement is an
 XLA collective expressed through jax.lax inside shard_map/pjit regions.
+
+Every wrapper records a per-(kind, axis) call/byte sample into a
+process-wide counter table at **trace time** — the moment the Python
+wrapper runs inside the traced region.  For a ``shard_map`` called outside
+``jit`` that is once per invocation (the body re-traces each call), so the
+counter delta over one decode step equals the number of collective *sites*
+the static spd pass (analysis/sharding_lint.py) attributes to the region —
+the cross-check tests/test_mxshard.py pins.  Under ``jit`` the sample lands
+once per (re)compile instead; treat jitted deltas as "collectives per
+traced program", not per executed step.
+
+Bytes are the operand payload per participant (local shard nbytes at trace
+time); ``axis_size`` — ``psum`` of the literal 1, folded to a constant by
+the partitioner — is exempt from counting both here and in the static pass.
 """
 from __future__ import annotations
+
+import threading
+
+_COUNTER_LOCK = threading.Lock()
+_COUNTERS = {}        # (kind, axis) -> [calls, bytes]
+_PROF_COUNTERS = {}   # (kind, axis) -> profiler.Counter (calls)
+
+
+def _record_collective(kind, axis_name, x):
+    """One collective sample: bump the (kind, axis) call/byte counters and,
+    while a profiler session is running, mirror the call count as a profiler
+    Counter ("C" trace events; gated on profiling_active() because an
+    ungated per-trace write would grow the event buffer between dumps)."""
+    ax = str(axis_name)
+    try:
+        nbytes = int(x.size) * x.dtype.itemsize
+    except (AttributeError, TypeError):
+        nbytes = 0
+    with _COUNTER_LOCK:
+        cell = _COUNTERS.setdefault((kind, ax), [0, 0])
+        cell[0] += 1
+        cell[1] += nbytes
+        calls = cell[0]
+    from .. import profiler
+    if profiler.profiling_active():
+        key = (kind, ax)
+        with _COUNTER_LOCK:
+            ctr = _PROF_COUNTERS.get(key)
+            if ctr is None:
+                ctr = profiler.Domain("collectives").new_counter(
+                    "coll:%s:%s" % (kind, ax))
+                _PROF_COUNTERS[key] = ctr
+        ctr.set_value(calls)
+
+
+def collective_counters():
+    """Snapshot of the runtime collective counters:
+    ``{kind: {axis: {"calls": int, "bytes": int}}}``."""
+    out = {}
+    with _COUNTER_LOCK:
+        for (kind, ax), (calls, nbytes) in _COUNTERS.items():
+            out.setdefault(kind, {})[ax] = {"calls": calls, "bytes": nbytes}
+    return out
+
+
+def reset_collective_counters():
+    """Zero the counter table (and drop the profiler Counter mirrors so a
+    fresh profiling session starts its gauges from zero)."""
+    with _COUNTER_LOCK:
+        _COUNTERS.clear()
+        _PROF_COUNTERS.clear()
+
+
+def collective_totals(snapshot=None):
+    """Aggregate a :func:`collective_counters` snapshot across axes:
+    ``{kind: {"calls": int, "bytes": int}}``."""
+    snap = collective_counters() if snapshot is None else snapshot
+    out = {}
+    for kind, by_axis in snap.items():
+        calls = sum(c["calls"] for c in by_axis.values())
+        nbytes = sum(c["bytes"] for c in by_axis.values())
+        out[kind] = {"calls": calls, "bytes": nbytes}
+    return out
 
 
 def allreduce(x, axis_name="dp"):
     """psum over a mesh axis — the allreduce that replaces kvstore push/pull."""
     import jax
+    _record_collective("psum", axis_name, x)
     return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name="dp"):
+    """Mean-allreduce (psum / axis size) — loss averaging over replicas."""
+    import jax
+    _record_collective("psum", axis_name, x)
+    return jax.lax.pmean(x, axis_name)
 
 
 def allgather(x, axis_name="dp", axis=0, tiled=True):
     import jax
+    _record_collective("all_gather", axis_name, x)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
@@ -24,12 +110,32 @@ def reduce_scatter(x, axis_name="dp", scatter_dimension=0):
     (parallel/zero.py) needs.  Works on integer dtypes too, which is how
     the 2-bit wire format accumulates int8 codes in int32 in-graph."""
     import jax
+    _record_collective("reduce_scatter", axis_name, x)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
                                 tiled=True)
 
 
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=False):
+    """Shard exchange: split ``split_axis`` over the axis members, concat
+    the received blocks on ``concat_axis`` — the Ulysses head/sequence
+    re-shard and the MoE dispatch/return primitive."""
+    import jax
+    _record_collective("all_to_all", axis_name, x)
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    """Point-to-point shard permutation (collective-permute on ICI)."""
+    import jax
+    _record_collective("ppermute", axis_name, x)
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
 def axis_size(axis_name="dp"):
-    """The extent of a mesh axis, from inside the traced region."""
+    """The extent of a mesh axis, from inside the traced region.  A psum of
+    the literal 1 — folded to a trace-time constant, so NOT a collective
+    (exempt from the counters and from the static spd pass alike)."""
     import jax
     return jax.lax.psum(1, axis_name)
 
@@ -37,15 +143,13 @@ def axis_size(axis_name="dp"):
 def ppermute_ring(x, axis_name, shift=1):
     """Rotate shards around the ring — the building block of ring attention
     and of bandwidth-optimal bidirectional allreduce on ICI."""
-    import jax
     n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
-    return jax.lax.ppermute(x, axis_name, perm)
+    return ppermute(x, axis_name, perm)
 
 
 def barrier_sync(name="barrier"):
     """Multi-host barrier (ps::Postoffice::Barrier analog)."""
-    import jax
     try:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)

@@ -66,9 +66,9 @@ def moe_apply(expert_fn, expert_params, gate_w, x, axis_name="ep",
     """
     import jax
     import jax.numpy as jnp
-    from jax import lax
+    from .collectives import all_to_all, axis_size
 
-    n = lax.psum(1, axis_name)
+    n = axis_size(axis_name)
     T, d = x.shape
     E_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
     E = E_local * n
@@ -83,16 +83,16 @@ def moe_apply(expert_fn, expert_params, gate_w, x, axis_name="ep",
     # my block for expert-group g to device g, receiving every device's
     # block for MY experts stacked on a new leading axis
     dispatched = dispatched.reshape((n, E_local, C, d))
-    exchanged = lax.all_to_all(dispatched, axis_name, split_axis=0,
-                               concat_axis=0, tiled=False)  # (n, E/n, C, d)
+    exchanged = all_to_all(dispatched, axis_name, split_axis=0,  # mxshard: reshard-ok(MoE dispatch: route capacity blocks to their expert owners)
+                           concat_axis=0, tiled=False)  # (n, E/n, C, d)
     # fold senders into the capacity axis and run the local experts
     tokens = jnp.swapaxes(exchanged, 0, 1).reshape((E_local, n * C, d))
     outs = jax.vmap(expert_fn)(expert_params, tokens)      # (E/n, n*C, d_out)
     d_out = outs.shape[-1]
     outs = jnp.swapaxes(outs.reshape((E_local, n, C, d_out)), 0, 1)
     # route results back to their senders
-    returned = lax.all_to_all(outs, axis_name, split_axis=0,
-                              concat_axis=0, tiled=False)  # (n, E/n, C, d_out)
+    returned = all_to_all(outs, axis_name, split_axis=0,  # mxshard: reshard-ok(MoE combine: return expert outputs to their senders)
+                          concat_axis=0, tiled=False)  # (n, E/n, C, d_out)
     expert_out = returned.reshape((E, C, d_out))
     return jnp.einsum("tec,ecd->td", combine, expert_out)
 
@@ -111,7 +111,18 @@ def make_expert_parallel_moe(mesh, expert_fn, axis_name="ep", k=2,
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
+    n = int(mesh.shape[axis_name])
+
     def run(expert_params, gate_w, x):
+        E = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+        if E % n:
+            raise ValueError(
+                "expert-parallel moe: expert count of %d is not divisible "
+                "by the mesh %r axis extent %d" % (E, axis_name, n))
+        if x.shape[0] % n:
+            raise ValueError(
+                "expert-parallel moe: token batch of %d is not divisible "
+                "by the mesh %r axis extent %d" % (x.shape[0], axis_name, n))
         p_specs = jax.tree_util.tree_map(
             lambda l: P(axis_name, *([None] * (l.ndim - 1))), expert_params)
         fn = shard_map(

@@ -41,8 +41,9 @@ def pipeline_apply(stage_fn, stage_params, x_microbatches, axis_name="pp"):
     import jax
     import jax.numpy as jnp
     from jax import lax
+    from .collectives import allreduce, axis_size, ppermute
 
-    S = lax.psum(1, axis_name)
+    S = axis_size(axis_name)
     stage_idx = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
     p_local = jax.tree_util.tree_map(lambda l: l[0], stage_params)
@@ -64,13 +65,13 @@ def pipeline_apply(stage_fn, stage_params, x_microbatches, axis_name="pp"):
         out = jnp.where(write,
                         lax.dynamic_update_index_in_dim(out, y, widx, 0),
                         out)
-        state_next = lax.ppermute(y, axis_name, perm)
+        state_next = ppermute(y, axis_name, perm)  # mxshard: reshard-ok(pipeline tick: shift activations one stage forward, overlapped with compute)
         return (state_next, out), None
 
     (_, out), _ = lax.scan(tick, (state0, out0),
                            jnp.arange(M + S - 1, dtype=jnp.int32))
     # only the last stage wrote; replicate to all shards
-    return lax.psum(out, axis_name)
+    return allreduce(out, axis_name)  # mxshard: reduce-ok(replicate the last stage's outputs; psum gradient is identity, carrying the backward pipeline)
 
 
 def make_pipeline_step(stage_fn, mesh, n_microbatches, axis_name="pp",
@@ -94,12 +95,24 @@ def make_pipeline_step(stage_fn, mesh, n_microbatches, axis_name="pp",
         return jax.tree_util.tree_map(
             lambda l: P(axis_name, *([None] * (l.ndim - 1))), params)
 
+    S = int(mesh.shape[axis_name])
+
     def to_micro(x):
         B = x.shape[0]
+        if B % n_microbatches:
+            raise ValueError(
+                "pipeline: batch of %d is not divisible into %d "
+                "microbatches" % (B, n_microbatches))
         mb = B // n_microbatches
         return x.reshape((n_microbatches, mb) + x.shape[1:])
 
     def forward(params, x_micro):
+        leaves = jax.tree_util.tree_leaves(params)
+        if leaves and leaves[0].shape[0] % S:
+            raise ValueError(
+                "pipeline: leading stage axis of %d is not divisible by "
+                "the mesh %r axis extent %d"
+                % (leaves[0].shape[0], axis_name, S))
         fn = shard_map(
             functools.partial(pipeline_apply, stage_fn, axis_name=axis_name),
             mesh=mesh,

@@ -49,10 +49,11 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
+    from .collectives import axis_size, ppermute
 
     if scale is None:
         scale = 1.0 / _np.sqrt(q.shape[-1])
-    n = lax.psum(1, axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
@@ -79,8 +80,8 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
                                       m_, l_, o_, scale, mask_b)
         # rotate kv to the next rank; overlaps with next iteration's compute
         perm = [(j, (j + 1) % n) for j in range(n)]
-        k_next = lax.ppermute(k_, axis_name, perm)
-        v_next = lax.ppermute(v_, axis_name, perm)
+        k_next = ppermute(k_, axis_name, perm)  # mxshard: reshard-ok(ring rotation: one K block per hop, N-1 hops total, overlapped with compute)
+        v_next = ppermute(v_, axis_name, perm)  # mxshard: reshard-ok(ring rotation: one V block per hop, N-1 hops total, overlapped with compute)
         return m2, l2, o2, k_next, v_next
 
     m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v))
@@ -95,6 +96,11 @@ def sequence_parallel_attention(mesh, q, k, v, causal=False):
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
+    n = int(mesh.shape["sp"])
+    if q.shape[2] % n:
+        raise ValueError(
+            "ring attention: sequence length of %d is not divisible by the "
+            "mesh 'sp' axis extent %d" % (q.shape[2], n))
     spec = P(None, None, "sp", None)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),

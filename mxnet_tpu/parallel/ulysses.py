@@ -23,22 +23,29 @@ import numpy as _np
 def ulysses_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):
     """Run inside shard_map with q,k,v (B, H, T_local, D), T-sharded.
 
-    Requires H % n == 0.
+    Requires H % n == 0 (validated eagerly at trace time; the tiled
+    all_to_all would otherwise fail with an opaque shape error).
     """
     import jax.numpy as jnp
-    from jax import lax
+    from .collectives import all_to_all, axis_size
 
+    n = axis_size(axis_name)
+    if q.shape[1] % n:
+        raise ValueError(
+            "ulysses_attention_local: head count of %d is not divisible by "
+            "the mesh %r axis extent %d; use ring attention instead"
+            % (q.shape[1], axis_name, n))
     if scale is None:
         scale = 1.0 / _np.sqrt(q.shape[-1])
 
     # (B, H, T/n, D) -> (B, H/n, T, D): split heads, gather sequence
     def fwd(x):
-        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                              tiled=True)
+        return all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)  # mxshard: reshard-ok(Ulysses T->H re-shard: one a2a instead of N-1 K/V ppermutes)
 
     def rev(x):
-        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                              tiled=True)
+        return all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)  # mxshard: reshard-ok(Ulysses H->T re-shard restoring the sequence sharding)
 
     qh, kh, vh = fwd(q), fwd(k), fwd(v)
     s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
@@ -63,6 +70,10 @@ def ulysses_parallel_attention(mesh, q, k, v, causal=False, axis_name="sp"):
     if q.shape[1] % n:
         raise ValueError("ulysses needs heads (%d) divisible by %s axis (%d); "
                          "use ring attention instead" % (q.shape[1], axis_name, n))
+    if q.shape[2] % n:
+        raise ValueError(
+            "ulysses: sequence length of %d is not divisible by the mesh %r "
+            "axis extent %d" % (q.shape[2], axis_name, n))
     spec = P(None, None, axis_name, None)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),

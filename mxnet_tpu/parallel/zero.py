@@ -121,7 +121,7 @@ def quantized_reduce_scatter(grad_flat, residual, threshold, axis_name="dp",
     from .collectives import reduce_scatter
     n = axis_size if axis_size is not None else jax.lax.psum(1, axis_name)
     codes, new_residual = quantize_2bit(grad_flat, residual, threshold)
-    summed = reduce_scatter(codes.astype(jnp.int32), axis_name)
+    summed = reduce_scatter(codes.astype(jnp.int32), axis_name)  # mxshard: reduce-ok(2-bit gradient shard sum: int32 code accumulation, 1/4 the fp32 wire bytes)
     g_shard = summed.astype(grad_flat.dtype) * (threshold / n)
     return g_shard, new_residual
 
@@ -192,7 +192,7 @@ def make_sharded_update_step(loss_fn, optimizer_update, mesh,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
-    from .collectives import allgather, reduce_scatter
+    from .collectives import allgather, pmean, reduce_scatter
 
     _check_wire_format(wire_format)
     axis = "dp"
@@ -220,7 +220,7 @@ def make_sharded_update_step(loss_fn, optimizer_update, mesh,
 
         def body(params, opt_state, res_list, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            loss = jax.lax.pmean(loss, axis)
+            loss = pmean(loss, axis)  # mxshard: reduce-ok(scalar loss mean over replicas: one word per step)
             idx = jax.lax.axis_index(axis)
             g_shards, p_shards, new_res = [], [], []
             gl = tree.tree_leaves(grads)
@@ -232,7 +232,7 @@ def make_sharded_update_step(loss_fn, optimizer_update, mesh,
                         gf, res_list[i][0], wire_threshold, axis, dp)
                     new_res.append(r_new[None])
                 else:
-                    g_shard = reduce_scatter(gf, axis) / dp
+                    g_shard = reduce_scatter(gf, axis) / dp  # mxshard: reduce-ok(ZeRO gradient shard: reduce_scatter + all_gather moves the bytes of one allreduce)
                 pf = flatten_param(pl[i], meta.padded)
                 p_shards.append(jax.lax.dynamic_slice(
                     pf, (idx * meta.shard,), (meta.shard,)))
@@ -242,7 +242,7 @@ def make_sharded_update_step(loss_fn, optimizer_update, mesh,
                 tree.tree_unflatten(p_def, p_shards))
             out_p = []
             for meta, shard in zip(metas, tree.tree_leaves(new_p)):
-                full = allgather(shard, axis)
+                full = allgather(shard, axis)  # mxshard: gather-ok(ZeRO param regather: the all_gather half of the bandwidth-neutral sharded update)
                 out_p.append(unflatten_param(full, meta.shape, meta.size))
             return (tree.tree_unflatten(p_def, out_p), new_opt, new_res,
                     loss)

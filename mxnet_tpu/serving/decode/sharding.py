@@ -138,7 +138,7 @@ def long_context_attention(q, k, v, causal=True, axis_name="sp",
     else:
         out = ring_attention(ql, kl, vl, axis_name=axis_name,
                              causal=causal)
-    return allgather(out, axis_name, axis=2, tiled=True)
+    return allgather(out, axis_name, axis=2, tiled=True)  # mxshard: gather-ok(restore the full T axis every sp member returns; allclose fused path, not bitwise)
 
 
 def expert_sharded_ffn(expert_fn, expert_params, gate_w, x, axis_name="sp",
@@ -339,15 +339,19 @@ class ShardedDecodeModel:
         def gathered(v, spec):
             for dim, ax in enumerate(tuple(spec)):
                 if ax is not None:
-                    v = allgather(v, ax, axis=dim, tiled=True)
+                    v = allgather(v, ax, axis=dim, tiled=True)  # mxshard: gather-ok(gather-at-use weight tax: replicated math keeps decode bitwise; ROADMAP item 1 deletes this tag)
             return v
 
+        # the gather-at-use region does NO reductions — replicated math is
+        # the bitwise contract.  Item 1's compute-parallel kernels will
+        # raise this to the Megatron one-psum-per-block budget.
+        # mxshard: budget(psum=0)
         def body(p_local, small, k_local, v_local):
             p_full = {n: gathered(v, pspecs[n])
                       for n, v in p_local.items()}
-            k_full = allgather(k_local, "tp", axis=POOL_HEAD_AXIS,
+            k_full = allgather(k_local, "tp", axis=POOL_HEAD_AXIS,  # mxshard: gather-ok(gather-at-use K-pool tax: full head axis for the inner kernel; ROADMAP item 1 deletes this tag)
                                tiled=True)
-            v_full = allgather(v_local, "tp", axis=POOL_HEAD_AXIS,
+            v_full = allgather(v_local, "tp", axis=POOL_HEAD_AXIS,  # mxshard: gather-ok(gather-at-use V-pool tax: full head axis for the inner kernel; ROADMAP item 1 deletes this tag)
                                tiled=True)
             out, kp, vp = inner_fn(p_full, *small, k_full, v_full)
             i = jax.lax.axis_index("tp")

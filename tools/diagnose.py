@@ -80,13 +80,16 @@ def main():
     # killable-subprocess PROBE_OK protocol
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from relay_watcher import probe
-    got = probe(args.probe_timeout)
+    got, failure = probe(args.probe_timeout)
     if got:
         plat, n, kind = got.split(None, 2)
         print("backend up: platform=%s devices=%s kind=%s" % (plat, n, kind))
     else:
         print("probe FAILED or timed out — backend init hung (axon relay "
               "down?); CPU work still runs with JAX_PLATFORMS=cpu")
+        if failure:
+            print("probe failure class=%s: %s"
+                  % (failure.get("class"), failure.get("detail")))
     print("\ndiagnose done")
 
 

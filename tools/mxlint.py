@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """mxlint — framework-native static analysis for the TPU build.
 
-Runs seven passes (see docs/LINT.md) and exits non-zero iff any finding is
+Runs eight passes (see docs/LINT.md) and exits non-zero iff any finding is
 not covered by the checked-in baseline:
 
   tracing   AST pass over mxnet_tpu/ (tracer concretization, host syncs in
@@ -15,6 +15,9 @@ not covered by the checked-in baseline:
             hot regions (SYN; empty baseline, sync-ok tags -> SYNC_MAP)
   rcp       mxflow stealth-recompile hazards at jit/CachedOp boundaries
   res       mxflow resource acquire/release pairing across exception edges
+  spd       mxshard SPMD sharding lint over parallel/ and serving/decode/
+            (collective sanctions, region budgets, axis names, eager
+            divisibility; SPD; empty baseline, tags -> COLLECTIVE_MAP)
 
 Usage:
   python tools/mxlint.py                      # all passes, text output
@@ -22,6 +25,7 @@ Usage:
   python tools/mxlint.py --passes sync,rcp,res
   python tools/mxlint.py --since HEAD~1       # findings in changed files
   python tools/mxlint.py --sync-map           # regenerate docs/SYNC_MAP.md
+  python tools/mxlint.py --collective-map     # regenerate docs/COLLECTIVE_MAP.md
   python tools/mxlint.py --update-baseline    # rewrite .mxlint-baseline.json
   python tools/mxlint.py --no-baseline        # raw findings, no suppression
 """
@@ -54,6 +58,7 @@ def _load_registry():
 _REGISTRY = _load_registry()
 PASSES = _REGISTRY.PASSES
 DEFAULT_SYNC_MAP = os.path.join("docs", "SYNC_MAP.md")
+DEFAULT_COLLECTIVE_MAP = os.path.join("docs", "COLLECTIVE_MAP.md")
 
 
 def collect(passes, root):
@@ -95,12 +100,20 @@ def main(argv=None):
                     help="incremental mode: only report findings in files "
                          "changed vs REV (git diff + untracked); the "
                          "registry pass is skipped unless ops or tests "
-                         "changed, and stale-key detection is off (a "
+                         "changed, the spd pass unless parallel/ or "
+                         "serving/decode/ changed (and its findings then "
+                         "bypass the file filter — sharding facts cross "
+                         "files), and stale-key detection is off (a "
                          "partial view cannot prove a fix)")
     ap.add_argument("--sync-map", nargs="?", const=DEFAULT_SYNC_MAP,
                     default=None, metavar="PATH",
                     help="write the sanctioned host-sync catalog (default "
                          "%s) and exit" % DEFAULT_SYNC_MAP)
+    ap.add_argument("--collective-map", nargs="?",
+                    const=DEFAULT_COLLECTIVE_MAP, default=None,
+                    metavar="PATH",
+                    help="write the sanctioned-collective catalog (default "
+                         "%s) and exit" % DEFAULT_COLLECTIVE_MAP)
     ap.add_argument("--baseline",
                     default=os.path.join(REPO, ".mxlint-baseline.json"),
                     help="baseline/suppression file "
@@ -132,6 +145,18 @@ def main(argv=None):
               % (len(entries), path))
         return 0
 
+    if args.collective_map is not None:
+        from mxnet_tpu.analysis import sharding_lint
+        entries = sharding_lint.collective_map_entries(args.root)
+        path = args.collective_map
+        if not os.path.isabs(path):
+            path = os.path.join(args.root, path)
+        with open(path, "w") as f:
+            f.write(sharding_lint.render_collective_map(entries))
+        print("wrote %d sanctioned collective site(s) to %s"
+              % (len(entries[0]), path))
+        return 0
+
     changed = None
     if args.since is not None:
         try:
@@ -144,12 +169,21 @@ def main(argv=None):
             # the audit joins the op registry against the test corpus;
             # untouched ops and tests cannot change its verdict
             passes = [p for p in passes if p != "registry"]
+        if "spd" in passes:
+            from mxnet_tpu.analysis.sharding_lint import SCAN_PREFIXES
+            if not any(p.startswith(SCAN_PREFIXES) for p in changed):
+                # the sharding lint only reads parallel/ and serving/decode/
+                passes = [p for p in passes if p != "spd"]
         if not changed:
             passes = []
 
     findings, report = collect(passes, args.root)
     if changed is not None:
-        findings = [f for f in findings if f.path in changed]
+        # SPD findings escape the changed-file filter: sharding facts
+        # (mesh axes, partition specs, budgets) propagate across files,
+        # so an edit in parallel/ can surface a finding elsewhere
+        findings = [f for f in findings
+                    if f.path in changed or f.rule.startswith("SPD")]
 
     if args.update_baseline:
         if args.since is not None:

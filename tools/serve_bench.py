@@ -38,11 +38,15 @@ Two load profiles:
   engines splitting the streams round-robin, then through ONE
   ``ShardedDecodeModel(tp=...)`` engine with head-sharded K/V pools
   taking every stream — both legs consume the same number of devices.
-  Reports tok/s, TTFT p50/p99, per-leg device counts, and the hard
+  Reports tok/s, TTFT p50/p99, per-leg device counts, the per-decode-step
+  collective bill (gathers/step, psums/step, bytes/step from the runtime
+  counters in ``parallel.collectives``, cross-checked against the
+  mxshard static prediction — docs/COLLECTIVE_MAP.md), and the hard
   correctness gates to a BENCH_SHARDED_DECODE.json artifact: every
-  stream OK, zero steady-state recompiles, zero leaked KV blocks, and
-  every OK stream (greedy AND sampled) BITWISE-equal to the
-  single-device reference on both legs.
+  stream OK, zero steady-state recompiles, zero leaked KV blocks,
+  static collective prediction == runtime counters, and every OK stream
+  (greedy AND sampled) BITWISE-equal to the single-device reference on
+  both legs.
 
 Usage:
   python tools/serve_bench.py                        # full batch run
@@ -574,6 +578,62 @@ def _prefix_spec_ok(report, require_speedup=True):
     return True
 
 
+def measure_decode_step_collectives(model_cfg, tp, block_size):
+    """Per-decode-step collective cost of the sharded engine, measured
+    two independent ways and cross-checked:
+
+    * **runtime** — the per-(kind, axis) counter deltas from
+      ``parallel.collectives`` over ONE un-jitted ``decode_fn`` call (the
+      shard_map body re-traces per call, so trace-time counts are
+      per-step counts);
+    * **static** — ``analysis.sharding_lint.predict_decode_step_collectives``
+      derived from the partition specs alone, no tracing.
+
+    ``static_matches_runtime`` (calls AND bytes) is a
+    ``_sharded_decode_ok`` exit gate: the lint's abstract sharding model
+    must agree with what the wires actually carry."""
+    import jax.numpy as jnp
+    from mxnet_tpu.analysis.sharding_lint import (
+        predict_decode_step_collectives)
+    from mxnet_tpu.parallel.collectives import (collective_counters,
+                                                collective_totals,
+                                                reset_collective_counters)
+    from mxnet_tpu.serving.decode import ShardedDecodeModel, TinyCausalLM
+
+    model = ShardedDecodeModel(TinyCausalLM(**model_cfg), tp=tp)
+    S, W = 2, 2
+    pool_shape = (model.num_layers, S * W + 1, block_size,
+                  model.num_heads, model.head_dim)
+    k_pool = model.zeros_pool(pool_shape)
+    v_pool = model.zeros_pool(pool_shape)
+    p = {n: a._data for n, a in model.param_dict().items()}
+    reset_collective_counters()
+    model.decode_fn(p, jnp.zeros((S,), jnp.int32),
+                    jnp.zeros((S,), jnp.int32),
+                    jnp.zeros((S, W), jnp.int32),
+                    k_pool._data, v_pool._data)
+    per_axis = collective_counters()
+    totals = collective_totals()
+    reset_collective_counters()
+    predicted = predict_decode_step_collectives(model,
+                                                pool_shape=pool_shape)
+    gathers = totals.get("all_gather", {"calls": 0, "bytes": 0})
+    psums = totals.get("psum", {"calls": 0, "bytes": 0})
+    return {
+        "gathers_per_step": gathers["calls"],
+        "psums_per_step": psums["calls"],
+        "collective_bytes_per_step": sum(v["bytes"]
+                                         for v in totals.values()),
+        "per_kind": totals,
+        "per_axis": per_axis,
+        "static_predicted": predicted,
+        "static_matches_runtime": (
+            predicted["all_gather"]["calls"] == gathers["calls"]
+            and predicted["all_gather"]["bytes"] == gathers["bytes"]
+            and predicted["psum"]["calls"] == psums["calls"]),
+    }
+
+
 def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
                              max_new, seed, model_cfg, tp=2):
     """Tensor-parallel vs replicated decode at an equal device budget.
@@ -688,8 +748,11 @@ def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
 
     tp1 = one(1, tp)
     tp2 = one(tp, 1)
+    collectives = measure_decode_step_collectives(model_cfg, tp,
+                                                  block_size)
     return {
         "profile": "sharded-decode",
+        "collectives": collectives,
         "workload": {
             "streams": streams,
             "slots": slots,
@@ -726,6 +789,8 @@ def _sharded_decode_ok(report):
     if report["tp1"]["devices"] != report["tp2"]["devices"]:
         return False
     if report["tp2"]["tp_degree"] != report["workload"]["tp"]:
+        return False
+    if not report["collectives"]["static_matches_runtime"]:
         return False
     return True
 
@@ -805,6 +870,12 @@ def main(argv=None):
                   % (key, leg["engines"], leg["tp_degree"], leg["devices"],
                      leg["tokens_per_s"], leg["ttft_ms"]["p50"],
                      leg["ttft_ms"]["p99"], leg["bitwise_equal_reference"]))
+        coll = report["collectives"]
+        print("collectives/step: %d gather(s), %d psum(s), %d byte(s)  "
+              "static==runtime: %s"
+              % (coll["gathers_per_step"], coll["psums_per_step"],
+                 coll["collective_bytes_per_step"],
+                 coll["static_matches_runtime"]))
         print("relative: %sx  wrote %s"
               % (report["relative_tokens_per_s"], args.out))
         return 0 if _sharded_decode_ok(report) else 1
