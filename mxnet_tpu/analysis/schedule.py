@@ -92,14 +92,24 @@ Invariants asserted (per seed)
   drains whole on every shard (host accounting + tp_degree signals),
   router/engine conservation holds, and the warmed shard_map signatures
   never recompile (see ``sharded_decode_storm``).
+* **disaggregated tier storm** (``disagg``) — greedy and seeded sampled
+  streams through a ``DisaggRouter`` (prefill-only tier handing off at
+  first token to a decode tier) while one PREFILL replica is killed and
+  one DECODE replica is drained mid-run: cross-tier conservation settles
+  on the prefill router's single ledger, OK streams stay bitwise-equal
+  to the colocated reference across the handoff, killed streams leave
+  strict prefixes that RE-ADMIT and continue the greedy path bitwise,
+  KV pools drain whole on both tiers, and surviving engines never
+  recompile (see ``disagg_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
 and ``tests/test_faults.py``/``tests/test_fleet.py``/
 ``tests/test_decode_fleet.py``/``tests/test_decode_prefix.py``/
-``tests/test_sharded_decode.py`` gate the fault-driven scenarios
-(``faults``, ``crash``, ``fleet``, ``decode_fleet``, ``decode_prefix``,
-``sharded_decode``) on the smaller ``FAULT_SMOKE_SEEDS`` set.
+``tests/test_sharded_decode.py``/``tests/test_disagg.py`` gate the
+fault-driven scenarios (``faults``, ``crash``, ``fleet``,
+``decode_fleet``, ``decode_prefix``, ``sharded_decode``, ``disagg``) on
+the smaller ``FAULT_SMOKE_SEEDS`` set.
 """
 from __future__ import annotations
 
@@ -2221,12 +2231,380 @@ def sharded_decode_storm(router, name, prompts, refs, sam_refs, seed):
 
 
 # ---------------------------------------------------------------------------
+# scenario: disaggregated prefill/decode tier storm (disagg)
+# ---------------------------------------------------------------------------
+
+_DISAGG_PROMPTS = ((5, 3, 7, 1), (2, 6, 4), (9, 8, 1, 2, 3), (7, 7),
+                   (1, 2, 3, 4, 5))
+_DISAGG_MAX_NEW = 5
+_DISAGG_TEMP = 0.8
+_DISAGG_TOPK = 6
+_DISAGG_SEED0 = 12000   # sampled stream of prompt i uses seed 12000 + i
+
+
+def _build_disagg_fixture():
+    """-> (disagg_router, engine_name, prompts, greedy_refs, sampled_refs).
+
+    Two prefill-only replicas handing off at first token to two decode
+    replicas — the smallest topology where killing one prefill AND
+    draining one decode replica both leave a survivor.  All engines run
+    the chunked path over the same seeded weights; the references come
+    from a colocated chunked engine, so the scenario's bitwise claim is
+    disaggregated-vs-colocated across the tier boundary.
+    ``max_prompt_len`` leaves room above the longest prompt so a killed
+    stream's prompt + emitted prefix can RE-ADMIT as a new prompt."""
+    from ..serving.decode import DecodeEngine, TinyCausalLM
+    from ..serving.disagg import DisaggRouter
+
+    model_kw = dict(vocab_size=24, hidden=16, num_layers=1, num_heads=2,
+                    max_len=24, seed=17)
+    engine_kw = dict(max_slots=2, block_size=4, num_blocks=24,
+                     max_prompt_len=12, max_new_tokens=_DISAGG_MAX_NEW,
+                     max_queue=8, breaker_threshold=4,
+                     breaker_backoff_ms=15.0, prefill_chunk=4)
+
+    def prefill_factory(name):
+        return DecodeEngine(TinyCausalLM(**model_kw), name=name,
+                            prefill_only=True, **engine_kw)
+
+    def decode_factory(name):
+        return DecodeEngine(TinyCausalLM(**model_kw), name=name,
+                            **engine_kw)
+
+    router = DisaggRouter(prefill_replicas=2, decode_replicas=2,
+                          failover_budget=2, breaker_threshold=3,
+                          breaker_backoff_ms=10.0)
+    router.load("dglm", prefill_factory, decode_factory,
+                prefill_replicas=2, decode_replicas=2)
+    ref_eng = DecodeEngine(TinyCausalLM(**model_kw), name="dgref",
+                           **engine_kw)
+    try:
+        refs = [ref_eng.generate_reference(list(p),
+                                           _DISAGG_MAX_NEW).tolist()
+                for p in _DISAGG_PROMPTS]
+        sam_refs = [ref_eng.generate_reference(
+                        list(p), _DISAGG_MAX_NEW, temperature=_DISAGG_TEMP,
+                        top_k=_DISAGG_TOPK,
+                        seed=_DISAGG_SEED0 + i).tolist()
+                    for i, p in enumerate(_DISAGG_PROMPTS)]
+    finally:
+        ref_eng.stop()
+    return (router, "dglm", [list(p) for p in _DISAGG_PROMPTS], refs,
+            sam_refs)
+
+
+def _disagg_engine_snaps(router, name):
+    """{"tier/rid": engine snapshot} across both tiers."""
+    stats = router.stats()
+    out = {}
+    for tier in ("prefill", "decode"):
+        for rid, s in stats[tier]["engines"].get(name, {}).items():
+            out["%s/%s" % (tier, rid)] = s
+    return out
+
+
+def disagg_storm(router, name, prompts, refs, sam_refs, seed):
+    """Storm over both tiers with a prefill kill AND a decode drain (the
+    ``disagg`` scenario).
+
+    Greedy and explicitly-seeded sampled streams are admitted at the
+    prefill tier and hand off at first token to the decode tier while a
+    disruptor KILLS one live prefill replica and DRAINS one live decode
+    replica mid-run.  Invariants:
+
+    * **no torn streams** — an OK stream's tokens equal the COLOCATED
+      reference for its (prompt, seed) bitwise, across the tier handoff
+      and any drain-driven decode→decode migration; TIMEOUT/UNAVAILABLE
+      partials are strict prefixes; shed streams carry zero tokens;
+    * **prefix re-admission** — a greedy stream the kill terminated
+      UNAVAILABLE re-admits as prompt + prefix and continues the greedy
+      reference path bitwise (the fencing protocol yields usable
+      prefixes, not just non-torn ones);
+    * **cross-tier conservation** — the prefill router's single ledger
+      satisfies ``requests == ok + timeouts + errors + unavailable``
+      and matches the client tally with zero ERROR streams; per-engine
+      ``requests + imported == terminal + handed_off`` holds on every
+      surviving engine of BOTH tiers;
+    * **pools whole on both tiers** — every surviving engine drains to
+      used == reserved == live_sequences == 0 with ``allocated_total ==
+      freed_total``;
+    * **zero steady-state recompiles** — first-token handoff, adoption,
+      and the decode drain all ride warmed signatures on engines that
+      lived the whole seed;
+    * **repair + replay** — a fresh prefill replica joins (warmed
+      before cutover), the drained decode replica re-enables, both
+      placements re-converge, and one greedy plus one sampled probe
+      reach OK bitwise-equal to the colocated references, with the
+      cross-tier handoff counter demonstrably advanced.
+    """
+    from ..serving import server as srv
+
+    violations = []
+    rng = random.Random(seed ^ 0xD15A)
+    before = router.prefill.decode_stats.snapshot()
+    before_hand = router.stats_sink.snapshot()
+    before_eng = _disagg_engine_snaps(router, name)
+
+    n_clients, per_client = 3, 2
+    plans = []   # [(timeout_ms or None, prompt_idx, sampled), ...]
+    for c in range(n_clients):
+        plan = []
+        for s in range(per_client):
+            tmo = rng.uniform(200.0, 1500.0) if rng.random() < 0.15 \
+                else None
+            plan.append((tmo, rng.randrange(len(prompts)),
+                         rng.random() < 0.35))
+        plans.append(plan)
+    results = [[] for _ in plans]
+
+    def client(c):
+        for tmo, pi, sampled in plans[c]:
+            if sampled:
+                stream = router.submit_stream(
+                    name, list(prompts[pi]),
+                    max_new_tokens=_DISAGG_MAX_NEW, timeout_ms=tmo,
+                    temperature=_DISAGG_TEMP, top_k=_DISAGG_TOPK,
+                    seed=_DISAGG_SEED0 + pi)
+            else:
+                stream = router.submit_stream(
+                    name, list(prompts[pi]),
+                    max_new_tokens=_DISAGG_MAX_NEW, timeout_ms=tmo)
+            if not stream.wait(_JOIN_TIMEOUT_S):
+                violations.append("disagg: stream of client %d never "
+                                  "terminated" % c)
+            results[c].append((pi, sampled, stream))
+
+    killed, drained = [], []
+
+    def disruptor():
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            d = router.prefill.decode_stats.snapshot()
+            if d["requests"] - before["requests"] >= 2:
+                break
+            time.sleep(0.002)
+        # kill one prefill replica: streams still prefilling there fence
+        # to UNAVAILABLE prefixes, streams already handed off must be
+        # untouched (their pins were detached at handoff)
+        p_live = [rid for rid, state
+                  in sorted(router.prefill.replicas().items())
+                  if state == "LIVE"]
+        if len(p_live) < 2:
+            violations.append("disagg: %d live prefill replica(s) before "
+                              "the kill (want >= 2)" % len(p_live))
+        else:
+            rid_k = p_live[rng.randrange(len(p_live))]
+            router.prefill.kill_replica(rid_k)
+            killed.append(rid_k)
+        # drain one decode replica: its adopted streams migrate to the
+        # surviving decode engine via the fenced export/import protocol
+        d_live = [rid for rid, state
+                  in sorted(router.decode.replicas().items())
+                  if state == "LIVE"]
+        if len(d_live) < 2:
+            violations.append("disagg: %d live decode replica(s) before "
+                              "the drain (want >= 2)" % len(d_live))
+        else:
+            rid_d = d_live[rng.randrange(len(d_live))]
+            router.decode.drain(rid_d)
+            drained.append(rid_d)
+
+    workers = [lambda c=c: client(c) for c in range(len(plans))]
+    workers.append(disruptor)
+    violations.extend(_spawn(workers))
+
+    # client-side status + token integrity vs the colocated reference
+    tally = {"admitted": 0, "OK": 0, "TIMEOUT": 0, "ERROR": 0,
+             "UNAVAILABLE": 0, "shed": 0, "rejected": 0}
+    readmit = None   # (prompt_idx, prefix) of a killed greedy stream
+    for c in range(len(plans)):
+        for pi, sampled, stream in results[c]:
+            status, tokens, _, _, _err = stream.snapshot()
+            if status is None:
+                violations.append("disagg: client %d stream has no "
+                                  "terminal status" % c)
+                continue
+            if stream.admitted:
+                tally["admitted"] += 1
+                if status not in (srv.OK, srv.TIMEOUT, srv.ERROR,
+                                  srv.UNAVAILABLE):
+                    violations.append("disagg: admitted stream ended %r"
+                                      % status)
+                    continue
+                tally[status] += 1
+            elif status == srv.OVERLOADED:
+                tally["shed"] += 1
+            elif status == srv.UNAVAILABLE:
+                tally["rejected"] += 1
+            else:
+                violations.append("disagg: rejected stream ended %r"
+                                  % status)
+                continue
+            ref = sam_refs[pi] if sampled else refs[pi]
+            kind = "sampled" if sampled else "greedy"
+            toks = list(tokens)
+            if status == srv.OK and toks != ref:
+                violations.append(
+                    "disagg: torn %s stream: client %d OK tokens %s != "
+                    "colocated reference %s" % (kind, c, toks, ref))
+            elif status in (srv.TIMEOUT, srv.UNAVAILABLE) and \
+                    toks != ref[:len(toks)]:
+                violations.append(
+                    "disagg: contaminated %s partial: client %d %s tokens "
+                    "%s not a prefix of %s" % (kind, c, status, toks, ref))
+            elif status == srv.OVERLOADED and toks:
+                violations.append("disagg: shed stream carries %d "
+                                  "token(s)" % len(toks))
+            if readmit is None and not sampled and stream.admitted \
+                    and status == srv.UNAVAILABLE \
+                    and 0 < len(toks) < len(ref):
+                readmit = (pi, toks)
+
+    # cross-tier conservation on the prefill router's single ledger
+    keys = ("requests", "ok", "timeouts", "errors", "unavailable", "shed",
+            "invalid", "unavailable_rejected")
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = router.prefill.decode_stats.snapshot()
+        d = {k: after[k] - before[k] for k in keys}
+        terminal_sum = (d["ok"] + d["timeouts"] + d["errors"]
+                        + d["unavailable"])
+        if d["requests"] == terminal_sum or time.monotonic() >= settle_until:
+            break
+        time.sleep(0.005)
+    if d["requests"] != terminal_sum:
+        violations.append("disagg: lost streams across the tier boundary: "
+                          "%d admitted, %d terminal"
+                          % (d["requests"], terminal_sum))
+    if d["requests"] != tally["admitted"]:
+        violations.append("disagg: admission mismatch: router %d vs "
+                          "clients %d" % (d["requests"], tally["admitted"]))
+    for client_key, fleet_key in (("OK", "ok"), ("TIMEOUT", "timeouts"),
+                                  ("ERROR", "errors"),
+                                  ("UNAVAILABLE", "unavailable"),
+                                  ("shed", "shed"),
+                                  ("rejected", "unavailable_rejected")):
+        if d[fleet_key] != tally[client_key]:
+            violations.append("disagg: %s mismatch: router %d vs clients "
+                              "%d" % (fleet_key, d[fleet_key],
+                                      tally[client_key]))
+    if d["errors"]:
+        violations.append("disagg: %d ERROR stream(s) with no faults "
+                          "injected" % d["errors"])
+
+    # pools whole + per-engine conservation + recompiles, on BOTH tiers
+    # (blocks are freed before the terminal is tallied, so settle on the
+    # conservation identity too, not just on empty pools)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        snaps = _disagg_engine_snaps(router, name)
+        if all(s["kv"]["used"] == 0 and s["kv"]["reserved"] == 0
+               and s["kv"]["live_sequences"] == 0
+               and s["requests"] + s["imported"] == (
+                   s["ok"] + s["timeouts"] + s["errors"]
+                   + s["unavailable"] + s["handed_off"])
+               for s in snaps.values()):
+            break
+        time.sleep(0.005)
+    snaps = _disagg_engine_snaps(router, name)
+    for key, s in snaps.items():
+        kv = s["kv"]
+        if kv["used"] != 0 or kv["reserved"] != 0 \
+                or kv["live_sequences"] != 0:
+            violations.append("disagg: KV pool not whole on %s: %r"
+                              % (key, {k: kv[k] for k in
+                                       ("used", "reserved",
+                                        "live_sequences")}))
+        if kv["allocated_total"] != kv["freed_total"]:
+            violations.append("disagg: KV leak on %s: allocated %d != "
+                              "freed %d" % (key, kv["allocated_total"],
+                                            kv["freed_total"]))
+        if s["requests"] + s["imported"] != (
+                s["ok"] + s["timeouts"] + s["errors"] + s["unavailable"]
+                + s["handed_off"]):
+            violations.append("disagg: engine conservation broken on %s: "
+                              "req %d + imported %d != ok %d + to %d + "
+                              "err %d + unavail %d + handed %d"
+                              % (key, s["requests"], s["imported"],
+                                 s["ok"], s["timeouts"], s["errors"],
+                                 s["unavailable"], s["handed_off"]))
+        prev = before_eng.get(key)
+        if prev is not None and \
+                s["cache"]["recompiles"] != prev["cache"]["recompiles"]:
+            violations.append("disagg: steady-state recompile on %s: "
+                              "%d -> %d"
+                              % (key, prev["cache"]["recompiles"],
+                                 s["cache"]["recompiles"]))
+
+    # repair for the next seed: a fresh prefill replica joins (the
+    # rebalancer warms its engine before placement commits), the drained
+    # decode replica re-enables, then replay probes cross the boundary
+    if killed:
+        router.prefill.add_replica()
+    for rid in drained:
+        if router.decode.replicas().get(rid) == "DRAINING":
+            router.decode.enable(rid)
+    if not router.prefill.wait_converged(timeout_s=10.0):
+        violations.append("disagg: prefill placement never re-converged: "
+                          "%r" % router.prefill.stats()["decode_models"])
+    if not router.decode.wait_converged(timeout_s=10.0):
+        violations.append("disagg: decode placement never re-converged: "
+                          "%r" % router.decode.stats()["decode_models"])
+    probe = router.submit_stream(name, list(prompts[0]),
+                                 max_new_tokens=_DISAGG_MAX_NEW)
+    probe.wait(_JOIN_TIMEOUT_S)
+    status, tokens, _, _, err = probe.snapshot()
+    if status != srv.OK or list(tokens) != refs[0]:
+        violations.append("disagg: post-repair greedy probe ended %r (%r)"
+                          % (status, err))
+    probe = router.submit_stream(name, list(prompts[1]),
+                                 max_new_tokens=_DISAGG_MAX_NEW,
+                                 temperature=_DISAGG_TEMP,
+                                 top_k=_DISAGG_TOPK,
+                                 seed=_DISAGG_SEED0 + 1)
+    probe.wait(_JOIN_TIMEOUT_S)
+    status, tokens, _, _, err = probe.snapshot()
+    if status != srv.OK or list(tokens) != sam_refs[1]:
+        violations.append("disagg: post-repair sampled probe ended %r (%r)"
+                          % (status, err))
+    if readmit is not None:
+        # the kill's prefix must RE-ADMIT and continue the greedy path:
+        # greedy decode is deterministic, so prompt + prefix decodes to
+        # exactly the reference's remaining tokens
+        pi, prefix = readmit
+        want = refs[pi][len(prefix):]
+        probe = router.submit_stream(name, list(prompts[pi]) + prefix,
+                                     max_new_tokens=len(want))
+        probe.wait(_JOIN_TIMEOUT_S)
+        status, tokens, _, _, err = probe.snapshot()
+        if status != srv.OK or list(tokens) != want:
+            violations.append("disagg: re-admitted prefix diverged: %r "
+                              "tokens %r != %r (%r)"
+                              % (status, list(tokens), want, err))
+    hand = router.stats_sink.snapshot()
+    if hand["handoffs"] - before_hand["handoffs"] < 1:
+        violations.append("disagg: no cross-tier handoff happened all "
+                          "seed (%d -> %d)"
+                          % (before_hand["handoffs"], hand["handoffs"]))
+    # settle so a late terminal hook can't straddle the next seed's
+    # `before` snapshot
+    settle_until = time.monotonic() + 5.0
+    while time.monotonic() < settle_until:
+        s = router.prefill.decode_stats.snapshot()
+        if s["requests"] == (s["ok"] + s["timeouts"] + s["errors"]
+                             + s["unavailable"]):
+            break
+        time.sleep(0.002)
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
              "crash", "decode", "fleet", "decode_fleet", "decode_prefix",
-             "sharded_decode")
+             "sharded_decode", "disagg")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -2258,6 +2636,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                            if "decode_prefix" in scenarios else None)
         dshard_fixture = (_build_sharded_decode_fixture()
                           if "sharded_decode" in scenarios else None)
+        disagg_fixture = (_build_disagg_fixture()
+                          if "disagg" in scenarios else None)
         try:
             for seed in seeds:
                 sched.reseed(seed)
@@ -2305,6 +2685,11 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                         dshard_fixture[0], dshard_fixture[1],
                         dshard_fixture[2], dshard_fixture[3],
                         dshard_fixture[4], seed)
+                if disagg_fixture is not None:
+                    per_seed["disagg"] = disagg_storm(
+                        disagg_fixture[0], disagg_fixture[1],
+                        disagg_fixture[2], disagg_fixture[3],
+                        disagg_fixture[4], seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n
@@ -2326,6 +2711,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                 dprefix_fixture[0].stop()
             if dshard_fixture is not None:
                 dshard_fixture[0].stop()
+            if disagg_fixture is not None:
+                disagg_fixture[0].stop()
     report["preemptions"] = sched.preemptions
     report["elapsed_s"] = time.monotonic() - t0
     return report

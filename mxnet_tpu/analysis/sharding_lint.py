@@ -76,7 +76,8 @@ __all__ = ["run", "analyze_source", "collective_sites",
            "SCAN_PREFIXES"]
 
 #: repo-relative path prefixes the pass scans (and --since triggers on)
-SCAN_PREFIXES = ("mxnet_tpu/parallel/", "mxnet_tpu/serving/decode/")
+SCAN_PREFIXES = ("mxnet_tpu/parallel/", "mxnet_tpu/serving/decode/",
+                 "mxnet_tpu/serving/disagg/")
 #: the wrapper/instrumentation module — definitions, not uses
 _WRAPPER_MODULE = "mxnet_tpu/parallel/collectives.py"
 #: paths on the bitwise-gated serving contract (SPD005)

@@ -20,10 +20,12 @@ from .server import (ModelServer, InferenceResult,
                      UNAVAILABLE)
 from .fleet import FleetRouter, FleetStats, DecodeFleetStats
 from . import decode
+from . import disagg
+from . import traffic
 
 __all__ = ["ModelServer", "InferenceResult", "BucketLadder", "Request",
            "MicroBatcher", "ModelRegistry", "ServableModel", "shape_key",
-           "CircuitBreaker", "HEALTHY", "DEGRADED", "decode",
-           "FleetRouter", "FleetStats", "DecodeFleetStats",
+           "CircuitBreaker", "HEALTHY", "DEGRADED", "decode", "disagg",
+           "traffic", "FleetRouter", "FleetStats", "DecodeFleetStats",
            "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR",
            "UNAVAILABLE"]

@@ -56,6 +56,16 @@ default, leaving the base engine bit-identical):
   proposes — the draft can be wrong, stale, or freshly imported garbage
   and only the acceptance rate moves.
 
+``prefill_only=True`` (requires ``prefill_chunk``, excludes speculation)
+turns the engine into one tier of a DISAGGREGATED deployment
+(serving/disagg/): it runs chunked prefill, emits the TTFT token, and
+then — instead of decoding — hands the stream off through the sink
+installed with :meth:`set_handoff` (the same snapshot dict
+``export_stream`` produces: K/V pages, cursor, sampler state).  KV
+admission reserves only the PROMPT's blocks (no decode growth happens
+here), so the same pool admits far more concurrent prefills, and the
+decode-width signatures are neither warmed nor ever dispatched.
+
 Every request is a :class:`DecodeStream` — tokens stream out as they are
 produced (iterator and/or ``on_token`` callback), and the terminal state
 is a status, never an exception: the same vocabulary as server.py
@@ -288,7 +298,8 @@ class DecodeEngine:
                  max_queue=64, scheduling="continuous", width_blocks=None,
                  warmup=True, breaker_threshold=5, breaker_backoff_ms=50.0,
                  breaker_max_backoff_ms=2000.0, prefill_chunk=None,
-                 prefix_cache=False, spec_k=0, draft_model=None):
+                 prefix_cache=False, spec_k=0, draft_model=None,
+                 prefill_only=False):
         if scheduling not in ("continuous", "static"):
             raise ValueError("scheduling must be 'continuous' or 'static'")
         self.name = name
@@ -302,6 +313,14 @@ class DecodeEngine:
         self.prefix_cache = bool(prefix_cache)
         self.spec_k = int(spec_k)
         self.draft = draft_model
+        self.prefill_only = bool(prefill_only)
+        self._handoff_cb = None     # set_handoff sink (prefill_only)
+        if self.prefill_only and self.prefill_chunk is None:
+            raise ValueError("prefill_only requires prefill_chunk (the "
+                             "prefill tier runs the chunked path)")
+        if self.prefill_only and self.spec_k > 0:
+            raise ValueError("prefill_only excludes speculative decoding "
+                             "(no decode steps run on the prefill tier)")
         if self.prefill_chunk is not None:
             if self.prefill_chunk <= 0 \
                     or self.prefill_chunk % int(block_size):
@@ -617,6 +636,10 @@ class DecodeEngine:
                 np.zeros((1,), np.int32), np.ones((1,), np.int32),
                 np.zeros((1, max_w), np.int32), outs[1], outs[2])
             n += 3
+        elif self.prefill_only:
+            # a prefill-only tier never dispatches a decode step: warming
+            # the width ladder would only stretch startup
+            pass
         else:
             for w in self._width_ladder:
                 outs = self._decode_exec(
@@ -768,12 +791,21 @@ class DecodeEngine:
         if prompt.min() < 0 or prompt.max() >= self.model.vocab_size:
             return ("prompt token ids outside [0, %d)"
                     % self.model.vocab_size)
-        need = self._cache.blocks_for_tokens(len(prompt) + max_new_tokens)
+        need = self._blocks_needed(len(prompt), max_new_tokens)
         if need > self._cache.capacity():
             # could NEVER join: reject now instead of starving in the queue
             return ("stream needs %d KV blocks but the pool only has %d"
                     % (need, self._cache.capacity()))
         return None
+
+    def _blocks_needed(self, prompt_len, max_new_tokens):
+        """Worst-case block reservation for one stream.  A prefill-only
+        engine writes exactly the prompt's pages — the stream leaves at
+        its first token, so no decode growth is ever provisioned here."""
+        if self.prefill_only:
+            return self._cache.blocks_for_tokens(int(prompt_len))
+        return self._cache.blocks_for_tokens(int(prompt_len)
+                                             + int(max_new_tokens))
 
     @staticmethod
     def _coerce_prompt(prompt):
@@ -866,16 +898,23 @@ class DecodeEngine:
                             and seq.stream.expired(now)]
             for i, _ in expired_live:
                 self._slots[i] = None
+        # a lost completion means an external fence already terminated
+        # the stream; we still held it, so its bucket settles here with
+        # the fence's status (see _vacate)
         for e in expired_q:
             self._cache.release(e.stream.seq_id)
             if e.stream.complete(TIMEOUT, error="deadline before prefill",
                                  owner=e.gen):
                 self.stats.on_result(TIMEOUT)
+            else:
+                self.stats.on_result(e.stream.snapshot()[0])
         for _, seq in expired_live:
             self._cache.free_seq(seq.seq_id)
             if seq.stream.complete(TIMEOUT, error="deadline mid-stream",
                                    owner=seq.gen):
                 self.stats.on_result(TIMEOUT)
+            else:
+                self.stats.on_result(seq.stream.snapshot()[0])
 
     def _claim_joiners(self):
         """Move queued streams into free slots (iteration-level join).
@@ -901,9 +940,9 @@ class DecodeEngine:
                 entry = self._queue[0]
                 res = None
                 if entry.snap is None:
-                    blocks = self._cache.blocks_for_tokens(
-                        len(entry.stream.prompt)
-                        + entry.stream.max_new_tokens)
+                    blocks = self._blocks_needed(
+                        len(entry.stream.prompt),
+                        entry.stream.max_new_tokens)
                     if self.prefix_cache:
                         res = self._cache.reserve(
                             entry.stream.seq_id, blocks,
@@ -932,12 +971,18 @@ class DecodeEngine:
     def _vacate(self, seq, status, error=None):
         """Free the sequence's pages and complete its stream (the slot
         entry was already cleared by the caller under ``_cond``).  The
-        completion presents this engine's fencing token: a stream handed
-        off to another engine refuses it, and the refusal keeps the stale
-        engine's terminal counters honest (no double count)."""
+        completion presents this engine's fencing token: losing means a
+        router fence terminated the stream while the seq still lived
+        here (a kill racing a handoff).  The stream leaves this engine
+        exactly once either way, so a lost completion settles the bucket
+        with the fence's status — every removal site counts exactly one
+        terminal, which is what keeps ``requests + imported == terminals
+        + handed_off`` true per engine."""
         self._cache.free_seq(seq.seq_id)
         if seq.stream.complete(status, error=error, owner=seq.gen):
             self.stats.on_result(status)
+        else:
+            self.stats.on_result(seq.stream.snapshot()[0])
 
     def _fail_all(self, exc):
         """A batch execution failed beyond the retry budget: fail every
@@ -1099,9 +1144,101 @@ class DecodeEngine:
             ttft = (time.monotonic() - stream.t_submit) * 1e3
         self.stats.on_prefill(ttft)
         self.stats.on_tokens(1)
-        self._maybe_finish(seq, token)
+        if self.prefill_only:
+            if not self._maybe_finish(seq, token):
+                return self._handoff_first_token(seq, k_pool, v_pool)
+        else:
+            self._maybe_finish(seq, token)
         self.stats.on_idle(self._live_count(), self._cache.used())
         return k_pool, v_pool
+
+    def _handoff_first_token(self, seq, k_pool, v_pool):
+        """Prefill-only mode: the stream leaves this engine AT its first
+        token.  The sequence's prompt K/V pages, cursor, and sampler
+        state are snapshotted (the exact ``export_stream`` dict shape),
+        its blocks return to the pool, and the installed handoff sink
+        decides where the stream decodes — a truthy return means the
+        stream found a decode home and leaves this engine's accounting
+        through ``handed_off``; anything else (no sink, a False return,
+        an exception) terminates it here with the retryable UNAVAILABLE,
+        its one-token prefix intact for re-admission.
+
+        No quiesce is needed: the worker thread owns the pool locals at
+        this point, so the pages read out are exactly the state the final
+        chunk left behind — the importer's restore is bitwise."""
+        stream = seq.stream
+        with self._cond:
+            for i, cand in enumerate(self._slots):
+                if cand is seq:
+                    self._slots[i] = None
+        status, tokens, _, _, _ = stream.snapshot()
+        if status is not None:
+            # terminal while prefilling (fenced by the router): counters
+            # settled wherever it was completed; just return its blocks
+            self._cache.free_seq(seq.seq_id)
+            return k_pool, v_pool
+        sampling = None
+        if stream.sampling is not None:
+            sampling = stream.sampling.as_dict()
+            if seq.sampler is not None:
+                sampling.update(seq.sampler.state())
+            else:
+                sampling.setdefault("draws", 0)
+        need = self._cache.blocks_for_tokens(seq.position)
+        blocks = self._cache.blocks_of(seq.seq_id)[:need]
+        idx = np.asarray(blocks, np.int32)
+        snap = {
+            "prompt": np.asarray(stream.prompt, np.int32).copy(),
+            "max_new_tokens": int(stream.max_new_tokens),
+            "tokens": list(tokens),
+            "geometry": {
+                "block_size": self._cache.block_size,
+                "num_layers": self.model.num_layers,
+                "num_heads": self.model.num_heads,
+                "head_dim": self.model.head_dim,
+                "vocab_size": self.model.vocab_size,
+            },
+            "position": int(seq.position),
+            "cur_token": int(seq.cur_token),
+            "generated": int(seq.generated),
+            "k": k_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(first-token handoff: prompt K pages leave the prefill tier once per stream)
+            "v": v_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(first-token handoff: prompt V pages leave the prefill tier once per stream)
+            "sampling": sampling,
+        }
+        self._cache.free_seq(seq.seq_id)
+        cb = self._handoff_cb
+        handed = False
+        if cb is not None:
+            try:
+                handed = bool(cb(stream, snap))
+            except Exception:
+                handed = False
+        if handed:
+            self.stats.on_handed_off()
+        else:
+            # the sink may have already fence-terminated the stream (an
+            # exhausted adoption search completes it UNAVAILABLE with a
+            # private token), so this complete can lose — but the stream
+            # leaves this engine either way, and conservation needs
+            # exactly one bucket for it here
+            stream.complete(UNAVAILABLE,
+                            error="prefill tier found no decode home; "
+                                  "re-admit with the emitted prefix as "
+                                  "prompt",
+                            owner=seq.gen)
+            self.stats.on_result(UNAVAILABLE)
+        self.stats.on_idle(self._live_count(), self._cache.used())
+        return k_pool, v_pool
+
+    def set_handoff(self, cb):
+        """Install the first-token handoff sink ``cb(stream, snap) ->
+        bool`` for a prefill-only engine (serving/disagg/ wires this to
+        the decode tier's adoption path).  The sink runs on the worker
+        thread between the final prompt chunk and the stream's departure;
+        it must not block on this engine."""
+        if not self.prefill_only:
+            raise MXNetError("set_handoff requires prefill_only=True")
+        self._handoff_cb = cb
 
     def _maybe_finish(self, seq, token):
         """OK-complete a sequence that hit EOS or its token budget."""
@@ -1381,10 +1518,13 @@ class DecodeEngine:
             return None
         status, tokens, _, _, _ = stream.snapshot()
         if status is not None:
-            # terminal while waiting to drain: its counters already
-            # settled here; just return its blocks (free_seq also drops
+            # terminal while still held: the engine's own terminations
+            # always remove the stream before completing, so a terminal
+            # found here means an external fence won — settle the bucket
+            # (see _vacate) and return its blocks (free_seq also drops
             # any outstanding reservation)
             self._cache.free_seq(stream.seq_id)
+            self.stats.on_result(status)
             return None
         geometry = {
             "block_size": self._cache.block_size,
@@ -1467,6 +1607,12 @@ class DecodeEngine:
         if geometry != mine:
             raise MXNetError("snapshot geometry %r does not match engine "
                              "%r geometry %r" % (geometry, self.name, mine))
+        if self.prefill_only and int(snap["generated"]) > 0:
+            # mid-decode state needs decode steps this tier never runs;
+            # only not-yet-prefilled streams may migrate within the tier
+            raise MXNetError("prefill-only engine %r cannot resume a "
+                             "stream that already decoded %d token(s)"
+                             % (self.name, int(snap["generated"])))
         prompt = np.asarray(snap["prompt"], np.int32)
         if stream is None:
             sampling = None
@@ -1486,8 +1632,8 @@ class DecodeEngine:
                              "the stream (owner %r)" % (owner,
                                                         stream.owner()))
         stream.stats = self.stats
-        need = self._cache.blocks_for_tokens(
-            len(prompt) + int(snap["max_new_tokens"]))
+        need = self._blocks_needed(len(prompt),
+                                   int(snap["max_new_tokens"]))
         with self._cond:
             if self._closed or self._draining or not self._running:
                 raise MXNetError("engine %r is not accepting streams"
@@ -1708,6 +1854,10 @@ class DecodeEngine:
             self._cache.release(e.stream.seq_id)
             if e.stream.complete(UNAVAILABLE, error=error, owner=e.gen):
                 self.stats.on_result(UNAVAILABLE)
+            else:
+                # externally fenced while queued: settle the bucket here
+                # (see _vacate)
+                self.stats.on_result(e.stream.snapshot()[0])
         for seq in live:
             self._vacate(seq, UNAVAILABLE, error=error)
 

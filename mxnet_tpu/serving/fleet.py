@@ -59,7 +59,15 @@ state:
   every tenant a weighted-fair share of the fleet's KV token capacity; an
   over-budget tenant sheds OVERLOADED while the rest keep flowing.
   ``scaling_advice()``/``poll_scaling()`` turn breaker + KV-utilization
-  signals into scale-out/scale-in policy hooks.
+  signals into scale-out/scale-in policy hooks, with a per-engine-name
+  breakdown; ``scale_decode()`` closes the loop into an actual replica
+  retarget (serving/disagg/autoscaler.py is the standing driver).
+* **Cross-tier handoff**: ``adopt_stream()`` lands a snapshot exported
+  by ANOTHER router's tier on this fleet's best replica, and
+  ``mark_departed()`` detaches a handed-off stream from its local
+  replica pin without dropping its accounting rec — together they are
+  the primitive pair the disaggregated prefill/decode topology
+  (serving/disagg/) is built from.
 
 The ``fleet`` and ``decode_fleet`` mxstress scenarios
 (analysis/schedule.py) are the standing chaos consumers: replicas are
@@ -188,6 +196,7 @@ class DecodeFleetStats:
         self.tokens_out = 0      # tokens delivered across terminal streams
         self._lat = LatencyWindow()
         self._ttft = LatencyWindow()
+        self._tpot = LatencyWindow()   # per-token decode latency (ms)
 
     def on_admitted(self):
         with self._lock:
@@ -234,6 +243,12 @@ class DecodeFleetStats:
                 self._lat.add(latency_ms)
             if ttft_ms is not None:
                 self._ttft.add(ttft_ms)
+            if int(tokens) > 1 and latency_ms is not None \
+                    and ttft_ms is not None:
+                # time-per-output-token: decode-phase latency spread over
+                # the tokens after the first (the TPOT SLO's sample)
+                self._tpot.add(max(0.0, latency_ms - ttft_ms)
+                               / (int(tokens) - 1))
 
     def snapshot(self):
         with self._lock:
@@ -252,6 +267,7 @@ class DecodeFleetStats:
                 "tokens_out": self.tokens_out,
                 "latency_ms": self._lat.percentiles(),
                 "ttft_ms": self._ttft.percentiles(),
+                "tpot_ms": self._tpot.percentiles(),
             }
 
 
@@ -374,6 +390,7 @@ class FleetRouter:
         self._dengines = {}     # (name, rid) -> DecodeEngine
         self._dbreakers = {}    # (name, rid) -> CircuitBreaker
         self._streams = {}      # DecodeStream -> _StreamRec (affinity pins)
+        self._departed = set()  # streams handed off before their pin landed
         self._tenants = {}      # tenant name -> _Tenant
         self._scaling = {"high": 0.85, "low": 0.15,
                          "scale_out": None, "scale_in": None}
@@ -731,7 +748,14 @@ class FleetRouter:
         rid, gen = ow if (isinstance(ow, tuple) and len(ow) == 2) \
             else (rep.rid, gen)
         with self._lock:
-            self._streams[stream] = _StreamRec(name, rid, gen, tenant, need)
+            rec = _StreamRec(name, rid, gen, tenant, need)
+            if stream in self._departed:
+                # handed off to another tier before this pin landed: the
+                # rec still settles the tenant + terminal accounting, but
+                # it must never match a local replica id again
+                self._departed.discard(stream)
+                rec.rid = rec.gen = None
+            self._streams[stream] = rec
             ten = self._tenants.get(tenant)
             if ten is not None:
                 ten.admitted += 1
@@ -822,6 +846,7 @@ class FleetRouter:
         visited."""
         status, tokens, ttft, latency, _ = stream.snapshot()
         with self._lock:
+            self._departed.discard(stream)
             rec = self._streams.pop(stream, None)
             if rec is None:
                 return
@@ -875,9 +900,45 @@ class FleetRouter:
             for stream, snap in eng.export_streams():
                 self._resume_on_survivor(name, stream, snap, exclude=rid)
 
+    def mark_departed(self, stream):
+        """Detach a stream from its replica pin WITHOUT dropping its rec:
+        the disaggregated router calls this the moment a prefill engine
+        hands the stream to the decode tier.  The rec keeps settling the
+        tenant tokens and the terminal count (cross-tier conservation
+        stays on THIS router), but ``rid``/``gen`` go None so a later
+        death or wedged drain of the prefill replica can never fence a
+        stream that now lives on the other tier.  If the handoff outraces
+        ``submit_stream``'s pin, the stream is parked in ``_departed``
+        and the pin lands already-detached."""
+        with self._lock:
+            rec = self._streams.get(stream)
+            if rec is not None:
+                rec.rid = rec.gen = None
+            else:
+                self._departed.add(stream)
+
+    def adopt_stream(self, name, stream, snap, exclude=None):
+        """Adopt a stream exported by ANOTHER router (the cross-tier
+        entry: serving/disagg/ lands prefill-tier snapshots here).  Same
+        protocol as a drain resume — generation check, re-own, import on
+        the best-scoring replica with bounded failover.  Returns True on
+        adoption (counted in ``decode_stats.handoffs``); False when no
+        replica could take it, in which case the stream was already
+        fence-terminated UNAVAILABLE with its prefix intact."""
+        with self._lock:
+            if name not in self._dspecs:
+                raise MXNetError("no decode engine %r in the fleet; "
+                                 "loaded: %s"
+                                 % (name, sorted(self._dspecs) or "none"))
+        return self._resume_on_survivor(name, stream, snap, exclude=exclude)
+
     def _resume_on_survivor(self, name, stream, snap, exclude):
         """Land one exported stream on the best surviving replica; on
         exhaustion, fence-terminate it (UNAVAILABLE, prefix intact)."""
+        if stream.snapshot()[0] is not None:
+            # terminal while in flight (a concurrent kill fenced it):
+            # importing it would strand a stream no engine can complete
+            return False
         tried = {exclude}
         for _ in range(self._failover_budget + 1):
             sel, _reason = self._select_decode(name, tried)
@@ -943,6 +1004,27 @@ class FleetRouter:
                 } for t in self._tenants.values()
             }
 
+    def scale_decode(self, name, replicas):
+        """Retarget a decode engine's replica count and converge toward
+        it: scale-out builds + warms a fresh engine on a spare replica
+        BEFORE its placement commits (the warm-before-cutover rule, via
+        ``_rebalance``), so a joining copy never serves cold.  Lowering
+        the target removes nothing by itself — scale-in is ``drain(rid)``
+        (streams hand off) followed by ``remove_replica(rid)``, with the
+        lowered target keeping the rebalancer from re-placing onto the
+        survivors.  The autoscaler (serving/disagg/autoscaler.py) drives
+        both directions."""
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        with self._lock:
+            spec = self._dspecs.get(name)
+            if spec is None:
+                raise MXNetError("no decode engine %r in the fleet; "
+                                 "loaded: %s"
+                                 % (name, sorted(self._dspecs) or "none"))
+            spec.replicas = int(replicas)
+        self._rebalance()
+
     # -- scaling policy hooks ----------------------------------------------
     def set_scaling_policy(self, scale_out=None, scale_in=None,
                            high=0.85, low=0.15):
@@ -961,12 +1043,18 @@ class FleetRouter:
         unhealthy breaker) says scale out; a near-idle fleet says scale
         in.  The advice also carries the mesh footprint — a tp=k engine
         placement consumes k devices — so policies can see when scale-out
-        would overcommit the device budget."""
+        would overcommit the device budget.
+
+        ``advice["engines"]`` breaks the same signals down per engine
+        NAME (replica count, per-name KV utilization / queue fill /
+        device footprint, and which thresholds that name tripped) — the
+        disaggregated router surfaces these as its per-tier reasons, and
+        a policy can scale one engine while holding another."""
         import jax
 
         devices_total = jax.local_device_count()
         with self._lock:
-            engines = list(self._dengines.values())
+            engines = list(self._dengines.items())
             breakers = list(self._dbreakers.values())
             high = self._scaling["high"]
             low = self._scaling["low"]
@@ -974,15 +1062,44 @@ class FleetRouter:
             return {"action": "hold", "kv_utilization": 0.0,
                     "queue_fill": 0.0, "unhealthy_breakers": 0,
                     "devices_in_use": 0, "devices_total": devices_total,
+                    "engines": {},
                     "reasons": ["no decode engines placed"]}
         utils, fills = [], []
         devices_in_use = 0
-        for eng in engines:
+        per_name = {}
+        for (name, _rid), eng in engines:
             sig = eng.routing_signals()
             cap = max(1, sig["kv_capacity"])
-            utils.append(1.0 - sig["kv_blocks_free"] / cap)
-            fills.append(sig["queue_depth"] / max(1, sig["max_queue"]))
-            devices_in_use += max(1, int(sig.get("tp_degree", 1)))
+            util = 1.0 - sig["kv_blocks_free"] / cap
+            fill = sig["queue_depth"] / max(1, sig["max_queue"])
+            devs = max(1, int(sig.get("tp_degree", 1)))
+            utils.append(util)
+            fills.append(fill)
+            devices_in_use += devs
+            row = per_name.setdefault(
+                name, {"replicas": 0, "devices_in_use": 0,
+                       "_utils": [], "_fills": []})
+            row["replicas"] += 1
+            row["devices_in_use"] += devs
+            row["_utils"].append(util)
+            row["_fills"].append(fill)
+        breakdown = {}
+        for name, row in sorted(per_name.items()):
+            n_util = sum(row["_utils"]) / len(row["_utils"])
+            n_fill = max(row["_fills"])
+            n_reasons = []
+            if n_util >= high:
+                n_reasons.append("kv utilization %.2f >= %.2f"
+                                 % (n_util, high))
+            if n_fill >= high:
+                n_reasons.append("queue fill %.2f >= %.2f" % (n_fill, high))
+            breakdown[name] = {
+                "replicas": row["replicas"],
+                "devices_in_use": row["devices_in_use"],
+                "kv_utilization": n_util,
+                "queue_fill": n_fill,
+                "reasons": n_reasons,
+            }
         kv_util = sum(utils) / len(utils)
         queue_fill = max(fills)
         unhealthy = sum(1 for b in breakers if b.health() != HEALTHY)
@@ -1009,6 +1126,7 @@ class FleetRouter:
                 "queue_fill": queue_fill, "unhealthy_breakers": unhealthy,
                 "devices_in_use": devices_in_use,
                 "devices_total": devices_total,
+                "engines": breakdown,
                 "reasons": reasons}
 
     def poll_scaling(self):

@@ -29,8 +29,19 @@ prefix-cached + speculative engines with shared-prefix prompts (greedy
 and seeded sampled) while one replica drains mid-run: migrated streams
 carry refcounted shared KV pages and sampler state, outputs stay bitwise
 equal to their references, pools drain whole, the prefix-hit/CoW-fork/
-speculation counters advance, and nothing recompiles.  Exit code is
-non-zero iff any seed violated any invariant.
+speculation counters advance, and nothing recompiles.  The
+``sharded_decode`` scenario storms a tensor-parallel decode fleet over a
+device mesh with a mid-run drain: sharded streams stay bitwise-equal to
+the single-device reference and per-shard KV pools stay whole.  The
+``disagg`` scenario storms a disaggregated prefill/decode topology
+(``DisaggRouter``: prefill-only tier handing every stream off at first
+token) while one prefill replica is KILLED and one decode replica is
+DRAINED: cross-tier conservation settles on the prefill router's single
+ledger, handed-off streams stay bitwise-equal to the colocated
+reference, killed streams leave strict prefixes that re-admit and
+continue the greedy path bitwise, KV pools drain whole on both tiers,
+and surviving engines never recompile.  Exit code is non-zero iff any
+seed violated any invariant.
 
 Usage:
   python tools/mxstress.py --smoke              # 25 fixed seeds, <=20 s
