@@ -1,6 +1,6 @@
 """Static analysis passes over the TPU build (``tools/mxlint.py`` front end).
 
-Eight passes, one per defect class the green test suite cannot see:
+Nine passes, one per defect class the green test suite cannot see:
 
 * :mod:`.tracing_lint` — AST pass over ``mxnet_tpu/`` for tracer
   concretization, implicit host syncs inside fcompute bodies, and
@@ -31,6 +31,17 @@ Eight passes, one per defect class the green test suite cannot see:
   axis) counter table in :mod:`mxnet_tpu.parallel.collectives`; the two
   are pinned to one ground truth in ``tests/test_mxshard.py`` and the
   sanction catalog is ``docs/COLLECTIVE_MAP.md``.
+* :mod:`.memory_lint` — the mxmem device-memory pass (``mem``): a
+  symbolic per-buffer size model over ``parallel/``, ``module/``, and
+  ``serving/decode/`` enforcing donation at jit/CachedOp boundaries
+  (``# mxmem: nodonate(<reason>)`` sanctions), use-after-donate, declared
+  per-region HBM budgets (``# mxmem: budget(hbm=...)``), hot-path
+  ``reserve()`` coverage before device allocation, full-shape
+  materialization inside sharded regions, and tag hygiene.  Its dynamic
+  twin is the per-region byte accountant in
+  :mod:`mxnet_tpu.memory_accounting`; the two are pinned to one ground
+  truth in ``tests/test_mxmem.py`` and the footprint catalog is
+  ``docs/MEM_MAP.md``.
 
 The pass registry (:data:`.common.PASS_REGISTRY`) is the single source of
 truth mapping pass names to rule-key prefixes and runners.  All passes emit :class:`.common.Finding` records keyed by stable identity

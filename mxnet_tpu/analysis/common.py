@@ -52,6 +52,8 @@ PASS_REGISTRY = {
             "runner": "mxnet_tpu.analysis.dataflow:run_res"},
     "spd": {"rules": ("SPD",),
             "runner": "mxnet_tpu.analysis.sharding_lint:run"},
+    "mem": {"rules": ("MEM",),
+            "runner": "mxnet_tpu.analysis.memory_lint:run"},
 }
 
 PASSES = tuple(PASS_REGISTRY)

@@ -101,6 +101,14 @@ Invariants asserted (per seed)
   strict prefixes that RE-ADMIT and continue the greedy path bitwise,
   KV pools drain whole on both tiers, and surviving engines never
   recompile (see ``disagg_storm``).
+* **memory-pressure storm** (``mem``) — concurrent sequence lifecycles
+  drive a tiny paged KV pool to near-exhaustion (admission sheds, LRU
+  eviction, prefix re-admission, copy-on-write forks): the pool's
+  attachment ledger conserves (``allocated_total == freed_total``), the
+  byte accountant (``mxnet_tpu.memory_accounting`` — the runtime twin of
+  the mem lint pass) mirrors it exactly in bytes, its region peak stays
+  under the declared admission worst case, and ``peak_used`` never
+  exceeds physical capacity (see ``mem_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
@@ -2599,12 +2607,109 @@ def disagg_storm(router, name, prompts, refs, sam_refs, seed):
 
 
 # ---------------------------------------------------------------------------
+# scenario 14: memory-pressure storm on the paged KV pool + byte accountant
+# ---------------------------------------------------------------------------
+
+def mem_storm(seed, n_threads=4, rounds=3):
+    """Memory-pressure storm: the runtime half of the mxmem lint pass.
+
+    A deliberately tiny ``PagedKVCache`` (16 allocatable 512-byte blocks)
+    is driven to near-exhaustion by concurrent sequence lifecycles —
+    ``reserve`` (some shed) -> ``ensure_capacity`` growth -> prefix
+    ``register``/re-admission (the handoff-import path) -> copy-on-write
+    ``writable`` forks -> ``free_seq`` — while LRU eviction recycles
+    cached prefix pages underneath and chaos stretches every lock edge.
+
+    Invariants:
+    * **attachment conservation** — once every sequence is freed,
+      ``allocated_total == freed_total`` and no block stays in use;
+    * **twin exactness** — the byte accountant's region mirrors the
+      cache ledger exactly: ``allocs == allocated_total``,
+      ``frees == freed_total``, ``alloc_bytes == allocated_total *
+      block_bytes``, and ``live_bytes == 0`` after the drain;
+    * **declared-budget peak** — ``peak_bytes`` never exceeds the
+      admission worst case declared below (each thread's one live
+      sequence attaches at most its shared prefix + its full
+      reservation), and the cache's own ``peak_used`` never exceeds
+      physical capacity — the no-mid-stream-OOM contract MEM004 makes
+      static;
+    * **activity** — the storm demonstrably allocated and shared;
+    * **no deadlock** — every worker joins.
+    """
+    from .. import memory_accounting
+    from ..serving.decode.kv_cache import PagedKVCache
+
+    violations = []
+    region = "mem_storm:%d:%d" % (seed, time.monotonic_ns() % (1 << 30))
+    cache = PagedKVCache(2, 17, 4, 2, 4, account_region=region)
+    rng = random.Random(seed ^ 0x3E3)
+    # three 12-token prompts (3 full blocks each): enough overlap for
+    # prefix hits and CoW forks, enough variety for eviction pressure
+    prompts = [[rng.randrange(1000) for _ in range(12)] for _ in range(3)]
+    res_blocks = 4   # per-sequence reservation (4 threads x 4 = capacity)
+    shed = [0]
+
+    def lifecycle(tid):
+        for r in range(rounds):
+            seq = "m%d_%d_%d" % (seed, tid, r)
+            prompt = prompts[(tid + r) % len(prompts)]
+            res = cache.reserve(seq, res_blocks, prompt=prompt)
+            if not res:
+                shed[0] += 1      # benign: admission shed under pressure
+                continue
+            cache.ensure_capacity(seq, len(prompt))
+            cache.writable(seq, 0)          # forks iff the page is shared
+            cache.register_prefix(seq, prompt)
+            cache.free_seq(seq)
+
+    violations.extend(_spawn([lambda t=t: lifecycle(t)
+                              for t in range(n_threads)]))
+
+    stats = cache.stats()
+    mem = memory_accounting.memory_counters().get(region, {})
+    bb = cache.block_bytes
+    if stats["allocated_total"] != stats["freed_total"]:
+        violations.append("mem: KV ledger leaked: allocated %d != freed %d"
+                          % (stats["allocated_total"], stats["freed_total"]))
+    if stats["used"] != 0 or stats["live_sequences"] != 0:
+        violations.append("mem: pool not drained: used=%d live_sequences=%d"
+                          % (stats["used"], stats["live_sequences"]))
+    if mem.get("allocs", -1) != stats["allocated_total"]:
+        violations.append("mem: accountant allocs %r != cache "
+                          "allocated_total %d"
+                          % (mem.get("allocs"), stats["allocated_total"]))
+    if mem.get("frees", -1) != stats["freed_total"]:
+        violations.append("mem: accountant frees %r != cache freed_total %d"
+                          % (mem.get("frees"), stats["freed_total"]))
+    if mem.get("alloc_bytes", -1) != stats["allocated_total"] * bb:
+        violations.append("mem: accountant alloc_bytes %r != %d x %dB"
+                          % (mem.get("alloc_bytes"),
+                             stats["allocated_total"], bb))
+    if mem.get("live_bytes", -1) != 0:
+        violations.append("mem: accountant live_bytes %r != 0 after drain"
+                          % (mem.get("live_bytes"),))
+    # admission worst case: each thread's single live sequence holds at
+    # most its shared prefix (3 blocks) plus its full reservation
+    budget = n_threads * (3 + res_blocks) * bb
+    if mem.get("peak_bytes", 0) > budget:
+        violations.append("mem: peak_bytes %r over the declared budget %d"
+                          % (mem.get("peak_bytes"), budget))
+    if stats["peak_used"] > cache.capacity():
+        violations.append("mem: peak_used %d over physical capacity %d"
+                          % (stats["peak_used"], cache.capacity()))
+    if stats["allocated_total"] == 0:
+        violations.append("mem: storm allocated nothing (shed %d)"
+                          % shed[0])
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
              "crash", "decode", "fleet", "decode_fleet", "decode_prefix",
-             "sharded_decode", "disagg")
+             "sharded_decode", "disagg", "mem")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -2690,6 +2795,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                         disagg_fixture[0], disagg_fixture[1],
                         disagg_fixture[2], disagg_fixture[3],
                         disagg_fixture[4], seed)
+                if "mem" in scenarios:
+                    per_seed["mem"] = mem_storm(seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n

@@ -1,0 +1,213 @@
+"""Runtime HBM-accounting twin of the mxmem static pass.
+
+The analog of the reference's graph-level memory planner (SURVEY §5 "Memory
+saving" / arxiv 1512.01274 §5), pushed to runtime: a thread-safe per-region
+byte accountant that the static pass ``analysis/memory_lint.py`` is pinned
+against.  Producers call :func:`record_alloc` / :func:`record_free` at the
+exact points device-sized buffers enter and leave service — the KV block
+pool bumps them at its four accounting increments (attach, grow, CoW fork,
+free), the decode engine at pool materialization — and the collective
+wrappers in ``parallel/collectives.py`` report each gather/reduce OUTPUT as
+a *temp* via :func:`record_temp` whenever a :func:`track_region` scope is
+active on the calling thread.
+
+The model is deliberately conservative: no buffer reuse, no aliasing.  A
+region's ``peak_bytes`` is therefore the worst-case sum of everything live
+at once under a no-reuse allocator — exactly the quantity the static pass
+predicts symbolically (``predict_decode_step_peak_bytes``), which is what
+makes the two sides comparable with ``==`` rather than ``<=``.
+
+Counters mirror into profiler Counters ("C" trace events) in a "memory"
+Domain, gated on ``profiling_active()`` for the same reason the collective
+twin gates: an ungated per-alloc write would grow the event buffer between
+dumps.  :func:`device_memory_stats` additionally surfaces the backend
+allocator's own view (``device.memory_stats()``) where the jax platform
+provides one (TPU/GPU; CPU returns None).
+"""
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+# region -> {"allocs", "frees", "temps", "alloc_bytes", "freed_bytes",
+#            "live_bytes", "peak_bytes"}
+_REGIONS = {}
+_PROF_COUNTERS = {}   # region -> profiler.Counter (live_bytes)
+_TLS = threading.local()
+
+_FIELDS = ("allocs", "frees", "temps", "alloc_bytes", "freed_bytes",
+           "live_bytes", "peak_bytes")
+
+
+def _mirror(region, live_bytes):
+    """Profiler Counter mirror of a region's live bytes (gated)."""
+    from . import profiler
+    if not profiler.profiling_active():
+        return
+    with _LOCK:
+        ctr = _PROF_COUNTERS.get(region)
+        if ctr is None:
+            ctr = profiler.Domain("memory").new_counter(
+                "mem:%s:live" % region)
+            _PROF_COUNTERS[region] = ctr
+    ctr.set_value(live_bytes)
+
+
+def _frames():
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def current_region():
+    """The innermost :func:`track_region` scope on this thread, or None."""
+    stack = _frames()
+    return stack[-1][0] if stack else None
+
+
+def record_alloc(nbytes, region=None, count=1):
+    """Account ``count`` device allocation(s) totalling ``nbytes`` against
+    ``region`` (default: the active :func:`track_region` scope, else
+    "untracked")."""
+    if region is None:
+        region = current_region() or "untracked"
+    nbytes = int(nbytes)
+    with _LOCK:
+        cell = _REGIONS.setdefault(region, dict.fromkeys(_FIELDS, 0))
+        cell["allocs"] += count
+        cell["alloc_bytes"] += nbytes
+        cell["live_bytes"] += nbytes
+        if cell["live_bytes"] > cell["peak_bytes"]:
+            cell["peak_bytes"] = cell["live_bytes"]
+        live = cell["live_bytes"]
+    _mirror(region, live)
+    return region
+
+
+def record_free(nbytes, region=None, count=1):
+    """Account ``count`` device free(s) totalling ``nbytes``."""
+    if region is None:
+        region = current_region() or "untracked"
+    nbytes = int(nbytes)
+    with _LOCK:
+        cell = _REGIONS.setdefault(region, dict.fromkeys(_FIELDS, 0))
+        cell["frees"] += count
+        cell["freed_bytes"] += nbytes
+        cell["live_bytes"] -= nbytes
+        live = cell["live_bytes"]
+    _mirror(region, live)
+    return region
+
+
+def record_temp(x_or_nbytes):
+    """Account a region-scoped temporary (a collective's full-shape output,
+    a re-shard staging buffer): allocated now, freed automatically when the
+    innermost :func:`track_region` scope exits.  Accepts an array (tracer-
+    safe: size/itemsize read in try/except, unsized objects count 0 bytes)
+    or a byte count.  No-op returning False when no scope is active — the
+    collective wrappers call this unconditionally, and unscoped execution
+    (ordinary training steps) must stay free."""
+    stack = _frames()
+    if not stack:
+        return False
+    try:
+        nbytes = int(x_or_nbytes.size) * x_or_nbytes.dtype.itemsize
+    except (AttributeError, TypeError):
+        try:
+            nbytes = int(x_or_nbytes)
+        except (TypeError, ValueError):
+            nbytes = 0
+    region = stack[-1][0]
+    with _LOCK:
+        cell = _REGIONS.setdefault(region, dict.fromkeys(_FIELDS, 0))
+        cell["allocs"] += 1
+        cell["temps"] += 1
+        cell["alloc_bytes"] += nbytes
+        cell["live_bytes"] += nbytes
+        if cell["live_bytes"] > cell["peak_bytes"]:
+            cell["peak_bytes"] = cell["live_bytes"]
+        live = cell["live_bytes"]
+    stack[-1][1] += nbytes
+    stack[-1][2] += 1
+    _mirror(region, live)
+    return True
+
+
+class track_region(object):
+    """Context manager scoping :func:`record_temp` to a named region on the
+    current thread.  On exit every temp recorded inside the scope is freed
+    in one batch — the conservative no-reuse model: everything allocated in
+    the region is live until the region ends, so ``peak_bytes`` is the sum
+    of all temps (plus any explicit allocs charged to the same region)."""
+
+    __slots__ = ("region",)
+
+    def __init__(self, region):
+        self.region = str(region)
+
+    def __enter__(self):
+        _frames().append([self.region, 0, 0])
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        region, temp_bytes, temp_count = _frames().pop()
+        if temp_count:
+            record_free(temp_bytes, region=region, count=temp_count)
+        return False
+
+
+def memory_counters():
+    """Snapshot of the accountant: ``{region: {field: int}}`` with fields
+    allocs/frees/temps/alloc_bytes/freed_bytes/live_bytes/peak_bytes."""
+    with _LOCK:
+        return {region: dict(cell) for region, cell in _REGIONS.items()}
+
+
+def memory_totals(snapshot=None):
+    """Aggregate a :func:`memory_counters` snapshot across regions.  Peak
+    is summed (each region's worst case can land at a different instant;
+    the sum is the conservative fleet-wide bound)."""
+    snap = memory_counters() if snapshot is None else snapshot
+    out = dict.fromkeys(_FIELDS, 0)
+    for cell in snap.values():
+        for field in _FIELDS:
+            out[field] += cell.get(field, 0)
+    return out
+
+
+def region_peak_bytes(region):
+    """A single region's ``peak_bytes`` (0 if never seen)."""
+    with _LOCK:
+        cell = _REGIONS.get(region)
+        return cell["peak_bytes"] if cell else 0
+
+
+def reset_memory_counters():
+    """Zero the accountant (and drop the profiler Counter mirrors so a
+    fresh profiling session starts its gauges from zero)."""
+    with _LOCK:
+        _REGIONS.clear()
+        _PROF_COUNTERS.clear()
+
+
+def device_memory_stats():
+    """The backend allocator's own per-device view where jax exposes one:
+    ``{device_label: stats_dict}`` for devices with ``memory_stats()``
+    (TPU/GPU), or None when unavailable (CPU backend, jax missing)."""
+    try:
+        import jax
+        out = {}
+        for dev in jax.local_devices():
+            stats = getattr(dev, "memory_stats", None)
+            if stats is None:
+                continue
+            try:
+                s = stats()
+            except Exception:
+                continue
+            if s:
+                out[str(dev)] = dict(s)
+        return out or None
+    except Exception:
+        return None

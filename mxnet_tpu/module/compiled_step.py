@@ -228,7 +228,7 @@ class CompiledTrainStep:
         flags = {"donate_params": True} if _resolve_donate(donate, ctx) \
             else {}
         self.cached_op = CachedOp(self._make_forward_fn(), state_nd,
-                                  aux_names=tuple(state_nd), flags=flags)
+                                  aux_names=tuple(state_nd), flags=flags)  # mxmem: nodonate(donate='auto' resolves per backend at dispatch: CPU XLA cannot alias, accelerator backends donate via donate_params — see _resolve_donate)
 
     # -- trace ----------------------------------------------------------
     def _make_forward_fn(self):
@@ -375,6 +375,10 @@ class CompiledTrainStep:
                 y = jnp.float32(0.0)
             return new_carry, y
 
+        # the compiled fit step's declared worst case: params + grads +
+        # optimizer slots live at once, plus the sharded-update region's
+        # full-weight gather temps (the symbolic sites MEM_MAP catalogs)
+        # mxmem: budget(hbm=1GB)
         def forward_fn(p, t_nd, lr_nd, *input_nds):
             import jax
             import jax.numpy as jnp

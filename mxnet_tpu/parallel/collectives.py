@@ -17,6 +17,13 @@ traced program", not per executed step.
 Bytes are the operand payload per participant (local shard nbytes at trace
 time); ``axis_size`` — ``psum`` of the literal 1, folded to a constant by
 the partitioner — is exempt from counting both here and in the static pass.
+
+Each wrapper additionally reports its OUTPUT as a region temp to the byte
+accountant (memory_accounting.record_temp) — the runtime half of the mem
+pass: inside a ``track_region`` scope the full-shape gather outputs sum to
+the region's peak under the reuse-free model, which is what
+``predict_decode_step_peak_bytes`` predicts statically.  Outside a scope
+the call is a no-op, so ordinary training/serving steps pay nothing.
 """
 from __future__ import annotations
 
@@ -54,6 +61,14 @@ def _record_collective(kind, axis_name, x):
         ctr.set_value(calls)
 
 
+def _record_output_temp(out):
+    """Report a collective's output buffer to the byte accountant as a
+    region-scoped temp (tracer-safe; no-op without a track_region scope)."""
+    from .. import memory_accounting
+    memory_accounting.record_temp(out)
+    return out
+
+
 def collective_counters():
     """Snapshot of the runtime collective counters:
     ``{kind: {axis: {"calls": int, "bytes": int}}}``."""
@@ -88,20 +103,21 @@ def allreduce(x, axis_name="dp"):
     """psum over a mesh axis — the allreduce that replaces kvstore push/pull."""
     import jax
     _record_collective("psum", axis_name, x)
-    return jax.lax.psum(x, axis_name)
+    return _record_output_temp(jax.lax.psum(x, axis_name))
 
 
 def pmean(x, axis_name="dp"):
     """Mean-allreduce (psum / axis size) — loss averaging over replicas."""
     import jax
     _record_collective("psum", axis_name, x)
-    return jax.lax.pmean(x, axis_name)
+    return _record_output_temp(jax.lax.pmean(x, axis_name))
 
 
 def allgather(x, axis_name="dp", axis=0, tiled=True):
     import jax
     _record_collective("all_gather", axis_name, x)
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    return _record_output_temp(
+        jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled))
 
 
 def reduce_scatter(x, axis_name="dp", scatter_dimension=0):
@@ -111,8 +127,9 @@ def reduce_scatter(x, axis_name="dp", scatter_dimension=0):
     the 2-bit wire format accumulates int8 codes in int32 in-graph."""
     import jax
     _record_collective("reduce_scatter", axis_name, x)
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
-                                tiled=True)
+    return _record_output_temp(
+        jax.lax.psum_scatter(x, axis_name,
+                             scatter_dimension=scatter_dimension, tiled=True))
 
 
 def all_to_all(x, axis_name, split_axis, concat_axis, tiled=False):
@@ -121,15 +138,16 @@ def all_to_all(x, axis_name, split_axis, concat_axis, tiled=False):
     re-shard and the MoE dispatch/return primitive."""
     import jax
     _record_collective("all_to_all", axis_name, x)
-    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=tiled)
+    return _record_output_temp(
+        jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                           concat_axis=concat_axis, tiled=tiled))
 
 
 def ppermute(x, axis_name, perm):
     """Point-to-point shard permutation (collective-permute on ICI)."""
     import jax
     _record_collective("ppermute", axis_name, x)
-    return jax.lax.ppermute(x, axis_name, perm)
+    return _record_output_temp(jax.lax.ppermute(x, axis_name, perm))
 
 
 def axis_size(axis_name="dp"):

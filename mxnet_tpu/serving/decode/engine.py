@@ -370,7 +370,8 @@ class DecodeEngine:
             # full occupancy at worst case: admission is then slot-bound
             num_blocks = self.max_slots * max_width + 1
         self._cache = PagedKVCache(model.num_layers, num_blocks, block_size,
-                                   model.num_heads, model.head_dim)
+                                   model.num_heads, model.head_dim,
+                                   account_region="kv:%s" % name)
         self._params = model.param_dict()
         # mesh footprint: a sharded model (sharding.py) spans tp devices;
         # the fleet's placement and scaling advice count them through here
@@ -387,9 +388,9 @@ class DecodeEngine:
         mflags = self._placement_flags(model)
         dflags = self._placement_flags(draft_model)
         self._prefill_cop = CachedOp(self._prefill_forward, self._params,
-                                     flags=mflags)
+                                     flags=mflags)  # mxmem: nodonate(K/V pools are threaded functionally and re-read for export/handoff; donating would alias live pages)
         self._decode_cop = CachedOp(self._decode_forward, self._params,
-                                    flags=mflags)
+                                    flags=mflags)  # mxmem: nodonate(pool handles outlive the step: export_stream and bitwise replay re-read them after dispatch)
         retry = util.retry(attempts=_EXEC_ATTEMPTS, backoff=_EXEC_BACKOFF_S,
                            on_retry=lambda exc, i: self.stats.on_retry())
         self._prefill_exec = retry(self._prefill_once)
@@ -397,7 +398,7 @@ class DecodeEngine:
         self._chunk_cop = self._chunk_exec = None
         if self.prefill_chunk is not None:
             self._chunk_cop = CachedOp(self._chunk_forward, self._params,
-                                       flags=mflags)
+                                       flags=mflags)  # mxmem: nodonate(chunked prefill re-enters with the same pools across chunks; donation would free them mid-prompt)
             self._chunk_exec = retry(self._chunk_once)
         self._verify_cop = self._verify_exec = None
         self._draft_cop = self._draft_exec = None
@@ -407,14 +408,14 @@ class DecodeEngine:
         if self.spec_k > 0:
             self._draft_params = draft_model.param_dict()
             self._verify_cop = CachedOp(self._verify_forward, self._params,
-                                        flags=mflags)
+                                        flags=mflags)  # mxmem: nodonate(verify reads the same pools the decode path owns; rejected drafts roll back to them)
             self._verify_exec = retry(self._verify_once)
             self._draft_cop = CachedOp(self._draft_forward,
-                                       self._draft_params, flags=dflags)
+                                       self._draft_params, flags=dflags)  # mxmem: nodonate(draft pools persist across speculation rounds and rollbacks)
             self._draft_exec = retry(self._draft_once)
             self._draft_chunk_cop = CachedOp(self._draft_chunk_forward,
                                              self._draft_params,
-                                             flags=dflags)
+                                             flags=dflags)  # mxmem: nodonate(draft prefill shares the draft pools with the per-round draft loop)
             self._draft_chunk_exec = retry(self._draft_chunk_once)
         self.warmup_report = None
         if warmup:
@@ -578,11 +579,29 @@ class DecodeEngine:
         return [nd.zeros(shape, dtype="float32"),
                 nd.zeros(shape, dtype="float32")]
 
+    def _record_pools(self, pools, shape):
+        """Charge a freshly materialized K/V pool set to the engine's pool
+        region (``<account_region>:pools``): ``prod(shape)`` fp32 words per
+        pool.  Pool sets either live for the engine's lifetime or are
+        warmup/reference throwaways, so the region only allocates — its
+        alloc_bytes is the total pool traffic the engine ever charged."""
+        from ... import memory_accounting
+        nbytes = 1
+        for d in shape:
+            nbytes *= int(d)
+        nbytes *= 4 * len(pools)   # fp32 pools
+        memory_accounting.record_alloc(
+            nbytes, "%s:pools" % self._cache.account_region,
+            count=len(pools))
+        return pools
+
     def _init_pools(self):
         """Fresh target-model K/V pools on the model's placement."""
+        shape = self._cache.pool_shape()
         if getattr(self.model, "zeros_pool", None) is None:
-            return self._cache.init_pools()
-        return self._zeros_pools(self.model, self._cache.pool_shape())
+            return self._record_pools(self._cache.init_pools(), shape)
+        return self._record_pools(self._zeros_pools(self.model, shape),
+                                  shape)
 
     def _draft_pools(self):
         """Fresh zeroed draft-model K/V pools (same block grid as the
@@ -590,7 +609,8 @@ class DecodeEngine:
         shape = (self.draft.num_layers, self._cache.num_blocks,
                  self._cache.block_size, self.draft.num_heads,
                  self.draft.head_dim)
-        return self._zeros_pools(self.draft, shape)
+        return self._record_pools(self._zeros_pools(self.draft, shape),
+                                  shape)
 
     # -- warmup ----------------------------------------------------------
     def warmup(self):
@@ -1442,8 +1462,16 @@ class DecodeEngine:
         self._cache.ensure_capacity(seq.seq_id, position)
         blocks = self._cache.blocks_of(seq.seq_id)
         idx = np.asarray(blocks, np.int32)
+        # the snapshot's K/V pages stage host->device as two transient
+        # buffers, consumed by the scatter below; the paired free keeps
+        # the region balanced while its peak records the staging cost
+        from ... import memory_accounting
+        staged = int(snap["k"].nbytes) + int(snap["v"].nbytes)
+        region = "%s:import" % self._cache.account_region
+        memory_accounting.record_alloc(staged, region, count=2)
         k_pool = NDArray(k_pool._data.at[:, idx].set(snap["k"]))
         v_pool = NDArray(v_pool._data.at[:, idx].set(snap["v"]))
+        memory_accounting.record_free(staged, region, count=2)
         seq.position = position
         seq.cur_token = int(snap["cur_token"])
         seq.generated = int(snap["generated"])
@@ -1663,13 +1691,24 @@ class DecodeEngine:
             draining = self._draining or self._closed
         snap = self.stats.snapshot()
         kv = self._cache.stats()
+        from ... import memory_accounting
+        mem = memory_accounting.memory_counters().get(
+            self._cache.account_region, {})
+        free_blocks = self._cache.available_unreserved()
         return {
             # available_unreserved counts a page shared by N sequences
             # ONCE — the fleet's headroom math sees real free blocks, not
             # N-times-counted shared ones
-            "kv_blocks_free": self._cache.available_unreserved(),
+            "kv_blocks_free": free_blocks,
             "kv_capacity": self._cache.capacity(),
             "kv_block_size": self._cache.block_size,
+            # bytes-based headroom from the HBM accountant + block geometry
+            # (memory_accounting.py): what scaling_advice() aggregates
+            "kv_block_bytes": kv["block_bytes"],
+            "kv_bytes_free": free_blocks * kv["block_bytes"],
+            "kv_bytes_capacity": self._cache.capacity() * kv["block_bytes"],
+            "kv_bytes_live": int(mem.get("live_bytes", 0)),
+            "kv_bytes_peak": int(mem.get("peak_bytes", 0)),
             "queue_depth": queue_depth,
             "max_queue": self._max_queue,
             "slots_live": slots_live,

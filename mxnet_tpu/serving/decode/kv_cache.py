@@ -70,6 +70,13 @@ The pools are threaded *functionally* through the decode CachedOps (inputs
 object never holds them, so the accounting lock is never held across an XLA
 call.  Thread-safe: every mutable field is guarded by ``_lock``
 (docs/CONCURRENCY.md).
+
+Every accounting increment mirrors into the process-wide byte accountant
+(``mxnet_tpu.memory_accounting``) under the cache's ``account_region``
+label (default: a unique ``"kv:N"``): attach/grow/CoW-attach record
+``block_bytes`` allocated, detach/free record it freed — the runtime half
+of the mem lint pass (analysis/memory_lint.py), which the ``mem`` stress
+scenario cross-checks against ``stats()``'s allocated/freed totals.
 """
 from __future__ import annotations
 
@@ -82,6 +89,17 @@ from ...base import MXNetError
 __all__ = ["PagedKVCache", "ReserveResult"]
 
 _CHAIN_SEED = b"mxnet-tpu-kv-prefix-v1"
+
+_REGION_LOCK = threading.Lock()
+_REGION_IDS = 0
+
+
+def _next_account_region():
+    """Unique default byte-accountant region label for a new cache."""
+    global _REGION_IDS
+    with _REGION_LOCK:
+        _REGION_IDS += 1
+        return "kv:%d" % _REGION_IDS
 
 
 class ReserveResult:
@@ -114,17 +132,24 @@ class ReserveResult:
 
 class PagedKVCache:
     def __init__(self, num_layers, num_blocks, block_size, num_heads,
-                 head_dim, dtype="float32"):
+                 head_dim, dtype="float32", account_region=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the trash block)")
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
+        import numpy as np
         self.num_layers = int(num_layers)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = dtype
+        # one logical block = a K page + a V page across every layer
+        self.block_bytes = (2 * self.num_layers * self.block_size
+                            * self.num_heads * self.head_dim
+                            * np.dtype(dtype).itemsize)
+        self.account_region = (str(account_region) if account_region
+                               else _next_account_region())
         # re-entrant: the allocation helpers below guard themselves, and
         # the public operations call them with the lock already held
         self._lock = threading.RLock()
@@ -178,6 +203,18 @@ class PagedKVCache:
         tail = tuple(int(t) for t in prompt[full * bs:])
         return out, tail
 
+    def _account_alloc(self, nblocks=1):
+        """Mirror ``nblocks`` page attachments into the byte accountant."""
+        from ... import memory_accounting
+        memory_accounting.record_alloc(self.block_bytes * nblocks,
+                                       self.account_region, count=nblocks)
+
+    def _account_free(self, nblocks=1):
+        """Mirror ``nblocks`` page detachments into the byte accountant."""
+        from ... import memory_accounting
+        memory_accounting.record_free(self.block_bytes * nblocks,
+                                      self.account_region, count=nblocks)
+
     def _take_block_locked(self):
         """Pop a free block, evicting the LRU cached block if none free.
         Eviction only ever touches the ref==0 cached pool, so shared pages
@@ -203,6 +240,7 @@ class PagedKVCache:
             self._ref[block] = ref + 1
             self._tables.setdefault(seq_id, []).append(block)
             self._allocated_total += 1
+            self._account_alloc()
 
     def _used_locked(self):
         with self._lock:
@@ -294,6 +332,7 @@ class PagedKVCache:
             self._tables.setdefault(seq_id, []).append(block)
             self._ref[block] = 1
             self._allocated_total += 1
+            self._account_alloc()
             self._note_peak_locked()
             return block
 
@@ -334,7 +373,9 @@ class PagedKVCache:
             self._ref[block] -= 1
             self._ref[new] = 1
             self._freed_total += 1       # detached the shared page
+            self._account_free()
             self._allocated_total += 1   # attached the private copy
+            self._account_alloc()
             self._cow_forks += 1
             self._note_peak_locked()
             return new, block
@@ -398,6 +439,8 @@ class PagedKVCache:
                 else:
                     self._free.append(block)
             self._freed_total += len(blocks)
+            if blocks:
+                self._account_free(len(blocks))
             self._reserved -= self._reservations.pop(seq_id, 0)
             return len(blocks)
 
@@ -445,6 +488,7 @@ class PagedKVCache:
             return {
                 "num_blocks": self.num_blocks - 1,   # allocatable
                 "block_size": self.block_size,
+                "block_bytes": self.block_bytes,
                 "used": self._used_locked(),
                 "free": len(self._free),
                 "reserved": self._reserved,

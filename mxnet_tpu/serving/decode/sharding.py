@@ -345,6 +345,12 @@ class ShardedDecodeModel:
         # the gather-at-use region does NO reductions — replicated math is
         # the bitwise contract.  Item 1's compute-parallel kernels will
         # raise this to the Megatron one-psum-per-block budget.
+        # The decode step's declared worst case: every gather-at-use temp
+        # (full params once per sharded dim + both full K/V pools) live at
+        # once under the accountant's reuse-free model —
+        # predict_decode_step_peak_bytes() is the exact symbolic form,
+        # pinned == the runtime peak in BENCH_SHARDED_DECODE.json.
+        # mxmem: budget(hbm=64MB)
         # mxshard: budget(psum=0)
         def body(p_local, small, k_local, v_local):
             p_full = {n: gathered(v, pspecs[n])

@@ -1062,10 +1062,12 @@ class FleetRouter:
             return {"action": "hold", "kv_utilization": 0.0,
                     "queue_fill": 0.0, "unhealthy_breakers": 0,
                     "devices_in_use": 0, "devices_total": devices_total,
+                    "kv_bytes_free": 0, "kv_bytes_capacity": 0,
                     "engines": {},
                     "reasons": ["no decode engines placed"]}
         utils, fills = [], []
         devices_in_use = 0
+        kv_bytes_free = kv_bytes_capacity = 0
         per_name = {}
         for (name, _rid), eng in engines:
             sig = eng.routing_signals()
@@ -1073,14 +1075,21 @@ class FleetRouter:
             util = 1.0 - sig["kv_blocks_free"] / cap
             fill = sig["queue_depth"] / max(1, sig["max_queue"])
             devs = max(1, int(sig.get("tp_degree", 1)))
+            b_free = int(sig.get("kv_bytes_free", 0))
+            b_cap = int(sig.get("kv_bytes_capacity", 0))
             utils.append(util)
             fills.append(fill)
             devices_in_use += devs
+            kv_bytes_free += b_free
+            kv_bytes_capacity += b_cap
             row = per_name.setdefault(
                 name, {"replicas": 0, "devices_in_use": 0,
+                       "kv_bytes_free": 0, "kv_bytes_capacity": 0,
                        "_utils": [], "_fills": []})
             row["replicas"] += 1
             row["devices_in_use"] += devs
+            row["kv_bytes_free"] += b_free
+            row["kv_bytes_capacity"] += b_cap
             row["_utils"].append(util)
             row["_fills"].append(fill)
         breakdown = {}
@@ -1098,6 +1107,8 @@ class FleetRouter:
                 "devices_in_use": row["devices_in_use"],
                 "kv_utilization": n_util,
                 "queue_fill": n_fill,
+                "kv_bytes_free": row["kv_bytes_free"],
+                "kv_bytes_capacity": row["kv_bytes_capacity"],
                 "reasons": n_reasons,
             }
         kv_util = sum(utils) / len(utils)
@@ -1126,6 +1137,10 @@ class FleetRouter:
                 "queue_fill": queue_fill, "unhealthy_breakers": unhealthy,
                 "devices_in_use": devices_in_use,
                 "devices_total": devices_total,
+                # bytes-based headroom summed from the engines' HBM
+                # accountant signals (block geometry x unreserved blocks)
+                "kv_bytes_free": kv_bytes_free,
+                "kv_bytes_capacity": kv_bytes_capacity,
                 "engines": breakdown,
                 "reasons": reasons}
 

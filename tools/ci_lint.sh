@@ -1,13 +1,13 @@
 #!/usr/bin/env bash
-# One-shot runner for all eight mxlint passes (tracing, registry, cabi,
-# concur, sync, rcp, res, spd) — the CI lint gate.  Any extra arguments
+# One-shot runner for all nine mxlint passes (tracing, registry, cabi,
+# concur, sync, rcp, res, spd, mem) — the CI lint gate.  Any extra arguments
 # are forwarded to tools/mxlint.py, so the incremental pre-commit flavor
 # is:
 #
 #   tools/ci_lint.sh --since HEAD~1
 #
 # Exits non-zero iff any finding is not covered by the baseline (the
-# concur/sync/rcp/res/spd families keep EMPTY baselines: fix, never
+# concur/sync/rcp/res/spd/mem families keep EMPTY baselines: fix, never
 # suppress).
 set -euo pipefail
 cd "$(dirname "$0")/.."

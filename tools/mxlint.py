@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """mxlint — framework-native static analysis for the TPU build.
 
-Runs eight passes (see docs/LINT.md) and exits non-zero iff any finding is
+Runs nine passes (see docs/LINT.md) and exits non-zero iff any finding is
 not covered by the checked-in baseline:
 
   tracing   AST pass over mxnet_tpu/ (tracer concretization, host syncs in
@@ -18,6 +18,10 @@ not covered by the checked-in baseline:
   spd       mxshard SPMD sharding lint over parallel/ and serving/decode/
             (collective sanctions, region budgets, axis names, eager
             divisibility; SPD; empty baseline, tags -> COLLECTIVE_MAP)
+  mem       mxmem device-memory liveness/donation/footprint lint over
+            parallel/, module/, and serving/decode/ (donation at jit/
+            CachedOp boundaries, hbm budgets, hot-path reserve coverage,
+            full-shape temps; MEM; empty baseline, tags -> MEM_MAP)
 
 Usage:
   python tools/mxlint.py                      # all passes, text output
@@ -26,6 +30,7 @@ Usage:
   python tools/mxlint.py --since HEAD~1       # findings in changed files
   python tools/mxlint.py --sync-map           # regenerate docs/SYNC_MAP.md
   python tools/mxlint.py --collective-map     # regenerate docs/COLLECTIVE_MAP.md
+  python tools/mxlint.py --mem-map            # regenerate docs/MEM_MAP.md
   python tools/mxlint.py --update-baseline    # rewrite .mxlint-baseline.json
   python tools/mxlint.py --no-baseline        # raw findings, no suppression
 """
@@ -59,6 +64,7 @@ _REGISTRY = _load_registry()
 PASSES = _REGISTRY.PASSES
 DEFAULT_SYNC_MAP = os.path.join("docs", "SYNC_MAP.md")
 DEFAULT_COLLECTIVE_MAP = os.path.join("docs", "COLLECTIVE_MAP.md")
+DEFAULT_MEM_MAP = os.path.join("docs", "MEM_MAP.md")
 
 
 def collect(passes, root):
@@ -100,11 +106,12 @@ def main(argv=None):
                     help="incremental mode: only report findings in files "
                          "changed vs REV (git diff + untracked); the "
                          "registry pass is skipped unless ops or tests "
-                         "changed, the spd pass unless parallel/ or "
-                         "serving/decode/ changed (and its findings then "
-                         "bypass the file filter — sharding facts cross "
-                         "files), and stale-key detection is off (a "
-                         "partial view cannot prove a fix)")
+                         "changed, the spd/mem passes unless parallel/, "
+                         "module/, or serving/decode/ changed (and their "
+                         "findings then bypass the file filter — sharding "
+                         "and memory facts cross files), and stale-key "
+                         "detection is off (a partial view cannot prove "
+                         "a fix)")
     ap.add_argument("--sync-map", nargs="?", const=DEFAULT_SYNC_MAP,
                     default=None, metavar="PATH",
                     help="write the sanctioned host-sync catalog (default "
@@ -114,6 +121,10 @@ def main(argv=None):
                     metavar="PATH",
                     help="write the sanctioned-collective catalog (default "
                          "%s) and exit" % DEFAULT_COLLECTIVE_MAP)
+    ap.add_argument("--mem-map", nargs="?", const=DEFAULT_MEM_MAP,
+                    default=None, metavar="PATH",
+                    help="write the device-memory footprint catalog "
+                         "(default %s) and exit" % DEFAULT_MEM_MAP)
     ap.add_argument("--baseline",
                     default=os.path.join(REPO, ".mxlint-baseline.json"),
                     help="baseline/suppression file "
@@ -157,6 +168,18 @@ def main(argv=None):
               % (len(entries[0]), path))
         return 0
 
+    if args.mem_map is not None:
+        from mxnet_tpu.analysis import memory_lint
+        entries = memory_lint.mem_map_entries(args.root)
+        path = args.mem_map
+        if not os.path.isabs(path):
+            path = os.path.join(args.root, path)
+        with open(path, "w") as f:
+            f.write(memory_lint.render_mem_map(entries))
+        print("wrote %d memory site(s), %d hbm budget(s) to %s"
+              % (len(entries[0]), len(entries[1]), path))
+        return 0
+
     changed = None
     if args.since is not None:
         try:
@@ -174,16 +197,23 @@ def main(argv=None):
             if not any(p.startswith(SCAN_PREFIXES) for p in changed):
                 # the sharding lint only reads parallel/ and serving/decode/
                 passes = [p for p in passes if p != "spd"]
+        if "mem" in passes:
+            from mxnet_tpu.analysis.memory_lint import SCAN_PREFIXES
+            if not any(p.startswith(SCAN_PREFIXES) for p in changed):
+                # the memory lint only reads its scanned directories
+                passes = [p for p in passes if p != "mem"]
         if not changed:
             passes = []
 
     findings, report = collect(passes, args.root)
     if changed is not None:
-        # SPD findings escape the changed-file filter: sharding facts
-        # (mesh axes, partition specs, budgets) propagate across files,
-        # so an edit in parallel/ can surface a finding elsewhere
+        # SPD/MEM findings escape the changed-file filter: sharding and
+        # memory facts (mesh axes, partition specs, budgets, donation)
+        # propagate across files, so an edit in parallel/ can surface a
+        # finding elsewhere
         findings = [f for f in findings
-                    if f.path in changed or f.rule.startswith("SPD")]
+                    if f.path in changed
+                    or f.rule.startswith(("SPD", "MEM"))]
 
     if args.update_baseline:
         if args.since is not None:

@@ -40,7 +40,14 @@ DRAINED: cross-tier conservation settles on the prefill router's single
 ledger, handed-off streams stay bitwise-equal to the colocated
 reference, killed streams leave strict prefixes that re-admit and
 continue the greedy path bitwise, KV pools drain whole on both tiers,
-and surviving engines never recompile.  Exit code is non-zero iff any
+and surviving engines never recompile.  The ``mem`` scenario is the
+dynamic twin of ``--passes mem`` (docs/MEM_MAP.md): a seeded
+memory-pressure storm on one paged KV pool (reserve/grow/CoW-fork/free
+under preemption) after which the attachment ledger must conserve
+(allocated == freed, used == 0), the byte accountant must mirror it
+exactly (live_bytes == 0, alloc counts equal), region peak_bytes must
+stay under the declared admission budget, and physical peak_used must
+stay <= pool capacity.  Exit code is non-zero iff any
 seed violated any invariant.
 
 Usage:

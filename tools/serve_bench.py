@@ -649,6 +649,59 @@ def measure_decode_step_collectives(model_cfg, tp, block_size):
     }
 
 
+def measure_decode_step_peak_bytes(model_cfg, tp, block_size):
+    """Per-decode-step device-memory peak of the sharded engine, measured
+    two independent ways and cross-checked:
+
+    * **runtime** — the region-peak bytes from
+      ``mxnet_tpu.memory_accounting`` over ONE un-jitted ``decode_fn``
+      call under ``track_region("bench:decode-step")`` (the collective
+      wrappers record their output temps into the active region);
+    * **static** — ``analysis.memory_lint.predict_decode_step_peak_bytes``
+      derived from the partition specs and pool shape alone, no tracing.
+
+    ``static_matches_runtime`` (exact bytes) is a ``_sharded_decode_ok``
+    exit gate: the lint's abstract footprint model must agree with what
+    the accountant actually charges."""
+    import jax.numpy as jnp
+    from mxnet_tpu.analysis.memory_lint import (
+        predict_decode_step_peak_bytes)
+    from mxnet_tpu.memory_accounting import (device_memory_stats,
+                                             memory_counters,
+                                             reset_memory_counters,
+                                             track_region)
+    from mxnet_tpu.serving.decode import ShardedDecodeModel, TinyCausalLM
+
+    model = ShardedDecodeModel(TinyCausalLM(**model_cfg), tp=tp)
+    S, W = 2, 2
+    pool_shape = (model.num_layers, S * W + 1, block_size,
+                  model.num_heads, model.head_dim)
+    k_pool = model.zeros_pool(pool_shape)
+    v_pool = model.zeros_pool(pool_shape)
+    p = {n: a._data for n, a in model.param_dict().items()}
+    reset_memory_counters()
+    with track_region("bench:decode-step"):
+        model.decode_fn(p, jnp.zeros((S,), jnp.int32),
+                        jnp.zeros((S,), jnp.int32),
+                        jnp.zeros((S, W), jnp.int32),
+                        k_pool._data, v_pool._data)
+    region = memory_counters().get("bench:decode-step",
+                                   {"temps": 0, "peak_bytes": 0,
+                                    "live_bytes": 0})
+    reset_memory_counters()
+    predicted = predict_decode_step_peak_bytes(model,
+                                               pool_shape=pool_shape)
+    return {
+        "region": "bench:decode-step",
+        "temps_per_step": region["temps"],
+        "runtime_peak_bytes": region["peak_bytes"],
+        "static_predicted_peak_bytes": predicted,
+        "live_bytes_after": region["live_bytes"],
+        "static_matches_runtime": predicted == region["peak_bytes"],
+        "device_memory_stats_available": device_memory_stats() is not None,
+    }
+
+
 def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
                              max_new, seed, model_cfg, tp=2):
     """Tensor-parallel vs replicated decode at an equal device budget.
@@ -765,9 +818,11 @@ def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
     tp2 = one(tp, 1)
     collectives = measure_decode_step_collectives(model_cfg, tp,
                                                   block_size)
+    memory = measure_decode_step_peak_bytes(model_cfg, tp, block_size)
     return {
         "profile": "sharded-decode",
         "collectives": collectives,
+        "memory": memory,
         "workload": {
             "streams": streams,
             "slots": slots,
@@ -793,7 +848,9 @@ def _sharded_decode_ok(report):
     is bitwise-equal to the single-device reference, and zero
     steady-state recompiles / leaked KV blocks; the legs must actually
     consume the same device count and the sharded leg must report the
-    declared tp_degree."""
+    declared tp_degree.  The static collective AND memory models must
+    both match the measured per-step reality exactly (calls, bytes, and
+    peak-bytes), and the decode-step accounting region must drain."""
     for leg in (report["tp1"], report["tp2"]):
         if set(leg["statuses"]) != {"OK"}:
             return False
@@ -806,6 +863,11 @@ def _sharded_decode_ok(report):
     if report["tp2"]["tp_degree"] != report["workload"]["tp"]:
         return False
     if not report["collectives"]["static_matches_runtime"]:
+        return False
+    mem = report["memory"]
+    if not mem["static_matches_runtime"]:
+        return False
+    if mem["runtime_peak_bytes"] <= 0 or mem["live_bytes_after"] != 0:
         return False
     return True
 
@@ -831,6 +893,8 @@ def run_disagg_bench(rate_hz, duration_s, slots, block_size, chunk,
     steady-state recompiles and zero leaked KV blocks on every engine
     of both legs, and every OK stream BITWISE-equal to the single-
     engine reference for its (prompt, budget, sampling) triple."""
+    from mxnet_tpu.memory_accounting import (memory_counters,
+                                             reset_memory_counters)
     from mxnet_tpu.serving import traffic
     from mxnet_tpu.serving.decode import DecodeEngine, TinyCausalLM
     from mxnet_tpu.serving.disagg import DisaggRouter
@@ -884,6 +948,9 @@ def run_disagg_bench(rate_hz, duration_s, slots, block_size, chunk,
                 for p, b, opts in zip(prompts, budgets, sampling)]
     finally:
         ref_eng.stop()
+    # clean HBM-accountant slate for the two measured legs: every kv:*
+    # region charged from here on belongs to a leg engine
+    reset_memory_counters()
 
     def drive(submit_stream, ledger, engine_snaps, extra=None):
         """Replay the trace open-loop and account one leg."""
@@ -1004,8 +1071,36 @@ def run_disagg_bench(rate_hz, duration_s, slots, block_size, chunk,
 
     speedup = (disagg["goodput_per_s"] / colocated["goodput_per_s"]
                if colocated["goodput_per_s"] else 0.0)
+    # fleet-wide HBM accounting across BOTH legs' engines: every KV-block
+    # region must drain (alloc == freed, zero live) once the engines
+    # stop; the :pools subregions are alloc-only (engine-lifetime pools)
+    # and the :import subregions record balanced handoff staging, so the
+    # balance gate reads only the block-ledger regions
+    kv_regions = {r: c for r, c in memory_counters().items()
+                  if r.startswith("kv:")}
+    blocks = {r: c for r, c in kv_regions.items()
+              if not r.endswith((":pools", ":import"))}
+    memory = {
+        "kv_regions": len(kv_regions),
+        "kv_alloc_bytes": sum(c["alloc_bytes"]
+                              for c in kv_regions.values()),
+        "kv_freed_bytes": sum(c["freed_bytes"]
+                              for c in kv_regions.values()),
+        # block-ledger live bytes: must drain to zero once engines stop
+        "kv_live_bytes": sum(c["live_bytes"] for c in blocks.values()),
+        # engine-lifetime pools: charged once at warmup, never freed
+        "kv_pool_bytes": sum(c["live_bytes"]
+                             for r, c in kv_regions.items()
+                             if r.endswith(":pools")),
+        "kv_peak_bytes": sum(c["peak_bytes"]
+                             for c in kv_regions.values()),
+        "balanced": bool(blocks) and all(
+            c["alloc_bytes"] == c["freed_bytes"] and c["live_bytes"] == 0
+            for c in blocks.values()),
+    }
     return {
         "profile": "disagg",
+        "memory": memory,
         "workload": {
             "rate_hz": rate_hz,
             "duration_s": duration_s,
@@ -1037,7 +1132,9 @@ def _disagg_ok(report):
     zero steady-state recompiles on every engine (both tiers), and
     every OK stream is bitwise-equal to the reference; the disagg leg
     must actually hand off (at least one cross-tier handoff, none
-    failed).  The >= 1.2x goodput bar is reported, not gated — on a
+    failed), and the HBM accountant's KV block regions must drain across
+    both legs (``memory.balanced``).  The >= 1.2x goodput bar is
+    reported, not gated — on a
     shared-core CPU host the tiers contend for the same silicon (see
     the artifact's ``speedup_goodput`` and docs/SERVING.md)."""
     for leg in (report["colocated"], report["disagg"]):
@@ -1054,6 +1151,9 @@ def _disagg_ok(report):
     if hand["handoffs"] < 1 or hand["handoff_failures"]:
         return False
     if report["colocated"]["devices"] != report["disagg"]["devices"]:
+        return False
+    mem = report["memory"]
+    if not mem["balanced"] or mem["kv_alloc_bytes"] <= 0:
         return False
     return True
 
@@ -1090,6 +1190,11 @@ def _main_sharded_decode(args, ap):
           % (coll["gathers_per_step"], coll["psums_per_step"],
              coll["collective_bytes_per_step"],
              coll["static_matches_runtime"]))
+    mem = report["memory"]
+    print("memory/step: %d temp(s), peak %d byte(s)  "
+          "static==runtime: %s"
+          % (mem["temps_per_step"], mem["runtime_peak_bytes"],
+             mem["static_matches_runtime"]))
     print("relative: %sx  wrote %s"
           % (report["relative_tokens_per_s"], args.out))
     return 0 if _sharded_decode_ok(report) else 1
@@ -1222,6 +1327,9 @@ def _main_disagg(args, ap):
                  round(g["ttft_ms"]["p99"], 2),
                  round(g["tpot_ms"]["p99"], 3),
                  leg["bitwise_equal_reference"]))
+    mem = report["memory"]
+    print("memory: %d kv region(s), %d byte(s) allocated, balanced: %s"
+          % (mem["kv_regions"], mem["kv_alloc_bytes"], mem["balanced"]))
     print("handoffs: %d (failed %d)  speedup: %sx  wrote %s"
           % (report["disagg"]["handoffs"]["handoffs"],
              report["disagg"]["handoffs"]["handoff_failures"],
