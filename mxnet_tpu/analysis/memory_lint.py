@@ -1197,36 +1197,30 @@ def render_mem_map(entries):
     return "\n".join(lines)
 
 
-def predict_decode_step_peak_bytes(model, pool_shape, pool_itemsize=4):
+def predict_decode_step_peak_bytes(model, slots=2, itemsize=4):
     """Worst-case per-step HBM temp peak of the sharded decode region,
-    derived from the partition specs alone — no tracing: each sharded
-    parameter dim gathers one FULL-shape temp (total bytes, not the local
-    shard — the gather OUTPUT is what lands in HBM), each sharded K/V pool
-    axis gathers one full pool per pool, and under the accountant's
-    reuse-free region model every temp is live until the region ends, so
-    the peak is their sum.
+    derived from the compute-parallel kernel structure alone — no
+    tracing: the only collective temps a decode step materializes are its
+    psum OUTPUTS (a psum output is shaped like its input), one per
+    runtime psum call — the ``[slots, hidden]`` embedding assembly, two
+    ``[slots, hidden]`` Megatron block reductions per layer (int8 code
+    bytes under ``wire="2bit"``), and the ``[slots, vocab]`` tied-unembed
+    logits.  Under the accountant's reuse-free region model every temp is
+    live until the region ends, so the peak is their sum.  The PR 15
+    gather-at-use wrapper peaked at the FULL gathered weights + both full
+    K/V pools; the compute-parallel kernels delete those temps entirely.
 
     This is the static half of the acceptance cross-check: the runtime
-    ``track_region`` peak over ONE un-jitted ``decode_fn`` call (the
-    shard_map body re-traces per call, and every collective wrapper
-    records its output temp) must equal it EXACTLY — divisibility is
-    guaranteed by ``check_tp_divisible`` at model construction, so
-    shard x tp == total with no rounding."""
-    total_bytes = 0
-    for name, spec in model._pspecs.items():
-        arr = model._params[name]
-        data = getattr(arr, "_data", arr)
-        total = 1
-        for d in data.shape:
-            total *= int(d)
-        itemsize = data.dtype.itemsize
-        for ax in tuple(spec):
-            if ax is not None:
-                total_bytes += total * itemsize
-    pool_axes = sum(1 for ax in tuple(model._pool_sharding.spec)
-                    if ax is not None)
-    pool_total = 1
-    for d in pool_shape:
-        pool_total *= int(d)
-    total_bytes += 2 * pool_axes * pool_total * pool_itemsize
-    return total_bytes
+    ``track_region`` peak over ONE un-jitted ``decode_fn`` call with
+    ``slots`` decode slots (the shard_map body re-traces per call, and
+    every collective wrapper records its output temp) must equal it
+    EXACTLY."""
+    L = int(model.num_layers)
+    S = int(slots)
+    hidden = int(model.num_heads) * int(model.head_dim)
+    vocab = int(model.vocab_size)
+    wire_itemsize = 1 if getattr(model, "wire", None) == "2bit" \
+        else itemsize
+    return (S * hidden * itemsize
+            + 2 * L * S * hidden * wire_itemsize
+            + S * vocab * itemsize)

@@ -86,9 +86,11 @@ Invariants asserted (per seed)
   nothing recompiles (see ``decode_prefix_storm``).
 * **sharded decode storm** (``sharded_decode``) — greedy and seeded
   sampled streams over tensor-parallel mesh-backed engines
-  (``ShardedDecodeModel(tp=2)``, head-sharded K/V pools) while one
-  replica drains mid-run: the sharded→sharded handoff keeps OK streams
-  bitwise-equal to the SINGLE-DEVICE reference, every engine's pool
+  (``ShardedDecodeModel(tp=2)``, head-sharded K/V pools, gather-free
+  compute-parallel kernels) while one replica drains mid-run: the
+  sharded→sharded handoff keeps OK token streams identical to the
+  SINGLE-DEVICE reference (logits are allclose under the Megatron
+  psums; the token claim is exact), every engine's pool
   drains whole on every shard (host accounting + tp_degree signals),
   router/engine conservation holds, and the warmed shard_map signatures
   never recompile (see ``sharded_decode_storm``).
@@ -1952,12 +1954,13 @@ def _build_sharded_decode_fixture():
     """-> (router, engine_name, prompts, greedy_refs, sampled_refs).
 
     Two replicas, each hosting a DecodeEngine over
-    ``ShardedDecodeModel(tp=2)`` — head-sharded K/V pools, gathered
-    compute — declared ``tp=2`` to the router so the device-footprint
-    accounting is live under the storm.  The references come from an
-    UNSHARDED engine over the same seeded weights: the scenario's bitwise
-    claim is sharded-vs-single-device, held across a mid-storm
-    sharded→sharded handoff."""
+    ``ShardedDecodeModel(tp=2)`` — head-sharded K/V pools, gather-free
+    compute-parallel Megatron kernels — declared ``tp=2`` to the router
+    so the device-footprint accounting is live under the storm.  The
+    references come from an UNSHARDED engine over the same seeded
+    weights: the scenario's claim is sharded-vs-single-device TOKEN
+    identity (logits are allclose, not bitwise, under the per-block
+    psums), held across a mid-storm sharded→sharded handoff."""
     from ..serving.decode import (DecodeEngine, ShardedDecodeModel,
                                   TinyCausalLM)
     from ..serving.fleet import FleetRouter

@@ -1001,11 +1001,14 @@ def render_collective_map(entries):
         "do not edit by hand (tests/test_mxshard.py compares this file",
         "against a fresh render).  Every entry is a collective site the",
         "spd pass (docs/LINT.md) would flag, sanctioned by an inline",
-        "justification tag or a region budget.  The `gather-ok` entries",
-        "in serving/decode are the measured gather tax",
-        "(BENCH_SHARDED_DECODE.json, docs/PERF.md): ROADMAP item 1's",
-        "compute-parallel kernels land by DELETING those tags and",
-        "holding the region to its Megatron psum budget.",
+        "justification tag or a region budget.  The decode-step region",
+        "holds the Megatron compute-parallel contract: ZERO gather-ok",
+        "sites (the PR 15 gather-at-use tax is deleted) and a",
+        "budget(psum=4) covering its four allclose-sanctioned psum sites",
+        "— embedding assembly (order-free, exact), the per-block",
+        "row-parallel reduction, its opt-in 2-bit quantized wire, and",
+        "the tied-unembed reduction (BENCH_SHARDED_DECODE.json,",
+        "docs/PERF.md measure the resulting 2L+2-psum/zero-gather bill).",
         "",
     ]
     cur = None
@@ -1041,48 +1044,34 @@ def render_collective_map(entries):
     return "\n".join(lines)
 
 
-def predict_decode_step_collectives(model, pool_shape=None,
-                                    pool_itemsize=4):
+def predict_decode_step_collectives(model, slots=2, itemsize=4):
     """Per-step collective cost of a ShardedDecodeModel decode region,
-    derived from the abstract sharding model (partition specs + pool
-    sharding), NOT from tracing: one all_gather per sharded dim per
-    parameter plus one per sharded K/V pool operand, payload = the local
-    shard bytes; zero reductions (the gather-at-use bitwise contract,
-    enforced by the region's ``budget(psum=0)``).
+    derived from the compute-parallel kernel structure, NOT from tracing:
+    one exact scatter-assembly psum for the column-sharded embedding
+    (``[slots, hidden]`` fp32), two Megatron block psums per layer
+    (row-parallel attention-out and MLP-out, ``[slots, hidden]`` — int8
+    code bytes under ``wire="2bit"``), and one weight-tied unembedding
+    psum (``[slots, vocab]``, always exact fp32).  Zero all_gathers: the
+    K/V pools never leave their head shard and weights contract locally
+    (the ``budget(psum=4)`` region — 4 static sites, ``2L + 2`` runtime
+    calls).
 
     This is the static half of the acceptance cross-check: the runtime
-    counter delta over ONE un-jitted ``decode_fn`` call (the shard_map
-    body re-traces per call) must match exactly — both call counts and
-    bytes when ``pool_shape`` is given (bytes are None otherwise).
+    counter delta over ONE un-jitted ``decode_fn`` call with ``slots``
+    decode slots (the shard_map body re-traces per call) must match
+    exactly — call counts and bytes (the counters record psum INPUT
+    operand bytes, and a psum input is full-width on every member).
     """
-    tp = int(model.tp)
-    calls = 0
-    nbytes = 0
-    for name, spec in model._pspecs.items():
-        arr = model._params[name]
-        data = getattr(arr, "_data", arr)
-        total = 1
-        for d in data.shape:
-            total *= int(d)
-        itemsize = data.dtype.itemsize
-        for ax in tuple(spec):
-            if ax is not None:
-                calls += 1
-                nbytes += (total * itemsize) // tp
-    pool_axes = sum(1 for ax in tuple(model._pool_sharding.spec)
-                    if ax is not None)
-    pool_bytes = None
-    if pool_shape is not None:
-        total = 1
-        for d in pool_shape:
-            total *= int(d)
-        pool_bytes = (total * pool_itemsize) // tp
-    for _pool in ("k", "v"):
-        calls += pool_axes
-        if pool_bytes is not None:
-            nbytes += pool_axes * pool_bytes
+    L = int(model.num_layers)
+    S = int(slots)
+    hidden = int(model.num_heads) * int(model.head_dim)
+    vocab = int(model.vocab_size)
+    wire_itemsize = 1 if getattr(model, "wire", None) == "2bit" \
+        else itemsize
+    nbytes = (S * hidden * itemsize          # embedding assembly, exact
+              + 2 * L * S * hidden * wire_itemsize   # Megatron blocks
+              + S * vocab * itemsize)        # tied unembed, exact
     return {
-        "all_gather": {"calls": calls,
-                       "bytes": nbytes if pool_shape is not None else None},
-        "psum": {"calls": 0, "bytes": 0},
+        "all_gather": {"calls": 0, "bytes": 0},
+        "psum": {"calls": 2 * L + 2, "bytes": nbytes},
     }

@@ -105,6 +105,11 @@ def discover_roles(names, layer_map=None):
 class GluonCausalLMAdapter:
     """Serve a Gluon causal LM through the paged-KV decode contract."""
 
+    # dense roles live in Gluon's [units, in] layout (the transpose of the
+    # contract's [in, units]); ShardedDecodeModel's compute-parallel
+    # kernels read this attr and transpose LOCAL shards back at trace time
+    param_layout = "gluon"
+
     def __init__(self, block, num_heads, eos_id=None, layer_map=None):
         params = {name: p for name, p in block.collect_params().items()}
         roles = discover_roles(list(params), layer_map)

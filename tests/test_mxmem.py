@@ -190,8 +190,14 @@ def test_three_regions_declare_hbm_budgets():
     }
     for b in budgets:
         assert b["concrete_bytes"] <= b["cap_bytes"]
-        # each budget region covers its full-shape gather sites
-        assert b["gather_sites"] >= 1
+    # the training regions still cover their full-shape gather sites;
+    # the compute-parallel decode step has NONE left (the deleted
+    # gather tax — its temps are the 2L+2 psum outputs)
+    assert regions["ShardedDecodeModel._build_fn.body"][
+        "gather_sites"] == 0
+    for qual in ("CompiledTrainStep._make_forward_fn.forward_fn",
+                 "make_sharded_update_step.step.body"):
+        assert regions[qual]["gather_sites"] >= 1
 
 
 def test_mem_map_is_fresh():
@@ -336,11 +342,14 @@ def test_decode_step_peak_prediction_matches_runtime():
     finally:
         reset_memory_counters()
     predicted = memory_lint.predict_decode_step_peak_bytes(
-        model, pool_shape=pool_shape)
+        model, slots=S)
     # exact agreement — the abstract footprint model is the metered
-    # truth of the gather-at-use temps, not an estimate
+    # truth of the psum-output temps, not an estimate (the gathered
+    # weight/pool temps of the PR 15 wrapper no longer exist)
     assert predicted == region["peak_bytes"] > 0
     assert region["live_bytes"] == 0            # all temps drained
+    # 2L+2 psum outputs are the ONLY collective temps per decode step
+    assert region["temps"] == 2 * model.num_layers + 2
 
 
 # ---------------------------------------------------------------------------

@@ -159,22 +159,35 @@ def test_repo_collective_sites_all_sanctioned():
     unsanctioned = [s for s in sites if s["sanction"] == "UNSANCTIONED"]
     assert unsanctioned == []
     assert all(s["reason"].strip() for s in sites)
-    # the gather-at-use tax sites are tagged in the decode region, with
-    # the ROADMAP pointer that deletes them
+    # the gather tax is DELETED: the compute-parallel kernels keep zero
+    # gather-ok sites in the decode-step region (the only sharding.py
+    # all_gather left is the fused long-context sp path, outside it)
     decode_gathers = [
         s for s in sites
         if s["path"] == "mxnet_tpu/serving/decode/sharding.py"
-        and s["kind"] == "all_gather" and s["sanction"] == "gather-ok"]
-    assert len(decode_gathers) >= 3
+        and s["kind"] == "all_gather" and s["sanction"] == "gather-ok"
+        and "ShardedDecodeModel" in (s.get("region") or "")]
+    assert decode_gathers == []
+    # ...replaced by the four allclose-sanctioned psum sites (assembly /
+    # Megatron block / 2bit wire / tied unembed)
+    decode_psums = [
+        s for s in sites
+        if s["path"] == "mxnet_tpu/serving/decode/sharding.py"
+        and s["kind"] == "psum" and s["sanction"] == "allclose-ok"]
+    assert len(decode_psums) == 4
 
 
-def test_decode_region_holds_the_zero_psum_budget():
+def test_decode_region_holds_the_megatron_psum_budget():
+    # the compute-parallel rewrite: the decode region's budget covers
+    # exactly its four static psum sites (assembly, Megatron block, 2bit
+    # wire, tied unembed) and not one gather
     _sites, budgets = sharding_lint.collective_map_entries(REPO)
     decode = [b for b in budgets
               if b["region"] == "ShardedDecodeModel._build_fn.body"]
     assert len(decode) == 1
-    assert decode[0]["budget"] == {"psum": 0}
-    assert decode[0]["counts"].get("psum", 0) == 0
+    assert decode[0]["budget"] == {"psum": 4}
+    assert decode[0]["counts"].get("psum", 0) == 4
+    assert decode[0]["counts"].get("all_gather", 0) == 0
 
 
 def test_collective_map_is_fresh_and_justified():
@@ -316,15 +329,18 @@ def test_decode_step_static_prediction_matches_runtime():
     finally:
         reset_collective_counters()
     predicted = sharding_lint.predict_decode_step_collectives(
-        model, pool_shape=pool_shape)
-    gathers = measured["all_gather"]
+        model, slots=S)
+    psums = measured["psum"]
     # exact agreement, calls AND bytes — the abstract sharding model is
     # the wire truth, not an estimate
-    assert gathers["calls"] == predicted["all_gather"]["calls"]
-    assert gathers["bytes"] == predicted["all_gather"]["bytes"]
-    # the bitwise gather-at-use region performs zero reductions (its
-    # budget(psum=0) is enforced statically; this is the runtime echo)
-    assert measured.get("psum", {"calls": 0})["calls"] == 0
+    assert psums["calls"] == predicted["psum"]["calls"]
+    assert psums["calls"] == 2 * model.num_layers + 2
+    assert psums["bytes"] == predicted["psum"]["bytes"]
+    # the compute-parallel kernels pay ZERO gathers: weights contract
+    # locally, the K/V pools never leave their head shard (the deleted
+    # gather tax; statically the region holds budget(psum=4))
+    assert measured.get("all_gather", {"calls": 0})["calls"] == 0
+    assert predicted["all_gather"] == {"calls": 0, "bytes": 0}
 
 
 # ---------------------------------------------------------------------------
@@ -442,10 +458,14 @@ def test_bench_artifact_carries_collective_bill():
                 "static_predicted", "static_matches_runtime"):
         assert key in coll, "collectives.%s missing from the artifact" % key
     assert coll["static_matches_runtime"] is True
-    assert coll["gathers_per_step"] > 0
-    assert coll["psums_per_step"] == 0
+    # the compute-parallel bill: zero gathers, 2L+2 psums per step
+    layers = report["workload"]["model"]["num_layers"]
+    assert coll["gathers_per_step"] == 0
+    assert coll["psums_per_step"] == 2 * layers + 2
     assert coll["collective_bytes_per_step"] > 0
-    assert coll["per_axis"]["all_gather"]["tp"]["calls"] \
-        == coll["gathers_per_step"]
-    assert coll["static_predicted"]["all_gather"]["calls"] \
-        == coll["gathers_per_step"]
+    assert coll["per_axis"]["psum"]["tp"]["calls"] \
+        == coll["psums_per_step"]
+    assert coll["static_predicted"]["psum"]["calls"] \
+        == coll["psums_per_step"]
+    assert coll["static_predicted"]["all_gather"] == \
+        {"calls": 0, "bytes": 0}

@@ -3,11 +3,14 @@
 
 Tier-1 gates for the sharded-decode tentpole:
 
-* **Bitwise tensor parallelism** — a ``ShardedDecodeModel(tp=2)`` engine
-  (head-sharded K/V pools, gather-at-use compute) serves greedy AND
-  seeded-sampled streams bitwise-equal to the single-device reference,
-  with zero steady-state recompiles and zero leaked blocks; prefix
-  caching, CoW, chunked prefill and speculative verify compose unchanged.
+* **Compute-parallel tensor parallelism** — a ``ShardedDecodeModel(tp=2)``
+  engine (head-sharded K/V pools, Megatron column/row-parallel matmuls,
+  zero gathers on the decode step) serves greedy AND seeded-sampled
+  streams token-identical to the single-device reference, with zero
+  steady-state recompiles and zero leaked blocks; prefix caching, CoW,
+  chunked prefill and speculative verify compose unchanged.  Logits are
+  allclose (not bitwise) to the reference: the per-block psum reduces
+  partial products in a different order than the unsharded matmul.
 * **Eager shape validation** — heads/tp divisibility, pool layout vs the
   mesh, device budget, and parameter PartitionSpecs all fail as
   ValueErrors naming BOTH extents (the ``shard_batch`` convention), never
@@ -28,7 +31,14 @@ Tier-1 gates for the sharded-decode tentpole:
   lands in the profiler dump.
 * **Chaos + bench** — the mxstress ``sharded_decode`` scenario holds over
   FAULT_SMOKE_SEEDS, and ``serve_bench --profile sharded-decode`` (smoke)
-  plus the committed BENCH_SHARDED_DECODE.json artifact meet the gates.
+  plus the committed BENCH_SHARDED_DECODE.json artifact meet the gates:
+  gather-free decode step (2L+2 psums, statically predicted) and tp=2
+  per-device throughput >= 0.8x of the equal-device tp=1 legs.
+* **Quantized wire** — opt-in ``wire="2bit"`` swaps the per-block psums
+  for the PR 10 2-bit codec (assembly + unembed psums stay exact fp32):
+  codec round-trip is bitwise at representable inputs, end-to-end logits
+  stay finite inside a documented loose envelope, and the counter bill
+  drops from 4-byte to 1-byte wire words on the block psums.
 """
 import json
 import os
@@ -637,17 +647,25 @@ def test_serve_bench_sharded_decode_smoke_artifact(tmp_path):
     for key in ("tp1", "tp2"):
         leg = report[key]
         assert leg["statuses"] == {"OK": streams}
-        assert leg["bitwise_equal_reference"] is True
+        assert leg["token_equal_reference"] is True
         assert leg["steady_state_recompiles"] == 0
         assert leg["kv_leaked_blocks"] == 0
     assert report["tp1"]["devices"] == report["tp2"]["devices"]
+    assert report["collectives"]["gathers_per_step"] == 0
+    assert report["collectives"]["static_matches_runtime"] is True
+    assert report["memory"]["static_matches_runtime"] is True
+    # NO relative-throughput assertion here: the smoke model's step is
+    # microseconds of math, so the ratio is scheduling noise under a
+    # loaded test host.  The committed artifact carries the >=0.8x gate.
 
 
 def test_committed_bench_sharded_decode_artifact_meets_gates():
     """The committed BENCH_SHARDED_DECODE.json must hold the PR's
-    acceptance numbers: both equal-device legs all-OK and bitwise-equal
-    to the single-device reference (greedy AND sampled streams), with
-    zero steady-state recompiles and zero leaked KV blocks."""
+    acceptance numbers: both equal-device legs all-OK and token-equal
+    to the single-device reference (greedy AND sampled streams), zero
+    steady-state recompiles, zero leaked KV blocks, a gather-free
+    decode-step collective bill (2L+2 psums, statically predicted),
+    and tp=2 per-device throughput at >= 0.8x the tp=1 legs."""
     path = os.path.join(REPO, "BENCH_SHARDED_DECODE.json")
     assert os.path.exists(path), "BENCH_SHARDED_DECODE.json not committed"
     report = json.load(open(path))
@@ -656,7 +674,7 @@ def test_committed_bench_sharded_decode_artifact_meets_gates():
     for key in ("tp1", "tp2"):
         leg = report[key]
         assert leg["statuses"] == {"OK": streams}
-        assert leg["bitwise_equal_reference"] is True
+        assert leg["token_equal_reference"] is True
         assert leg["steady_state_recompiles"] == 0
         assert leg["kv_leaked_blocks"] == 0
         assert leg["ttft_ms"]["p99"] >= leg["ttft_ms"]["p50"] > 0
@@ -665,3 +683,240 @@ def test_committed_bench_sharded_decode_artifact_meets_gates():
     assert report["tp1"]["engines"] == report["workload"]["tp"]
     assert report["tp2"]["engines"] == 1
     assert report["tp2"]["tp_degree"] == report["workload"]["tp"]
+    layers = report["workload"]["model"]["num_layers"]
+    coll = report["collectives"]
+    assert coll["gathers_per_step"] == 0
+    assert coll["psums_per_step"] == 2 * layers + 2
+    assert coll["static_matches_runtime"] is True
+    assert report["memory"]["static_matches_runtime"] is True
+    assert report["relative_tokens_per_s"] >= 0.8
+
+
+# ---------------------------------------------------------------------------
+# compute-parallel kernels: tp=4 parity, the allclose-logit envelope, and
+# the eager canonical-schema validation
+# ---------------------------------------------------------------------------
+
+_TP4_KW = dict(vocab_size=32, hidden=16, num_layers=1, num_heads=4,
+               max_len=48, seed=11)
+
+
+def test_tp4_streams_token_identical_greedy_and_sampled():
+    ref_eng = _engine(TinyCausalLM(**_TP4_KW), "tp4ref")
+    eng = _engine(ShardedDecodeModel(TinyCausalLM(**_TP4_KW), tp=4),
+                  "tp4sh")
+    try:
+        for kw in ({}, dict(_SAMPLE)):
+            for p in _PROMPTS:
+                want = ref_eng.generate_reference(p, 8, **kw).tolist()
+                s = eng.submit(list(p), 8, timeout_ms=30000, **kw)
+                assert s.result().status == OK
+                assert list(s.tokens()) == want
+        assert _leak(eng) == 0
+    finally:
+        ref_eng.stop()
+        eng.stop()
+
+
+def _prefill_logits(m, prompt, num_blocks=8, bs=4):
+    """Raw last-position prefill logits (the engine-internal call shape:
+    unwrapped jnp params and pools, one padded prompt row)."""
+    import jax.numpy as jnp
+    L = len(prompt)
+    shape = (m.num_layers, num_blocks, bs, m.num_heads, m.head_dim)
+    if hasattr(m, "zeros_pool"):
+        kp, vp = m.zeros_pool(shape)._data, m.zeros_pool(shape)._data
+    else:
+        kp = vp = jnp.zeros(shape, jnp.float32)
+    p = {n: a._data for n, a in m.param_dict().items()}
+    tokens = jnp.asarray([list(prompt)], jnp.int32)
+    length = jnp.asarray([L], jnp.int32)
+    table = jnp.arange((L + bs - 1) // bs, dtype=jnp.int32)[None]
+    logits, _, _ = m.prefill_fn(p, tokens, length, table, kp, vp)
+    return np.asarray(logits)[0]
+
+
+def test_sharded_logits_allclose_with_documented_root_cause(model,
+                                                            sh_model):
+    """The compute-parallel logits are allclose — NOT bitwise — to the
+    single-device reference.  Root cause: each Megatron half-block
+    reduces its row-parallel partial products with a psum, and the psum's
+    member-order summation associates the hidden-axis contraction
+    differently than the unsharded ``[S,H] @ [H,H]`` matmul; float
+    addition is not associative, so the last mantissa bits drift
+    (~1e-7 relative on the tiny model).  The serving bar is therefore
+    token identity — argmax and the seeded sampler ride far above that
+    noise — which the stream-level tests above pin bitwise."""
+    ref = _prefill_logits(model, _PROMPT)
+    got = _prefill_logits(sh_model, _PROMPT)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    assert int(np.argmax(got)) == int(np.argmax(ref))
+
+
+def test_wire_and_context_attention_validation_is_eager():
+    inner = TinyCausalLM(**_MODEL_KW)
+    with pytest.raises(ValueError, match="unknown wire '4bit'"):
+        ShardedDecodeModel(TinyCausalLM(**_MODEL_KW), tp=2, wire="4bit")
+    with pytest.raises(ValueError, match="wire_threshold\\s*> 0"):
+        ShardedDecodeModel(TinyCausalLM(**_MODEL_KW), tp=2, wire="2bit",
+                           wire_threshold=0.0)
+    inner.context_attention = "sp"
+    with pytest.raises(ValueError, match="head-local attention"):
+        ShardedDecodeModel(inner, tp=2)
+
+
+class _ParamOverride:
+    """Wrap a contract model but dictate its param_dict()."""
+
+    def __init__(self, inner, mutate):
+        self._inner = inner
+        self._mutate = mutate
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def param_dict(self):
+        params = dict(self._inner.param_dict())
+        self._mutate(params)
+        return params
+
+
+def test_canonical_schema_validation_is_eager():
+    import jax.numpy as jnp
+    from mxnet_tpu.ndarray import NDArray
+
+    def extra(params):
+        params["l0_bias"] = params["l0_wq"]
+
+    with pytest.raises(ValueError, match=r"unexpected \['l0_bias'\]"):
+        ShardedDecodeModel(_ParamOverride(TinyCausalLM(**_MODEL_KW),
+                                          extra), tp=2)
+
+    def wrong_shape(params):
+        params["pos"] = NDArray(jnp.zeros((4, 4), jnp.float32))
+
+    with pytest.raises(ValueError, match=r"'pos' has shape \(4, 4\)"):
+        ShardedDecodeModel(_ParamOverride(TinyCausalLM(**_MODEL_KW),
+                                          wrong_shape), tp=2)
+
+    from jax.sharding import PartitionSpec as P
+    inner = TinyCausalLM(**_MODEL_KW)
+    specs = dict(inner.partition_specs())
+    specs["l0_wo"] = P(None, "tp")          # column where row is required
+    with pytest.raises(ValueError,
+                       match="Megatron kernels require \\('tp',\\)"):
+        ShardedDecodeModel(_SpecOverride(inner, specs), tp=2)
+
+
+# ---------------------------------------------------------------------------
+# opt-in wire="2bit": codec exactness, accuracy envelope, wire-byte bill
+# ---------------------------------------------------------------------------
+
+def test_wire_2bit_psum_bitwise_at_representable_inputs():
+    """At inputs the codec represents exactly — every element in
+    ``{-thr, 0, +thr}`` with a power-of-two threshold — the quantized
+    psum is BITWISE equal to the exact fp32 psum: the ±1 int8 codes
+    reconstruct each member's contribution with zero residual."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.serving.decode import sharding as shd
+
+    thr = 0.25
+    geom = shd._Geometry(num_layers=1, num_heads=2, local_heads=1,
+                         head_dim=8, hidden=16, hidden_local=8,
+                         vocab_size=32, max_len=32, tp=2, gluon=False,
+                         wire="2bit", wire_threshold=thr)
+    mesh = decode_mesh(2)
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.choice([-thr, 0.0, thr], size=(2, 16)),
+                    jnp.float32)
+    quant = shard_map(lambda x: shd._psum_2bit(geom, x), mesh=mesh,
+                      in_specs=P("tp"), out_specs=P("tp"),
+                      check_rep=False)
+    exact = shard_map(lambda x: jax.lax.psum(x, "tp"), mesh=mesh,
+                      in_specs=P("tp"), out_specs=P("tp"),
+                      check_rep=False)
+    assert np.asarray(quant(y)).tobytes() == np.asarray(exact(y)).tobytes()
+
+
+def test_wire_2bit_envelope_and_wire_byte_reduction():
+    """End-to-end ``wire="2bit"`` serving accuracy + cost envelope:
+
+    * the decode step stays gather-free with the same ``2L+2`` psum
+      bill, but the two per-layer block psums carry 1-byte int8 codes —
+      the counter bytes drop below the exact-wire bill and match the
+      static predictor exactly;
+    * logits stay finite and inside a LOOSE documented envelope of the
+      exact-wire logits (the codec is lossy by design — sign information
+      at ±threshold only; this is an opt-in accuracy/bandwidth trade,
+      NOT token-identical serving);
+    * the assembly and unembed psums stay exact fp32 (predictor terms).
+    """
+    import jax.numpy as jnp
+    from mxnet_tpu.analysis.sharding_lint import (
+        predict_decode_step_collectives)
+    from mxnet_tpu.parallel.collectives import (collective_totals,
+                                                reset_collective_counters)
+
+    exact_m = ShardedDecodeModel(TinyCausalLM(**_MODEL_KW), tp=2)
+    wire_m = ShardedDecodeModel(TinyCausalLM(**_MODEL_KW), tp=2,
+                                wire="2bit", wire_threshold=0.05)
+    S, W, bs = 2, 2, 4
+    shape = (wire_m.num_layers, S * W + 1, bs, wire_m.num_heads,
+             wire_m.head_dim)
+    kp, vp = wire_m.zeros_pool(shape), wire_m.zeros_pool(shape)
+    p = {n: a._data for n, a in wire_m.param_dict().items()}
+    reset_collective_counters()
+    logits, _, _ = wire_m.decode_fn(p, jnp.zeros((S,), jnp.int32),
+                                    jnp.zeros((S,), jnp.int32),
+                                    jnp.zeros((S, W), jnp.int32),
+                                    kp._data, vp._data)
+    totals = collective_totals()
+    reset_collective_counters()
+    predicted = predict_decode_step_collectives(wire_m, slots=S)
+    exact_bill = predict_decode_step_collectives(exact_m, slots=S)
+    layers = wire_m.num_layers
+    assert totals.get("all_gather", {"calls": 0})["calls"] == 0
+    assert totals["psum"]["calls"] == 2 * layers + 2
+    assert totals["psum"]["calls"] == predicted["psum"]["calls"]
+    assert totals["psum"]["bytes"] == predicted["psum"]["bytes"]
+    # the two block psums shrink 4 bytes -> 1 byte per element; the
+    # assembly + unembed psums stay fp32, so the delta is exactly the
+    # block-psum elements times 3 bytes
+    hidden = wire_m.num_heads * wire_m.head_dim
+    assert (exact_bill["psum"]["bytes"] - predicted["psum"]["bytes"]
+            == 2 * layers * S * hidden * 3)
+
+    got = np.asarray(logits)
+    ref = np.asarray(exact_m.decode_fn(
+        p, jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+        jnp.zeros((S, W), jnp.int32),
+        exact_m.zeros_pool(shape)._data,
+        exact_m.zeros_pool(shape)._data)[0])
+    assert np.all(np.isfinite(got))
+    # documented loose envelope: the residual-free sign codec clamps
+    # each block-psum element to +-tp*threshold, so logit error is
+    # bounded but NOT small — this wire trades accuracy for bandwidth
+    assert float(np.max(np.abs(got - ref))) < 16.0
+
+
+def test_wire_2bit_streams_complete_ok():
+    """A wire="2bit" engine still serves: fixed shapes, zero recompiles
+    in steady state, zero leaks.  (Token identity is NOT claimed — the
+    codec is lossy; only the serving invariants hold.)"""
+    eng = _engine(ShardedDecodeModel(TinyCausalLM(**_MODEL_KW), tp=2,
+                                     wire="2bit"), "sh2bit")
+    try:
+        s = eng.submit(list(_PROMPT), 8, timeout_ms=30000)
+        assert s.result().status == OK
+        before = eng.stats_snapshot()["cache"]["recompiles"]
+        for p in _PROMPTS:
+            s = eng.submit(list(p), 8, timeout_ms=30000)
+            assert s.result().status == OK
+            assert all(0 <= t < eng.model.vocab_size for t in s.tokens())
+        assert eng.stats_snapshot()["cache"]["recompiles"] == before
+        assert _leak(eng) == 0
+    finally:
+        eng.stop()

@@ -36,17 +36,20 @@ Two load profiles:
   device budget: the same mixed prompt/output-length stream workload
   (with a seeded-sampling minority) through tp (default 2) unsharded
   engines splitting the streams round-robin, then through ONE
-  ``ShardedDecodeModel(tp=...)`` engine with head-sharded K/V pools
-  taking every stream — both legs consume the same number of devices.
-  Reports tok/s, TTFT p50/p99, per-leg device counts, the per-decode-step
-  collective bill (gathers/step, psums/step, bytes/step from the runtime
+  ``ShardedDecodeModel(tp=...)`` engine — head-sharded K/V pools,
+  compute-parallel Megatron kernels — taking every stream; both legs
+  consume the same number of devices.  Reports tok/s, TTFT p50/p99,
+  per-leg device counts, the per-decode-step collective bill
+  (gathers/step == 0, psums/step == 2L+2, bytes/step from the runtime
   counters in ``parallel.collectives``, cross-checked against the
   mxshard static prediction — docs/COLLECTIVE_MAP.md), and the hard
   correctness gates to a BENCH_SHARDED_DECODE.json artifact: every
   stream OK, zero steady-state recompiles, zero leaked KV blocks,
-  static collective prediction == runtime counters, and every OK stream
-  (greedy AND sampled) BITWISE-equal to the single-device reference on
-  both legs.
+  static collective/memory predictions == runtime counters, every OK
+  stream (greedy AND sampled) token-identical to the single-device
+  reference on both legs (tp1 bitwise outright; the sharded leg allclose
+  in logits under the psum reduction-order relaxation), and sharded
+  per-device throughput >= 0.8x of tp1.
 * ``--profile disagg`` — disaggregated prefill/decode tiers vs a
   colocated fleet at an EQUAL device budget, under OPEN-loop load: both
   legs replay the identical seeded Poisson arrival trace
@@ -602,9 +605,10 @@ def measure_decode_step_collectives(model_cfg, tp, block_size):
       shard_map body re-traces per call, so trace-time counts are
       per-step counts);
     * **static** — ``analysis.sharding_lint.predict_decode_step_collectives``
-      derived from the partition specs alone, no tracing.
+      derived from the compute-parallel kernel structure alone, no
+      tracing (``2L + 2`` psums, zero gathers).
 
-    ``static_matches_runtime`` (calls AND bytes) is a
+    ``static_matches_runtime`` (calls AND bytes, both kinds) is a
     ``_sharded_decode_ok`` exit gate: the lint's abstract sharding model
     must agree with what the wires actually carry."""
     import jax.numpy as jnp
@@ -630,8 +634,7 @@ def measure_decode_step_collectives(model_cfg, tp, block_size):
     per_axis = collective_counters()
     totals = collective_totals()
     reset_collective_counters()
-    predicted = predict_decode_step_collectives(model,
-                                                pool_shape=pool_shape)
+    predicted = predict_decode_step_collectives(model, slots=S)
     gathers = totals.get("all_gather", {"calls": 0, "bytes": 0})
     psums = totals.get("psum", {"calls": 0, "bytes": 0})
     return {
@@ -645,7 +648,8 @@ def measure_decode_step_collectives(model_cfg, tp, block_size):
         "static_matches_runtime": (
             predicted["all_gather"]["calls"] == gathers["calls"]
             and predicted["all_gather"]["bytes"] == gathers["bytes"]
-            and predicted["psum"]["calls"] == psums["calls"]),
+            and predicted["psum"]["calls"] == psums["calls"]
+            and predicted["psum"]["bytes"] == psums["bytes"]),
     }
 
 
@@ -658,7 +662,9 @@ def measure_decode_step_peak_bytes(model_cfg, tp, block_size):
       call under ``track_region("bench:decode-step")`` (the collective
       wrappers record their output temps into the active region);
     * **static** — ``analysis.memory_lint.predict_decode_step_peak_bytes``
-      derived from the partition specs and pool shape alone, no tracing.
+      derived from the compute-parallel kernel structure alone, no
+      tracing (the psum-output temps are the only collective temps a
+      step materializes — the gathered-weight/pool temps are gone).
 
     ``static_matches_runtime`` (exact bytes) is a ``_sharded_decode_ok``
     exit gate: the lint's abstract footprint model must agree with what
@@ -689,8 +695,7 @@ def measure_decode_step_peak_bytes(model_cfg, tp, block_size):
                                    {"temps": 0, "peak_bytes": 0,
                                     "live_bytes": 0})
     reset_memory_counters()
-    predicted = predict_decode_step_peak_bytes(model,
-                                               pool_shape=pool_shape)
+    predicted = predict_decode_step_peak_bytes(model, slots=S)
     return {
         "region": "bench:decode-step",
         "temps_per_step": region["temps"],
@@ -709,14 +714,17 @@ def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
     The ``tp1`` leg runs ``tp`` independent single-device engines and
     splits the stream list round-robin across them; the ``tp2`` leg runs
     ONE engine over ``ShardedDecodeModel(tp=tp)`` — head-sharded K/V
-    pools, gathered compute — and takes every stream.  Both legs consume
-    exactly ``tp`` devices, see the identical seeded workload (mixed
-    prompt and output lengths, every 4th stream seeded-sampled), and are
-    held to the same bar: every stream's tokens BITWISE-equal to the
-    single-device reference for its (prompt, budget, sampling) triple.
-    The sharded leg's throughput is not expected to win on virtual CPU
-    devices (the all-gathers are real, the FLOPs savings are not); the
-    artifact's value is the correctness gates riding a real workload."""
+    pools, compute-parallel Megatron kernels — and takes every stream.
+    Both legs consume exactly ``tp`` devices, see the identical seeded
+    workload (mixed prompt and output lengths, every 4th stream
+    seeded-sampled), and are held to the same bar: every stream's tokens
+    TOKEN-identical to the single-device reference for its (prompt,
+    budget, sampling) triple (the tp1 leg is bitwise outright; the
+    sharded leg's logits are allclose under the documented psum
+    reduction-order relaxation, and its greedy/sampled token streams
+    must still match exactly).  With the gather tax gone the sharded
+    leg's per-device throughput is gated at >= 0.8x of tp1 — each device
+    runs 1/tp of the FLOPs and pays ``2L + 2`` small psums per step."""
     from mxnet_tpu.serving.decode import (DecodeEngine, ShardedDecodeModel,
                                           TinyCausalLM)
 
@@ -732,7 +740,7 @@ def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
     sampling = [{"temperature": 0.8, "top_k": 8, "seed": 2000 + i}
                 if i % 4 == 3 else {} for i in range(streams)]
 
-    # single-device references: the bitwise bar for BOTH legs
+    # single-device references: the token-identity bar for BOTH legs
     ref_eng = DecodeEngine(TinyCausalLM(**model_cfg), name="bench-shard-ref",
                            max_slots=slots, block_size=block_size,
                            max_prompt_len=max_prompt,
@@ -771,14 +779,14 @@ def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
         tokens = 0
         ttfts = []
         statuses = {}
-        bitwise = True
+        token_equal = True
         for i, h in enumerate(handles):
             h.wait()
             statuses[h.status] = statuses.get(h.status, 0) + 1
             toks = list(h.tokens())
             tokens += len(toks)
             if h.status == "OK" and toks != refs[i]:
-                bitwise = False
+                token_equal = False
             if h.ttft_ms is not None:
                 ttfts.append(h.ttft_ms)
         wall = time.monotonic() - t0
@@ -808,7 +816,7 @@ def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
             "tokens_per_s": round(tokens / wall, 1) if wall else 0.0,
             "ttft_ms": pcts,
             "statuses": statuses,
-            "bitwise_equal_reference": bitwise,
+            "token_equal_reference": token_equal,
             "steady_state_recompiles": recompiles,
             "kv_peak_blocks": peak,
             "kv_leaked_blocks": leaked,
@@ -842,19 +850,29 @@ def run_sharded_decode_bench(streams, slots, block_size, max_prompt,
     }
 
 
-def _sharded_decode_ok(report):
+def _sharded_decode_ok(report, smoke=False):
     """Exit gate for the sharded-decode profile: on BOTH equal-device
     legs every stream finishes OK, every OK stream (greedy and sampled)
-    is bitwise-equal to the single-device reference, and zero
+    is token-identical to the single-device reference, and zero
     steady-state recompiles / leaked KV blocks; the legs must actually
     consume the same device count and the sharded leg must report the
     declared tp_degree.  The static collective AND memory models must
     both match the measured per-step reality exactly (calls, bytes, and
-    peak-bytes), and the decode-step accounting region must drain."""
+    peak-bytes), the decode step must pay ZERO gathers, the decode-step
+    accounting region must drain, and the compute-parallel leg must hold
+    >= 0.8x the per-device throughput of tp1 (the gather-tax deletion
+    gate; the PR 15 gather-at-use wrapper measured 0.494x-0.825x).
+
+    The throughput ratio is waived under ``--smoke``: the smoke model is
+    a handful of microseconds of math per step, so the ratio there
+    measures host-process scheduling noise, not the collective bill.
+    Committed artifacts are produced by a full run and carry the gate
+    (test_committed_bench_sharded_decode_artifact_meets_gates re-checks
+    it on the committed JSON)."""
     for leg in (report["tp1"], report["tp2"]):
         if set(leg["statuses"]) != {"OK"}:
             return False
-        if not leg["bitwise_equal_reference"]:
+        if not leg["token_equal_reference"]:
             return False
         if leg["steady_state_recompiles"] != 0 or leg["kv_leaked_blocks"]:
             return False
@@ -864,10 +882,14 @@ def _sharded_decode_ok(report):
         return False
     if not report["collectives"]["static_matches_runtime"]:
         return False
+    if report["collectives"]["gathers_per_step"] != 0:
+        return False
     mem = report["memory"]
     if not mem["static_matches_runtime"]:
         return False
     if mem["runtime_peak_bytes"] <= 0 or mem["live_bytes_after"] != 0:
+        return False
+    if not smoke and report["relative_tokens_per_s"] < 0.8:
         return False
     return True
 
@@ -1180,10 +1202,10 @@ def _main_sharded_decode(args, ap):
     for key in ("tp1", "tp2"):
         leg = report[key]
         print("%s: %d engine(s) x tp=%d (%d device(s))  %s tok/s  "
-              "ttft p50/p99: %s/%s ms  bitwise: %s"
+              "ttft p50/p99: %s/%s ms  token-equal: %s"
               % (key, leg["engines"], leg["tp_degree"], leg["devices"],
                  leg["tokens_per_s"], leg["ttft_ms"]["p50"],
-                 leg["ttft_ms"]["p99"], leg["bitwise_equal_reference"]))
+                 leg["ttft_ms"]["p99"], leg["token_equal_reference"]))
     coll = report["collectives"]
     print("collectives/step: %d gather(s), %d psum(s), %d byte(s)  "
           "static==runtime: %s"
@@ -1197,7 +1219,7 @@ def _main_sharded_decode(args, ap):
              mem["static_matches_runtime"]))
     print("relative: %sx  wrote %s"
           % (report["relative_tokens_per_s"], args.out))
-    return 0 if _sharded_decode_ok(report) else 1
+    return 0 if _sharded_decode_ok(report, smoke=args.smoke) else 1
 
 
 def _main_prefix_spec(args, ap):
