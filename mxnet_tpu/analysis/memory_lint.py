@@ -87,7 +87,7 @@ __all__ = ["run", "analyze_source", "memory_sites", "source_memory_sites",
 
 #: repo-relative path prefixes the pass scans (and --since triggers on)
 SCAN_PREFIXES = ("mxnet_tpu/parallel/", "mxnet_tpu/module/",
-                 "mxnet_tpu/serving/decode/")
+                 "mxnet_tpu/serving/decode/", "mxnet_tpu/serving/deploy.py")
 #: the wrapper/instrumentation module — definitions, not uses
 _WRAPPER_MODULE = "mxnet_tpu/parallel/collectives.py"
 

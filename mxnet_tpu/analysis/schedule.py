@@ -111,15 +111,25 @@ Invariants asserted (per seed)
   the mem lint pass) mirrors it exactly in bytes, its region peak stays
   under the declared admission worst case, and ``peak_used`` never
   exceeds physical capacity (see ``mem_storm``).
+* **rolling-deployment storm** (``deploy``) — each seed publishes the
+  next checkpoint epoch with DIFFERENT weights and either rolls it
+  across the live fleet under client streams (sometimes racing a
+  replica kill) or crashes the DeploymentController at a seeded
+  ``deploy.*`` fault point: a killed controller always leaves the
+  fleet HEALTHY on the OLD generation, every stream finishes against
+  exactly one weight generation (bitwise vs that flavor's reference),
+  the ledger conserves, KV pools drain whole, and post-swap probes
+  never recompile (see ``deploy_storm``).
 
 ``tools/mxstress.py`` is the CLI front end; ``tests/test_concurrency.py``
 wires the smoke configuration (25 fixed seeds, bounded sizes) into tier-1
 and ``tests/test_faults.py``/``tests/test_fleet.py``/
 ``tests/test_decode_fleet.py``/``tests/test_decode_prefix.py``/
-``tests/test_sharded_decode.py``/``tests/test_disagg.py`` gate the
-fault-driven scenarios (``faults``, ``crash``, ``fleet``,
-``decode_fleet``, ``decode_prefix``, ``sharded_decode``, ``disagg``) on
-the smaller ``FAULT_SMOKE_SEEDS`` set.
+``tests/test_sharded_decode.py``/``tests/test_disagg.py``/
+``tests/test_deploy.py`` gate the fault-driven scenarios (``faults``,
+``crash``, ``fleet``, ``decode_fleet``, ``decode_prefix``,
+``sharded_decode``, ``disagg``, ``deploy``) on the smaller
+``FAULT_SMOKE_SEEDS`` set.
 """
 from __future__ import annotations
 
@@ -2707,12 +2717,335 @@ def mem_storm(seed, n_threads=4, rounds=3):
 
 
 # ---------------------------------------------------------------------------
+# scenario 15: generation-fenced rolling weight deployment (deploy)
+# ---------------------------------------------------------------------------
+
+_DEPLOY_PROMPT = (3, 1, 2)
+_DEPLOY_MAX_NEW = 5
+_DEPLOY_WSEEDS = {"A": 21, "B": 22}   # weight seed per generation flavor
+_DEPLOY_SITES = ("deploy.resolve", "deploy.warmup", "deploy.cutover",
+                 "deploy.commit")
+_DEPLOY_MODEL_KW = dict(vocab_size=24, hidden=16, num_layers=1, num_heads=2,
+                        max_len=24)
+_DEPLOY_ENGINE_KW = dict(max_slots=2, block_size=4, num_blocks=24,
+                         max_prompt_len=12, max_new_tokens=_DEPLOY_MAX_NEW,
+                         max_queue=8, breaker_threshold=4,
+                         breaker_backoff_ms=15.0)
+
+
+def _deploy_save(prefix, epoch, flavor):
+    """Publish TinyCausalLM weights of ``flavor`` as checkpoint ``epoch``
+    — manifest-committed, exactly like a trainer's ``do_checkpoint``."""
+    from .. import model as model_mod
+    from .. import symbol as sym_mod
+    from ..serving.decode import TinyCausalLM
+    lm = TinyCausalLM(seed=_DEPLOY_WSEEDS[flavor], **_DEPLOY_MODEL_KW)
+    model_mod.save_checkpoint(prefix, epoch, sym_mod.Variable("data"),
+                              dict(lm._params), {})
+
+
+def _deploy_builder(srv_name, arg_params, aux_params, generation):
+    """DeploymentController engine builder: checkpoint params -> warmed
+    generation-tagged engine."""
+    from ..serving.decode import DecodeEngine, TinyCausalLM
+    lm = TinyCausalLM(params=arg_params, **_DEPLOY_MODEL_KW)
+    return DecodeEngine(lm, name=srv_name, generation=generation,
+                        **_DEPLOY_ENGINE_KW)
+
+
+def _build_deploy_fixture():
+    """-> (router, "dplm", prefix, refs, state).
+
+    A 2-replica decode fleet first deployed at checkpoint epoch 1
+    (weight flavor "A").  Each seed's storm publishes the next epoch
+    with the OTHER flavor's weights and rolls it live — or crashes the
+    controller mid-roll at a seeded fault point.  ``refs`` holds the
+    per-flavor greedy reference, so "every stream finishes against ONE
+    weight generation" is checkable bitwise: any token list that is
+    neither flavor's reference (nor a strict prefix of one) is torn or
+    mixed-generation output."""
+    import os
+    import tempfile
+    from ..serving.decode import DecodeEngine, TinyCausalLM
+    from ..serving.deploy import DeploymentController
+    from ..serving.fleet import FleetRouter
+
+    tmpdir = tempfile.mkdtemp(prefix="mxstress-deploy-")
+    prefix = os.path.join(tmpdir, "ck")
+    _deploy_save(prefix, 1, "A")
+    refs = {}
+    for flavor, wseed in sorted(_DEPLOY_WSEEDS.items()):
+        eng = DecodeEngine(TinyCausalLM(seed=wseed, **_DEPLOY_MODEL_KW),
+                           name="dpref-%s" % flavor, **_DEPLOY_ENGINE_KW)
+        try:
+            refs[flavor] = eng.generate_reference(
+                list(_DEPLOY_PROMPT), _DEPLOY_MAX_NEW).tolist()
+        finally:
+            eng.stop()
+    if refs["A"] == refs["B"]:
+        raise RuntimeError("deploy fixture weight seeds produce identical "
+                           "outputs; the bitwise generation check is vacuous")
+    router = FleetRouter(replicas=2, failover_budget=2)
+    router.load_decode(
+        "dplm",
+        lambda n: DecodeEngine(TinyCausalLM(seed=_DEPLOY_WSEEDS["A"],
+                                            **_DEPLOY_MODEL_KW),
+                               name=n, **_DEPLOY_ENGINE_KW),
+        replicas=2)
+    ctl = DeploymentController(router, prefix,
+                               engines={"dplm": _deploy_builder})
+    report = ctl.poll()
+    if report is None or report["status"] != "deployed":
+        raise RuntimeError("deploy fixture: initial roll to epoch 1 "
+                           "failed: %r" % (report,))
+    state = {"dir": tmpdir, "epoch": 1, "flavors": {1: "A"}}
+    return (router, "dplm", prefix, refs, state)
+
+
+def deploy_storm(router, name, prefix, refs, state, seed):
+    """Rolling-deployment storm (the ``deploy`` scenario).
+
+    Each seed publishes the next checkpoint epoch carrying the OTHER
+    weight flavor, then either KILLS the controller at a seeded
+    ``deploy.*`` fault point (even seeds, site rotating over all four)
+    or rolls the swap for real under concurrent client streams — some
+    seeds racing a ``kill_replica`` against the controller.  Invariants:
+
+    * **crash-safe** — a controller killed at ANY fault point leaves the
+      fleet HEALTHY and serving the OLD generation bitwise, with no
+      staging debris after ``recover()``; the queued generation then
+      deploys cleanly;
+    * **single-generation streams** — every OK stream's tokens equal ONE
+      flavor's greedy reference exactly; TIMEOUT/UNAVAILABLE partials
+      are strict prefixes of one flavor (never an interleaving);
+    * **conservation** — the router ledger settles to ``requests == ok +
+      timeouts + errors + unavailable`` with zero ERROR streams, and
+      every surviving engine's KV pool drains whole;
+    * **flexible verdict under replica kill** — a kill racing the swap
+      may abort it or let it finish; either way the fleet re-converges
+      on ONE consistent generation matching the controller's report and
+      probes bitwise on that generation's reference;
+    * **zero steady-state recompiles** — post-swap probes ride warmed
+      signatures on every surviving engine.
+    """
+    from .. import faults
+    from ..base import MXNetError
+    from ..serving import server as srv
+    from ..serving.deploy import DeploymentController
+    from ..serving.health import HEALTHY
+
+    violations = []
+    rng = random.Random(seed ^ 0xDE7)
+
+    def cur_epoch():
+        return router.stats()["deploy"]["generation"]
+
+    def probe(flavor, label):
+        stream = router.submit_stream(name, list(_DEPLOY_PROMPT),
+                                      max_new_tokens=_DEPLOY_MAX_NEW)
+        if not stream.wait(_JOIN_TIMEOUT_S):
+            violations.append("deploy: %s probe never terminated" % label)
+            return
+        status, tokens, _, _, err = stream.snapshot()
+        if status != srv.OK or list(tokens) != refs[flavor]:
+            violations.append(
+                "deploy: %s probe ended %r tokens %r != flavor-%s "
+                "reference %r (%r)" % (label, status, list(tokens),
+                                       flavor, refs[flavor], err))
+
+    old_epoch = cur_epoch()
+    old_flavor = state["flavors"][old_epoch]
+    new_flavor = "B" if old_flavor == "A" else "A"
+    state["epoch"] += 1
+    new_epoch = state["epoch"]
+    state["flavors"][new_epoch] = new_flavor
+    _deploy_save(prefix, new_epoch, new_flavor)
+    ctl = DeploymentController(router, prefix,
+                               engines={name: _deploy_builder})
+
+    if seed % 2 == 0:
+        # kill the controller at a seeded fault point: the fleet must
+        # keep serving the OLD generation as if nothing happened
+        site = _DEPLOY_SITES[(seed // 2) % len(_DEPLOY_SITES)]
+        plan = faults.FaultPlan(seed).add(site, kind="crash", times=1)
+        crashed = False
+        try:
+            with faults.plan(plan):
+                ctl.poll()
+        except faults.SimulatedCrash:
+            crashed = True
+        if not crashed:
+            violations.append("deploy: planted crash at %s never fired"
+                              % site)
+        ctl = DeploymentController(router, prefix,
+                                   engines={name: _deploy_builder})
+        ctl.recover()
+        if cur_epoch() != old_epoch:
+            violations.append("deploy: crash at %s left generation %r "
+                              "(want old %r)"
+                              % (site, cur_epoch(), old_epoch))
+        if router.health() != HEALTHY:
+            violations.append("deploy: fleet %r (not HEALTHY) after a "
+                              "crash at %s" % (router.health(), site))
+        st = router.stats()["deploy"]
+        if st["in_progress"] is not None or st["retiring"]:
+            violations.append("deploy: staging/retiring debris after "
+                              "recover() from a crash at %s: %r"
+                              % (site, st))
+        probe(old_flavor, "post-crash(%s)" % site)
+
+    # the swap itself, under concurrent client streams — and, on some odd
+    # seeds, a replica kill racing the controller mid-swap.  Settle the
+    # ledger first so a probe's late terminal hook can't straddle the
+    # conservation window.
+    settle_until = time.monotonic() + 5.0
+    while time.monotonic() < settle_until:
+        snap = router.decode_stats.snapshot()
+        if snap["requests"] == (snap["ok"] + snap["timeouts"]
+                                + snap["errors"] + snap["unavailable"]):
+            break
+        time.sleep(0.002)
+    before = router.decode_stats.snapshot()
+    kill_mode = seed % 2 == 1 and rng.random() < 0.4
+    results, swap_report, swap_error, killed = [], [], [], []
+
+    def clients():
+        for i in range(4):
+            slow = (lambda t: time.sleep(0.004)) if i % 2 == 0 else None
+            results.append(router.submit_stream(
+                name, list(_DEPLOY_PROMPT),
+                max_new_tokens=_DEPLOY_MAX_NEW, on_token=slow))
+            time.sleep(0.002)
+        for stream in results:
+            if not stream.wait(_JOIN_TIMEOUT_S):
+                violations.append("deploy: client stream never terminated")
+
+    def swapper():
+        try:
+            swap_report.append(ctl.poll())
+        except MXNetError as exc:
+            swap_error.append(str(exc))   # aborted by a racing kill: legal
+
+    def killer():
+        time.sleep(rng.random() * 0.05)
+        live = [rid for rid, st in sorted(router.replicas().items())
+                if st == "LIVE"]
+        if len(live) >= 2:
+            rid = live[rng.randrange(len(live))]
+            router.kill_replica(rid)
+            killed.append(rid)
+
+    workers = [clients, swapper]
+    if kill_mode:
+        workers.append(killer)
+    violations.extend(_spawn(workers))
+
+    # repair + debris sweep, then the fleet must sit on ONE generation
+    if killed:
+        router.add_replica()
+    DeploymentController(router, prefix,
+                         engines={name: _deploy_builder}).recover()
+    if not router.wait_converged(timeout_s=10.0):
+        violations.append("deploy: placement never re-converged: %r"
+                          % router.stats()["decode_models"])
+    final = cur_epoch()
+    if final not in (old_epoch, new_epoch):
+        violations.append("deploy: fleet on unexpected generation %r "
+                          "(want %r or %r)" % (final, old_epoch, new_epoch))
+    report = swap_report[0] if swap_report else None
+    if report is not None and report["status"] == "deployed" \
+            and final != new_epoch:
+        violations.append("deploy: controller reported 'deployed' to %r "
+                          "but the fleet serves %r" % (new_epoch, final))
+    if report is None and not swap_error and not killed:
+        violations.append("deploy: swap neither reported nor errored "
+                          "with no kill in play")
+
+    # single-generation token integrity: OK == one flavor's reference
+    # bitwise; partials are strict prefixes of one flavor
+    for stream in results:
+        status, tokens, _, _, _err = stream.snapshot()
+        toks = list(tokens)
+        if status == srv.OK:
+            if toks != refs[old_flavor] and toks != refs[new_flavor]:
+                violations.append("deploy: torn/mixed-generation OK "
+                                  "stream: %r (refs %r / %r)"
+                                  % (toks, refs[old_flavor],
+                                     refs[new_flavor]))
+        elif status in (srv.TIMEOUT, srv.UNAVAILABLE):
+            if toks != refs[old_flavor][:len(toks)] \
+                    and toks != refs[new_flavor][:len(toks)]:
+                violations.append("deploy: contaminated %s partial: %r"
+                                  % (status, toks))
+        elif status == srv.OVERLOADED:
+            if toks:
+                violations.append("deploy: shed stream carries %d "
+                                  "token(s)" % len(toks))
+        elif status is not None:
+            violations.append("deploy: stream ended %r" % status)
+
+    # conservation on the router ledger (late terminal hooks settle)
+    keys = ("requests", "ok", "timeouts", "errors", "unavailable")
+    settle_until = time.monotonic() + 5.0
+    while True:
+        after = router.decode_stats.snapshot()
+        d = {k: after[k] - before[k] for k in keys}
+        terminal_sum = (d["ok"] + d["timeouts"] + d["errors"]
+                        + d["unavailable"])
+        if d["requests"] == terminal_sum \
+                or time.monotonic() >= settle_until:
+            break
+        time.sleep(0.005)
+    if d["requests"] != terminal_sum:
+        violations.append("deploy: lost streams across the swap: %d "
+                          "admitted, %d terminal"
+                          % (d["requests"], terminal_sum))
+    if d["errors"]:
+        violations.append("deploy: %d ERROR stream(s) with no faults "
+                          "injected" % d["errors"])
+
+    # KV pools whole on every surviving engine
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        snaps = router.stats()["engines"].get(name, {})
+        if all(s["kv"]["used"] == 0 and s["kv"]["reserved"] == 0
+               and s["kv"]["live_sequences"] == 0 for s in snaps.values()):
+            break
+        time.sleep(0.005)
+    snaps = router.stats()["engines"].get(name, {})
+    for rid, s in sorted(snaps.items()):
+        kv = s["kv"]
+        if kv["used"] != 0 or kv["reserved"] != 0 \
+                or kv["live_sequences"] != 0:
+            violations.append("deploy: KV pool not whole on %s: %r"
+                              % (rid, {k: kv[k] for k in
+                                       ("used", "reserved",
+                                        "live_sequences")}))
+        if kv["allocated_total"] != kv["freed_total"]:
+            violations.append("deploy: KV leak on %s: allocated %d != "
+                              "freed %d" % (rid, kv["allocated_total"],
+                                            kv["freed_total"]))
+
+    # post-swap probe on the committed generation, then zero recompiles
+    final_flavor = state["flavors"][final]
+    recomp0 = {rid: s["cache"]["recompiles"]
+               for rid, s in sorted(snaps.items())}
+    probe(final_flavor, "post-swap")
+    for rid, s in sorted(router.stats()["engines"].get(name, {}).items()):
+        if rid in recomp0 and s["cache"]["recompiles"] != recomp0[rid]:
+            violations.append("deploy: steady-state recompile on %s: "
+                              "%d -> %d" % (rid, recomp0[rid],
+                                            s["cache"]["recompiles"]))
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
 SCENARIOS = ("serving", "registry", "cache", "bulk", "feed", "faults",
              "crash", "decode", "fleet", "decode_fleet", "decode_prefix",
-             "sharded_decode", "disagg", "mem")
+             "sharded_decode", "disagg", "mem", "deploy")
 
 
 def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
@@ -2746,6 +3079,8 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                           if "sharded_decode" in scenarios else None)
         disagg_fixture = (_build_disagg_fixture()
                           if "disagg" in scenarios else None)
+        deploy_fixture = (_build_deploy_fixture()
+                          if "deploy" in scenarios else None)
         try:
             for seed in seeds:
                 sched.reseed(seed)
@@ -2800,6 +3135,11 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                         disagg_fixture[4], seed)
                 if "mem" in scenarios:
                     per_seed["mem"] = mem_storm(seed)
+                if deploy_fixture is not None:
+                    per_seed["deploy"] = deploy_storm(
+                        deploy_fixture[0], deploy_fixture[1],
+                        deploy_fixture[2], deploy_fixture[3],
+                        deploy_fixture[4], seed)
                 n = sum(len(v) for v in per_seed.values())
                 report["seeds"][seed] = per_seed
                 report["violations"] += n
@@ -2823,6 +3163,10 @@ def stress(seeds=SMOKE_SEEDS, scenarios=SCENARIOS, p_preempt=0.25,
                 dshard_fixture[0].stop()
             if disagg_fixture is not None:
                 disagg_fixture[0].stop()
+            if deploy_fixture is not None:
+                deploy_fixture[0].stop()
+                import shutil
+                shutil.rmtree(deploy_fixture[4]["dir"], ignore_errors=True)
     report["preemptions"] = sched.preemptions
     report["elapsed_s"] = time.monotonic() - t0
     return report

@@ -77,7 +77,7 @@ __all__ = ["run", "analyze_source", "collective_sites",
 
 #: repo-relative path prefixes the pass scans (and --since triggers on)
 SCAN_PREFIXES = ("mxnet_tpu/parallel/", "mxnet_tpu/serving/decode/",
-                 "mxnet_tpu/serving/disagg/")
+                 "mxnet_tpu/serving/disagg/", "mxnet_tpu/serving/deploy.py")
 #: the wrapper/instrumentation module — definitions, not uses
 _WRAPPER_MODULE = "mxnet_tpu/parallel/collectives.py"
 #: paths on the bitwise-gated serving contract (SPD005)

@@ -82,6 +82,13 @@ KNOWN_SITES = frozenset({
     # (exceptionally) catches SimulatedCrash at this one site, marks the
     # replica DEAD, and fails the request over; see FleetRouter.predict.
     "fleet.replica",
+    # rolling deployment (serving/deploy.py): the controller's swap
+    # pipeline.  A "crash" at any of these models the CONTROLLER dying
+    # mid-swap; the fleet must keep serving the old generation.
+    "deploy.resolve",         # before loading the resolved checkpoint
+    "deploy.warmup",          # before staging one (name, replica) copy
+    "deploy.cutover",         # before fencing the old placements
+    "deploy.commit",          # before the atomic routing flip
 })
 
 

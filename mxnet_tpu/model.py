@@ -252,3 +252,87 @@ def latest_complete_checkpoint(prefix, allow_unverified=False):
         except Exception:
             continue
     return None
+
+
+def prune_checkpoints(prefix, keep_last=2):
+    """Retention + crash-debris GC for a manifest checkpoint prefix.
+
+    Keeps the newest ``keep_last`` COMPLETE checkpoints (manifest entries
+    whose files all verify by content hash) and removes everything the
+    manifest has superseded: older entries' files, torn/partial older
+    entries, and orphaned ``util.write_atomic`` tmp files
+    (``<path>.tmp-<pid>-<tid>``) left behind by killed writers.
+
+    Safety rules, in order:
+
+    * the newest complete entry is NEVER touched (``keep_last`` is clamped
+      to >= 1) — a prune racing a deployment watcher cannot delete the
+      generation about to be served;
+    * manifest entries NEWER than the newest complete epoch are left alone
+      even when torn — that is what an in-progress ``save_checkpoint`` on
+      another process looks like mid-write;
+    * a file is deleted only when NO kept entry records it — the shared
+      ``prefix-symbol.json`` every epoch lists survives any prune that
+      keeps at least one entry;
+    * the manifest rewrite (atomic, like every write here) drops the pruned
+      entries FIRST, so a crash mid-prune leaves a manifest that only
+      points at files the prune had not yet removed — readers skip any
+      half-removed entry via the hash check, exactly like a torn save.
+
+    Returns ``{"kept": [epochs], "pruned": [epochs], "removed_files": [...],
+    "removed_tmp": [...]}``.
+    """
+    import glob
+    import json
+    import os
+    from .util import write_atomic
+    keep_last = max(1, int(keep_last))
+    report = {"kept": [], "pruned": [], "removed_files": [],
+              "removed_tmp": []}
+    manifest = load_manifest(prefix)
+    if manifest is not None:
+        entries = manifest["checkpoints"]
+        epochs = sorted((int(e) for e in entries), reverse=True)
+        complete = [e for e in epochs
+                    if _checkpoint_intact(entries[str(e)])]
+        kept = set(complete[:keep_last])
+        if complete:
+            newest_complete = complete[0]
+            # everything strictly older than the newest complete epoch is
+            # superseded; newer torn entries may be a save in progress
+            pruned = [e for e in epochs
+                      if e < newest_complete and e not in kept]
+        else:
+            pruned = []
+        if pruned:
+            keep_files = set()
+            for e in epochs:
+                if e not in pruned:
+                    keep_files.update(entries[str(e)].get("files", {}))
+            remove_files = set()
+            for e in pruned:
+                remove_files.update(entries[str(e)].get("files", {}))
+            remove_files -= keep_files
+            for e in pruned:
+                del entries[str(e)]
+            write_atomic(_manifest_path(prefix),
+                         json.dumps(manifest, indent=1, sort_keys=True))
+            for path in sorted(remove_files):
+                try:
+                    os.remove(path)
+                    report["removed_files"].append(path)
+                except OSError:
+                    pass
+        report["kept"] = sorted(kept)
+        report["pruned"] = sorted(pruned)
+    # write_atomic debris: "<path>.tmp-<pid>-<tid>" named after a target
+    # under this prefix.  Any such file is garbage by construction — a
+    # completed write_atomic os.replace()s its tmp away, so one still on
+    # disk means its writer died before commit.
+    for path in sorted(glob.glob("%s*.tmp-*" % glob.escape(prefix))):
+        try:
+            os.remove(path)
+            report["removed_tmp"].append(path)
+        except OSError:
+            pass
+    return report

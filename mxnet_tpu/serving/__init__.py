@@ -20,12 +20,15 @@ from .server import (ModelServer, InferenceResult,
                      UNAVAILABLE)
 from .fleet import FleetRouter, FleetStats, DecodeFleetStats
 from . import decode
+from . import deploy
 from . import disagg
 from . import traffic
+from .deploy import DeploymentController
 
 __all__ = ["ModelServer", "InferenceResult", "BucketLadder", "Request",
            "MicroBatcher", "ModelRegistry", "ServableModel", "shape_key",
-           "CircuitBreaker", "HEALTHY", "DEGRADED", "decode", "disagg",
-           "traffic", "FleetRouter", "FleetStats", "DecodeFleetStats",
+           "CircuitBreaker", "HEALTHY", "DEGRADED", "decode", "deploy",
+           "disagg", "traffic", "DeploymentController",
+           "FleetRouter", "FleetStats", "DecodeFleetStats",
            "OK", "TIMEOUT", "OVERLOADED", "INVALID_INPUT", "ERROR",
            "UNAVAILABLE"]

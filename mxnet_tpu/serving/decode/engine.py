@@ -299,11 +299,17 @@ class DecodeEngine:
                  warmup=True, breaker_threshold=5, breaker_backoff_ms=50.0,
                  breaker_max_backoff_ms=2000.0, prefill_chunk=None,
                  prefix_cache=False, spec_k=0, draft_model=None,
-                 prefill_only=False):
+                 prefill_only=False, generation=None):
         if scheduling not in ("continuous", "static"):
             raise ValueError("scheduling must be 'continuous' or 'static'")
         self.name = name
         self.model = model
+        # weight generation tag (serving/deploy.py): which checkpoint epoch
+        # this engine's params came from.  None = untagged (standalone use).
+        # import_stream refuses snapshots from a different generation — a
+        # stream must finish against the weights it started on
+        # (docs/CONCURRENCY.md invariant 13).
+        self.generation = generation
         self.scheduling = scheduling
         self.max_slots = int(max_slots)
         self.max_prompt_len = int(max_prompt_len)
@@ -1592,6 +1598,7 @@ class DecodeEngine:
                 "k": k_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(quiesced drain: K pages leave the device once per handoff)
                 "v": v_pool.asnumpy()[:, idx].copy(),  # mxflow: sync-ok(quiesced drain: V pages leave the device once per handoff)
                 "sampling": sampling,
+                "generation": self.generation,
             }
         else:
             # still queued (or joined but not yet prefilled): no device
@@ -1607,6 +1614,7 @@ class DecodeEngine:
                 "k": None,
                 "v": None,
                 "sampling": sampling,
+                "generation": self.generation,
             }
         self._cache.free_seq(stream.seq_id)
         self.stats.on_handed_off()
@@ -1635,6 +1643,14 @@ class DecodeEngine:
         if geometry != mine:
             raise MXNetError("snapshot geometry %r does not match engine "
                              "%r geometry %r" % (geometry, self.name, mine))
+        if snap.get("generation") != self.generation:
+            # the half-loaded-model guard: K/V pages written by one weight
+            # generation must never be read by another's attention — a
+            # stream finishes on the generation it started on (invariant 13)
+            raise MXNetError(
+                "snapshot from weight generation %r cannot resume on "
+                "engine %r serving generation %r"
+                % (snap.get("generation"), self.name, self.generation))
         if self.prefill_only and int(snap["generated"]) > 0:
             # mid-decode state needs decode steps this tier never runs;
             # only not-yet-prefilled streams may migrate within the tier
@@ -1716,6 +1732,7 @@ class DecodeEngine:
             "tokens_per_s": snap["tokens_per_s"],
             "tp_degree": self.tp_degree,
             "draining": draining,
+            "generation": self.generation,
             "prefix_hits": kv["prefix_hits"],
             "prefix_blocks_shared": kv["prefix_blocks_shared"],
             "cow_forks": kv["cow_forks"],
@@ -1866,6 +1883,7 @@ class DecodeEngine:
             snap["slots_live"] = sum(1 for s in self._slots if s is not None)
             snap["draining"] = self._draining
         snap["scheduling"] = self.scheduling
+        snap["generation"] = self.generation
         return snap
 
     # -- lifecycle ---------------------------------------------------------

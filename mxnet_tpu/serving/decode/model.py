@@ -76,7 +76,8 @@ class TinyCausalLM:
     """Small causal transformer LM with paged-KV prefill/decode kernels."""
 
     def __init__(self, vocab_size=48, hidden=32, num_layers=2, num_heads=2,
-                 max_len=128, seed=0, eos_id=None, context_attention=None):
+                 max_len=128, seed=0, eos_id=None, context_attention=None,
+                 params=None):
         if hidden % num_heads:
             raise ValueError("hidden must divide into num_heads")
         # name of a bound mesh axis ('sp') to split prompt attention over
@@ -91,22 +92,41 @@ class TinyCausalLM:
         self.max_len = int(max_len)
         self.eos_id = eos_id
         from ... import ndarray as nd
+        expected = {"embed": (self.vocab_size, self.hidden),
+                    "pos": (self.max_len, self.hidden)}
+        for l in range(self.num_layers):
+            expected["l%d_wq" % l] = (self.hidden, self.hidden)
+            expected["l%d_wk" % l] = (self.hidden, self.hidden)
+            expected["l%d_wv" % l] = (self.hidden, self.hidden)
+            expected["l%d_wo" % l] = (self.hidden, self.hidden)
+            expected["l%d_w1" % l] = (self.hidden, 2 * self.hidden)
+            expected["l%d_w2" % l] = (2 * self.hidden, self.hidden)
+        if params is not None:
+            # checkpoint-loaded weights (serving/deploy.py builds each new
+            # generation this way) — validate against the geometry before
+            # anything can compile a kernel over a half-shaped model
+            if set(params) != set(expected):
+                missing = sorted(set(expected) - set(params))
+                extra = sorted(set(params) - set(expected))
+                raise ValueError("params key mismatch: missing %r extra %r"
+                                 % (missing, extra))
+            loaded = {}
+            for k, shape in expected.items():
+                arr = params[k]
+                if tuple(arr.shape) != shape:
+                    raise ValueError("param %r has shape %r, expected %r"
+                                     % (k, tuple(arr.shape), shape))
+                loaded[k] = arr if isinstance(arr, nd.NDArray) \
+                    else nd.array(np.asarray(arr, np.float32))
+            self._params = loaded
+            return
         rng = np.random.RandomState(seed)
         scale = 1.0 / np.sqrt(self.hidden)
 
         def w(*shape):
             return nd.array(rng.randn(*shape).astype(np.float32) * scale)
 
-        params = {"embed": w(self.vocab_size, self.hidden),
-                  "pos": w(self.max_len, self.hidden)}
-        for l in range(self.num_layers):
-            params["l%d_wq" % l] = w(self.hidden, self.hidden)
-            params["l%d_wk" % l] = w(self.hidden, self.hidden)
-            params["l%d_wv" % l] = w(self.hidden, self.hidden)
-            params["l%d_wo" % l] = w(self.hidden, self.hidden)
-            params["l%d_w1" % l] = w(self.hidden, 2 * self.hidden)
-            params["l%d_w2" % l] = w(2 * self.hidden, self.hidden)
-        self._params = params
+        self._params = {k: w(*shape) for k, shape in expected.items()}
 
     def param_dict(self):
         return dict(self._params)

@@ -286,9 +286,14 @@ class _Replica:
 
 
 class _ModelSpec:
-    """Everything needed to re-load a model on a joining replica."""
+    """Everything needed to re-load a model on a joining replica.
+    ``wgen`` is the weight generation the spec currently serves (None
+    until a deployment commits one); rebalance passes compare it at
+    commit time so a copy warmed from a superseded generation is rolled
+    back instead of routed."""
 
-    __slots__ = ("name", "block", "input_shapes", "replicas", "kwargs")
+    __slots__ = ("name", "block", "input_shapes", "replicas", "kwargs",
+                 "wgen")
 
     def __init__(self, name, block, input_shapes, replicas, kwargs):
         self.name = name
@@ -296,6 +301,7 @@ class _ModelSpec:
         self.input_shapes = input_shapes
         self.replicas = replicas
         self.kwargs = kwargs
+        self.wgen = None
 
 
 class _EngineSpec:
@@ -307,7 +313,7 @@ class _EngineSpec:
     engine spans that many mesh devices (1 = unsharded), checked against
     the built engine's ``tp_degree``."""
 
-    __slots__ = ("name", "factory", "replicas", "max_new", "tp")
+    __slots__ = ("name", "factory", "replicas", "max_new", "tp", "wgen")
 
     def __init__(self, name, factory, replicas, tp=None):
         self.name = name
@@ -315,20 +321,25 @@ class _EngineSpec:
         self.replicas = replicas
         self.max_new = 0
         self.tp = tp
+        self.wgen = None         # weight generation the spec serves
 
 
 class _StreamRec:
     """Router-side record of one admitted stream (the session-affinity
     pin).  Guarded by the router's ``_lock``."""
 
-    __slots__ = ("name", "rid", "gen", "tenant", "need_tokens")
+    __slots__ = ("name", "rid", "gen", "tenant", "need_tokens", "wgen")
 
-    def __init__(self, name, rid, gen, tenant, need_tokens):
+    def __init__(self, name, rid, gen, tenant, need_tokens, wgen=None):
         self.name = name
         self.rid = rid
         self.gen = gen
         self.tenant = tenant
         self.need_tokens = need_tokens
+        # weight generation the stream STARTED on; pinned for life
+        # (docs/CONCURRENCY.md invariant 13) — handoffs may move the
+        # stream between engines but never across generations
+        self.wgen = wgen
 
 
 class _Tenant:
@@ -383,6 +394,18 @@ class FleetRouter:
         self._rr = {}           # name -> round-robin cursor
         self._next_rid = 0
         self._closed = False
+        # -- rolling deployment state (serving/deploy.py; all under _lock)
+        # fleet name -> server-side model name: a swapped-in model copy
+        # loads under "name@g<gen>" so old and new coexist on one server
+        # during the swap; routing reads through this alias
+        self._aliases = {}
+        # copies flipped out of routing but still finishing their pinned
+        # streams / in-flight predicts: dicts with kind/name/rid/wgen and
+        # an "eng" (engine entries) or "sname" (model entries)
+        self._retiring = []
+        self._deploy = {"generation": None, "previous": None,
+                        "staging": None, "revert": None,
+                        "last_rollback": None}
         self.stats_sink = FleetStats()
         # -- stateful decode tier (all under _lock, same discipline) -----
         self._dspecs = {}       # name -> _EngineSpec
@@ -438,6 +461,11 @@ class FleetRouter:
             rep.state = DRAINING
             engines = [(name, eng) for (name, r), eng
                        in self._dengines.items() if r == rid]
+            # retiring copies on this replica still hold pinned streams of
+            # their own generation; they drain through the same protocol
+            # (their snapshots only land on same-generation survivors)
+            engines += [(e["name"], e["eng"]) for e in self._retiring
+                        if e["kind"] == "engine" and e["rid"] == rid]
         if engines:
             self._handoff_decode(rid, engines)
 
@@ -530,6 +558,7 @@ class FleetRouter:
                 raise MXNetError("no model %r in the fleet; loaded: %s"
                                  % (name, sorted(self._specs) or "none"))
             del self._specs[name]
+            sname = self._aliases.pop(name, name)
             rids = self._placement.pop(name, [])
             self._rr.pop(name, None)
             servers = []
@@ -540,7 +569,7 @@ class FleetRouter:
                     servers.append(rep.server)
         for server in servers:
             try:
-                server.unload(name)
+                server.unload(sname)
             except MXNetError:
                 pass   # replica raced into teardown; nothing to unload
 
@@ -612,7 +641,9 @@ class FleetRouter:
                     engines.append((rep.server, eng))
         for server, eng in engines:
             try:
-                server.detach_engine(name)
+                # by the ENGINE's name: a swapped-in copy attaches under
+                # "name@g<gen>", not the fleet name
+                server.detach_engine(eng.name)
             except MXNetError:
                 pass
             eng.stop()
@@ -748,7 +779,12 @@ class FleetRouter:
         rid, gen = ow if (isinstance(ow, tuple) and len(ow) == 2) \
             else (rep.rid, gen)
         with self._lock:
-            rec = _StreamRec(name, rid, gen, tenant, need)
+            # the generation pin comes from the ENGINE that admitted: a
+            # swap committing between selection and this pin leaves the
+            # old engine retiring but still the stream's home, so its tag
+            # (not the spec's current one) is the truth
+            rec = _StreamRec(name, rid, gen, tenant, need,
+                             wgen=eng.generation)
             if stream in self._departed:
                 # handed off to another tier before this pin landed: the
                 # rec still settles the tenant + terminal accounting, but
@@ -934,11 +970,26 @@ class FleetRouter:
 
     def _resume_on_survivor(self, name, stream, snap, exclude):
         """Land one exported stream on the best surviving replica; on
-        exhaustion, fence-terminate it (UNAVAILABLE, prefix intact)."""
+        exhaustion, fence-terminate it (UNAVAILABLE, prefix intact).
+
+        Generation routing: a snapshot carries the weight generation of
+        the engine that exported it, and it may only resume on an engine
+        of the SAME generation (invariant 13; import_stream enforces it
+        bitwise too).  A snapshot from the fleet's current generation
+        takes the normal scored path; one from a retiring generation can
+        only land on a retiring same-generation copy (the already-cut-over
+        survivor of the rolling swap)."""
         if stream.snapshot()[0] is not None:
             # terminal while in flight (a concurrent kill fenced it):
             # importing it would strand a stream no engine can complete
             return False
+        wgen = snap.get("generation")
+        with self._lock:
+            spec = self._dspecs.get(name)
+            current = spec.wgen if spec is not None else None
+        if wgen != current:
+            return self._resume_on_retiring(name, stream, snap, wgen,
+                                            exclude)
         tried = {exclude}
         for _ in range(self._failover_budget + 1):
             sel, _reason = self._select_decode(name, tried)
@@ -971,6 +1022,41 @@ class FleetRouter:
         self._fence_terminate(
             stream, "drained replica's stream found no survivor with KV "
                     "headroom; re-admit with the emitted prefix as prompt")
+        return False
+
+    def _resume_on_retiring(self, name, stream, snap, wgen, exclude):
+        """Land a retiring-generation snapshot on a retiring
+        same-generation copy; fence-terminate when none survives."""
+        with self._lock:
+            cands = []
+            for entry in self._retiring:
+                if (entry["kind"] == "engine" and entry["name"] == name
+                        and entry["wgen"] == wgen
+                        and entry["rid"] != exclude):
+                    rep = self._replicas.get(entry["rid"])
+                    if rep is not None and rep.state == LIVE:
+                        cands.append((rep, entry["eng"], rep.gen))
+        for rep2, eng2, gen2 in cands:
+            try:
+                self._leases.check_generation(rep2.rid, gen2)
+            except MXNetError:
+                continue
+            owner2 = (rep2.rid, gen2)
+            stream.set_owner(owner2)
+            try:
+                eng2.import_stream(snap, stream=stream, owner=owner2)
+            except MXNetError:
+                continue      # no headroom / mid-retire: next candidate
+            with self._lock:
+                rec = self._streams.get(stream)
+                if rec is not None:
+                    rec.rid = rep2.rid
+                    rec.gen = gen2
+            self.decode_stats.on_handoff()
+            return True
+        self._fence_terminate(
+            stream, "stream's weight generation %r has no surviving copy; "
+                    "re-admit with the emitted prefix as prompt" % (wgen,))
         return False
 
     # -- multi-tenant QoS -------------------------------------------------
@@ -1179,12 +1265,12 @@ class FleetRouter:
                 return InferenceResult(
                     UNAVAILABLE,
                     error="no routable replica for %r (%s)" % (name, reason))
-            rep, breaker = sel
+            rep, breaker, sname = sel
             self._begin(rep)
             try:
                 faults.fault_point("fleet.replica", replica=rep.rid,
                                    model=name)
-                res = rep.server.predict(name, data, timeout_ms=timeout_ms)
+                res = rep.server.predict(sname, data, timeout_ms=timeout_ms)
             except faults.SimulatedCrash:
                 # the ONE place production code catches SimulatedCrash: at
                 # the fleet.replica site the crash is the REPLICA's death
@@ -1228,7 +1314,9 @@ class FleetRouter:
         raise AssertionError("unreachable")   # loop always returns
 
     def _select(self, name, tried):
-        """Pick (replica, breaker) for one attempt, or (None, reason).
+        """Pick (replica, breaker, server-side name) for one attempt, or
+        (None, reason).  The server-side name is the deployment alias —
+        the fleet name itself until a swap commits, "name@g<gen>" after.
 
         Round-robin over the model's placement, skipping already-tried,
         non-LIVE, and breaker-REJECT replicas.  Unknown model raises."""
@@ -1238,6 +1326,7 @@ class FleetRouter:
             if name not in self._specs:
                 raise MXNetError("no model %r in the fleet; loaded: %s"
                                  % (name, sorted(self._specs) or "none"))
+            sname = self._aliases.get(name, name)
             placed = list(self._placement.get(name, ()))
             if not placed:
                 return None, "no replicas host it"
@@ -1262,7 +1351,7 @@ class FleetRouter:
             # admit() outside _lock: the breaker has its own lock, and a
             # REJECT here must not stall other routing threads
             if breaker.admit() != REJECT:
-                return (rep, breaker), None
+                return (rep, breaker, sname), None
         return None, "all breakers open"
 
     def _begin(self, rep):
@@ -1280,6 +1369,28 @@ class FleetRouter:
             if rep is None or rep.state == DEAD:
                 return False
             rep.state = DEAD
+            # an in-progress swap can no longer cover this replica: the
+            # staged copies on it die with the server below, and commit
+            # must not flip a partial fleet — mark the staging aborted so
+            # commit_swap refuses and the controller aborts back to the
+            # old generation (evaluated BEFORE placements are pruned, so
+            # "did the dead replica matter to the swap" sees the truth)
+            st = self._deploy["staging"]
+            if st is not None and st["aborted"] is None:
+                involved = rid in st["rids"] or any(
+                    rid in self._placement.get(n, ())
+                    or rid in self._dplacement.get(n, ())
+                    for n in st["names"])
+                if involved:
+                    st["aborted"] = "replica %s died mid-swap" % rid
+                for key in [k for k in st["engines"] if k[1] == rid]:
+                    del st["engines"][key]
+                for key in [k for k in st["models"] if k[1] == rid]:
+                    del st["models"][key]
+            # retiring copies on the dead replica are gone with it; their
+            # streams are swept with the affected set below
+            self._retiring = [e for e in self._retiring
+                              if e["rid"] != rid]
             for name, rids in self._placement.items():
                 if rid in rids:
                     rids.remove(rid)
@@ -1357,7 +1468,12 @@ class FleetRouter:
                         if not cands:
                             continue
                         cands.sort(key=lambda r: (hosted[r.rid], r.rid))
-                        task = (name, spec, cands[0])
+                        # alias + weight generation captured with the
+                        # task: if a deployment commits while this copy
+                        # warms, the commit-time re-check below rolls the
+                        # superseded copy back instead of routing it
+                        task = (name, spec, cands[0],
+                                self._aliases.get(name, name), spec.wgen)
                         break
                     dtask = None
                     if task is None:
@@ -1378,16 +1494,16 @@ class FleetRouter:
                             if not cands:
                                 continue
                             cands.sort(key=lambda r: (hosted[r.rid], r.rid))
-                            dtask = (name, spec, cands[0])
+                            dtask = (name, spec, cands[0], spec.wgen)
                             break
                     if task is None and dtask is None:
                         return
                 if task is not None:
-                    name, spec, rep = task
+                    name, spec, rep, sname, wgen0 = task
                     try:
                         # load + full bucket-menu warmup on the new replica,
                         # BEFORE the placement commit below makes it routable
-                        rep.server.load_model(name, spec.block,
+                        rep.server.load_model(sname, spec.block,
                                               spec.input_shapes, **spec.kwargs)
                     except MXNetError:
                         failed.add((name, rep.rid))
@@ -1395,7 +1511,9 @@ class FleetRouter:
                     committed = False
                     with self._lock:
                         if (not self._closed and rep.state == LIVE
-                                and name in self._specs
+                                and self._specs.get(name) is spec
+                                and spec.wgen == wgen0
+                                and self._aliases.get(name, name) == sname
                                 and rep.rid not in self._placement[name]):
                             self._placement[name].append(rep.rid)
                             self._breakers[(name, rep.rid)] = CircuitBreaker(
@@ -1407,9 +1525,10 @@ class FleetRouter:
                         self.stats_sink.on_rebalance()
                     else:
                         # lost the race (replica died / model unloaded /
-                        # fleet stopped while warming): roll the orphan back
+                        # generation superseded / fleet stopped while
+                        # warming): roll the orphan back
                         try:
-                            rep.server.unload(name)
+                            rep.server.unload(sname)
                         except MXNetError:
                             pass
                     continue
@@ -1417,7 +1536,7 @@ class FleetRouter:
                 # lock (factory runs prefill/decode warmup), attach it to
                 # the replica's server so replica teardown drains it, then
                 # commit the placement
-                name, spec, rep = dtask
+                name, spec, rep, wgen0 = dtask
                 try:
                     eng = spec.factory(name)
                 except MXNetError:
@@ -1445,7 +1564,8 @@ class FleetRouter:
                 committed = False
                 with self._lock:
                     if (not self._closed and rep.state == LIVE
-                            and name in self._dspecs
+                            and self._dspecs.get(name) is spec
+                            and spec.wgen == wgen0
                             and rep.rid not in self._dplacement[name]):
                         self._dplacement[name].append(rep.rid)
                         self._dengines[(name, rep.rid)] = eng
@@ -1459,33 +1579,531 @@ class FleetRouter:
                     self.stats_sink.on_rebalance()
                 else:
                     try:
-                        rep.server.detach_engine(name)
+                        rep.server.detach_engine(eng.name)
                     except MXNetError:
                         pass
                     eng.stop()
 
-    def wait_converged(self, timeout_s=10.0):
+    def wait_converged(self, timeout_s=10.0, reason_on_timeout=False):
         """Block until every model has min(target, live) routable copies
-        (rebalancing settled).  Returns True on convergence."""
+        (rebalancing settled).  Returns True on convergence; on timeout,
+        returns False — or, with ``reason_on_timeout=True``, raises an
+        MXNetError naming every (model, replica-deficit) still open, so a
+        wedged rebalance (e.g. a factory that never finishes warming)
+        surfaces as a diagnosis instead of parking the caller forever."""
         deadline = time.monotonic() + timeout_s
         while True:
+            deficits = []
             with self._lock:
                 n_live = sum(1 for r in self._replicas.values()
                              if r.state == LIVE)
-                done = all(
-                    len([rid for rid in self._placement[name]
-                         if self._replicas[rid].state == LIVE])
-                    >= min(spec.replicas, n_live)
-                    for name, spec in self._specs.items()) and all(
-                    len([rid for rid in self._dplacement[name]
-                         if self._replicas[rid].state == LIVE])
-                    >= min(spec.replicas, n_live)
-                    for name, spec in self._dspecs.items())
-            if done:
+                for tier, placement in (("model", self._placement),
+                                        ("decode", self._dplacement)):
+                    specs = self._specs if tier == "model" else self._dspecs
+                    for name, spec in sorted(specs.items()):
+                        live_placed = [rid for rid in placement[name]
+                                       if self._replicas[rid].state == LIVE]
+                        want = min(spec.replicas, n_live)
+                        if len(live_placed) < want:
+                            deficits.append(
+                                "%s %r: %d/%d routable copies (placed on %s)"
+                                % (tier, name, len(live_placed), want,
+                                   live_placed or "nothing"))
+            if not deficits:
                 return True
             if time.monotonic() >= deadline:
+                if reason_on_timeout:
+                    raise MXNetError(
+                        "fleet did not converge within %.1fs; open "
+                        "deficits: %s" % (timeout_s, "; ".join(deficits)))
                 return False
             time.sleep(0.005)
+
+    # -- rolling weight swap (serving/deploy.py drives these) --------------
+    #
+    # The four-phase generation swap (docs/ROBUSTNESS.md "Rolling
+    # deployment"): begin -> stage (build + warm every new copy OUTSIDE
+    # _lock, old copies still serving) -> fence (lease-generation bump on
+    # every staged replica) -> commit (one atomic routing flip under
+    # _lock: no server or engine call, no fault point, nothing half-done)
+    # -> retire (old copies finish their pinned streams, consolidating
+    # onto one same-generation sink, then tear down).  abort_swap undoes a
+    # pre-commit swap; rollback_swap inverts a committed one while the
+    # revert record (cleared by retire_swap) still holds the old copies.
+
+    def begin_swap(self, generation):
+        """Open a staging area for weight generation ``generation``.
+        Exactly one swap at a time: raises while another is staging or a
+        committed one has not been retired yet."""
+        with self._lock:
+            if self._closed:
+                raise MXNetError("fleet is stopped; create a new FleetRouter")
+            if self._deploy["staging"] is not None:
+                raise MXNetError(
+                    "a swap to generation %r is already staging; abort or "
+                    "commit it first"
+                    % (self._deploy["staging"]["generation"],))
+            if self._deploy["revert"] is not None or self._retiring:
+                raise MXNetError(
+                    "the previous swap has not been retired; call "
+                    "retire_swap() (or rollback_swap()) first")
+            self._deploy["staging"] = {
+                "generation": generation, "names": set(),
+                "engines": {},     # (name, rid) -> warmed DecodeEngine
+                "models": {},      # (name, rid) -> server-side model name
+                "efactories": {},  # name -> generation engine factory
+                "mblocks": {},     # name -> generation block
+                "rids": set(), "fenced": False, "aborted": None,
+            }
+
+    @staticmethod
+    def _staging_ok(st):
+        """Validate a staging dict (read by the caller under ``_lock``)."""
+        if st is None:
+            raise MXNetError("no swap staged; call begin_swap() first")
+        if st["aborted"] is not None:
+            raise MXNetError("swap to generation %r aborted: %s"
+                             % (st["generation"], st["aborted"]))
+        return st
+
+    def stage_decode(self, name, rid, factory):
+        """Build + warm one new-generation engine for placement
+        ``(name, rid)``.  ``factory(srv_name)`` must return a warmed
+        DecodeEngine; it runs OUTSIDE ``_lock`` (warmup compiles are
+        slow) while the old copy keeps serving.  The engine attaches to
+        the replica's server under ``"name@g<generation>"`` so both
+        generations coexist until commit."""
+        with self._lock:
+            st = self._staging_ok(self._deploy["staging"])
+            g = st["generation"]
+            spec = self._dspecs.get(name)
+            if spec is None:
+                raise MXNetError("no decode engine %r in the fleet; "
+                                 "loaded: %s"
+                                 % (name, sorted(self._dspecs) or "none"))
+            rep = self._replicas.get(rid)
+            if rep is None or rep.state != LIVE \
+                    or rid not in self._dplacement.get(name, ()):
+                raise MXNetError("(%r, %s) is not a LIVE placement"
+                                 % (name, rid))
+            if (name, rid) in st["engines"]:
+                raise MXNetError("(%r, %s) is already staged" % (name, rid))
+        srv_name = "%s@g%s" % (name, g)
+        eng = factory(srv_name)
+        if getattr(eng, "generation", None) is None:
+            eng.generation = g
+        built_tp = int(getattr(eng, "tp_degree", 1))
+        if spec.tp is not None and built_tp != spec.tp:
+            eng.stop()
+            raise MXNetError(
+                "staged engine %r has tp_degree=%d but the fleet spec "
+                "declares tp=%d" % (srv_name, built_tp, spec.tp))
+        try:
+            rep.server.attach_engine(eng)
+        except MXNetError:
+            eng.stop()
+            raise
+        with self._lock:
+            ok = (self._deploy["staging"] is st and st["aborted"] is None
+                  and not self._closed and rep.state == LIVE
+                  and self._dspecs.get(name) is spec)
+            if ok:
+                st["engines"][(name, rid)] = eng
+                st["efactories"][name] = factory
+                st["names"].add(name)
+                st["rids"].add(rid)
+        if not ok:
+            # lost a death/abort race while warming: tear the orphan down
+            try:
+                rep.server.detach_engine(eng.name)
+            except MXNetError:
+                pass
+            eng.stop()
+            raise MXNetError("swap staging ended while warming %r on %s"
+                             % (name, rid))
+        return eng
+
+    def stage_model(self, name, rid, block):
+        """Load + warm one new-generation model copy for placement
+        ``(name, rid)`` under the alias ``"name@g<generation>"`` (spec
+        kwargs are inherited; the generation rides in as the copy's
+        tag).  Runs outside ``_lock``, old copy still serving."""
+        with self._lock:
+            st = self._staging_ok(self._deploy["staging"])
+            g = st["generation"]
+            spec = self._specs.get(name)
+            if spec is None:
+                raise MXNetError("no model %r in the fleet; loaded: %s"
+                                 % (name, sorted(self._specs) or "none"))
+            rep = self._replicas.get(rid)
+            if rep is None or rep.state != LIVE \
+                    or rid not in self._placement.get(name, ()):
+                raise MXNetError("(%r, %s) is not a LIVE placement"
+                                 % (name, rid))
+            if (name, rid) in st["models"]:
+                raise MXNetError("(%r, %s) is already staged" % (name, rid))
+            kwargs = dict(spec.kwargs)
+        kwargs["generation"] = g
+        sname = "%s@g%s" % (name, g)
+        rep.server.load_model(sname, block, spec.input_shapes, **kwargs)
+        with self._lock:
+            ok = (self._deploy["staging"] is st and st["aborted"] is None
+                  and not self._closed and rep.state == LIVE
+                  and self._specs.get(name) is spec)
+            if ok:
+                st["models"][(name, rid)] = sname
+                st["mblocks"][name] = block
+                st["names"].add(name)
+                st["rids"].add(rid)
+        if not ok:
+            try:
+                rep.server.unload(sname)
+            except MXNetError:
+                pass
+            raise MXNetError("swap staging ended while warming %r on %s"
+                             % (name, rid))
+
+    def fence_swap(self):
+        """Fence every staged replica's old incarnation: bump its lease
+        generation (MembershipTable) and cache the new one on the
+        replica row.  In-flight streams keep their per-stream owner
+        tokens and keep emitting on the old copies; what dies is the old
+        generation's power to RE-own or import anything from here on."""
+        with self._lock:
+            st = self._staging_ok(self._deploy["staging"])
+            if not st["engines"] and not st["models"]:
+                raise MXNetError("nothing staged; stage_decode()/"
+                                 "stage_model() before fence_swap()")
+            rids = sorted(st["rids"])
+        for rid in rids:
+            new_gen = self._leases.register(rid).generation
+            with self._lock:
+                rep = self._replicas.get(rid)
+                if rep is not None and rep.state != DEAD:
+                    rep.gen = new_gen
+        with self._lock:
+            st2 = self._deploy["staging"]
+            if st2 is st:
+                st["fenced"] = True
+
+    def commit_swap(self):
+        """The atomic routing flip.  Entirely under ``_lock`` with no
+        server/engine call and no fault point inside: a kill before it
+        leaves the fleet fully on the old generation, a kill after it
+        fully on the new one — there is no in-between to observe.
+
+        Requires a fenced, unaborted staging whose copies cover EVERY
+        live routable placement of every swapped name (a mid-swap
+        replica death breaks coverage and fails the commit).  Old copies
+        move to the retiring list; the revert record for
+        ``rollback_swap`` is built from the same entries."""
+        with self._lock:
+            st = self._staging_ok(self._deploy["staging"])
+            if not st["fenced"]:
+                raise MXNetError("fence_swap() must run before "
+                                 "commit_swap()")
+            g = st["generation"]
+            missing = []
+            for name in sorted(st["names"]):
+                if name in self._dspecs:
+                    for rid in self._dplacement.get(name, ()):
+                        if self._replicas[rid].state != DEAD \
+                                and (name, rid) not in st["engines"]:
+                            missing.append("engine (%s, %s)" % (name, rid))
+                if name in self._specs:
+                    for rid in self._placement.get(name, ()):
+                        if self._replicas[rid].state != DEAD \
+                                and (name, rid) not in st["models"]:
+                            missing.append("model (%s, %s)" % (name, rid))
+            if missing:
+                raise MXNetError(
+                    "cannot commit generation %r: unstaged live "
+                    "placements: %s" % (g, ", ".join(missing)))
+            retired = []
+            revert = {"generation": self._deploy["generation"],
+                      "previous": self._deploy["previous"],
+                      "engines": {}, "models": {}, "retired": retired}
+            for name in sorted({n for (n, _r) in st["engines"]}):
+                spec = self._dspecs[name]
+                revert["engines"][name] = {
+                    "factory": spec.factory, "wgen": spec.wgen,
+                    "max_new": spec.max_new}
+                for rid in list(self._dplacement.get(name, ())):
+                    key = (name, rid)
+                    new_eng = st["engines"].get(key)
+                    if new_eng is None:
+                        continue   # dead rid already pruned from placement
+                    entry = {"kind": "engine", "name": name, "rid": rid,
+                             "wgen": spec.wgen,
+                             "eng": self._dengines.get(key)}
+                    self._retiring.append(entry)
+                    retired.append(entry)
+                    self._dengines[key] = new_eng
+                    breaker = self._dbreakers.get(key)
+                    if breaker is not None:
+                        breaker.reset()
+                    spec.max_new = new_eng.max_new_tokens
+                spec.factory = st["efactories"][name]
+                spec.wgen = g
+            for name in sorted({n for (n, _r) in st["models"]}):
+                spec = self._specs[name]
+                old_sname = self._aliases.get(name, name)
+                revert["models"][name] = {
+                    "sname": old_sname, "block": spec.block,
+                    "kwargs": spec.kwargs, "wgen": spec.wgen}
+                new_sname = "%s@g%s" % (name, g)
+                for rid in list(self._placement.get(name, ())):
+                    if (name, rid) not in st["models"]:
+                        continue
+                    entry = {"kind": "model", "name": name, "rid": rid,
+                             "wgen": spec.wgen, "sname": old_sname}
+                    self._retiring.append(entry)
+                    retired.append(entry)
+                    breaker = self._breakers.get((name, rid))
+                    if breaker is not None:
+                        breaker.reset()
+                self._aliases[name] = new_sname
+                spec.block = st["mblocks"][name]
+                kwargs = dict(spec.kwargs)
+                kwargs["generation"] = g
+                spec.kwargs = kwargs
+                spec.wgen = g
+            self._deploy["previous"] = self._deploy["generation"]
+            self._deploy["generation"] = g
+            self._deploy["revert"] = revert
+            self._deploy["staging"] = None
+
+    def rollback_swap(self, reason="health gate"):
+        """Invert a committed, not-yet-retired swap: the routing flip runs
+        backwards under ``_lock`` (old copies come straight back out of
+        the retiring list — they were never torn down), the bad
+        generation's copies go INTO the retiring list to finish whatever
+        streams they admitted, and placements that only ever existed on
+        the bad generation (a post-commit rebalance) are dropped for the
+        background rebalancer to rebuild from the restored spec."""
+        with self._lock:
+            revert = self._deploy["revert"]
+            if revert is None:
+                raise MXNetError("nothing to roll back (no committed, "
+                                 "unretired swap)")
+            bad_gen = self._deploy["generation"]
+            alive = {id(e) for e in self._retiring}
+            live_old = {(e["kind"], e["name"], e["rid"]): e
+                        for e in revert["retired"] if id(e) in alive}
+            for name, saved in revert["engines"].items():
+                spec = self._dspecs.get(name)
+                if spec is None:
+                    continue
+                keep = []
+                for rid in list(self._dplacement.get(name, ())):
+                    key = (name, rid)
+                    bad_eng = self._dengines.get(key)
+                    if bad_eng is not None:
+                        self._retiring.append(
+                            {"kind": "engine", "name": name, "rid": rid,
+                             "wgen": spec.wgen, "eng": bad_eng})
+                    old = live_old.get(("engine", name, rid))
+                    if old is not None:
+                        self._retiring = [e for e in self._retiring
+                                          if e is not old]
+                        self._dengines[key] = old["eng"]
+                        breaker = self._dbreakers.get(key)
+                        if breaker is not None:
+                            breaker.reset()
+                        keep.append(rid)
+                    else:
+                        self._dengines.pop(key, None)
+                        self._dbreakers.pop(key, None)
+                self._dplacement[name] = keep
+                spec.factory = saved["factory"]
+                spec.wgen = saved["wgen"]
+                spec.max_new = saved["max_new"]
+            for name, saved in revert["models"].items():
+                spec = self._specs.get(name)
+                if spec is None:
+                    continue
+                bad_sname = self._aliases.get(name, name)
+                keep = []
+                for rid in list(self._placement.get(name, ())):
+                    self._retiring.append(
+                        {"kind": "model", "name": name, "rid": rid,
+                         "wgen": spec.wgen, "sname": bad_sname})
+                    old = live_old.get(("model", name, rid))
+                    if old is not None:
+                        self._retiring = [e for e in self._retiring
+                                          if e is not old]
+                        breaker = self._breakers.get((name, rid))
+                        if breaker is not None:
+                            breaker.reset()
+                        keep.append(rid)
+                    else:
+                        self._breakers.pop((name, rid), None)
+                self._placement[name] = keep
+                if saved["sname"] == name:
+                    self._aliases.pop(name, None)
+                else:
+                    self._aliases[name] = saved["sname"]
+                spec.block = saved["block"]
+                spec.kwargs = saved["kwargs"]
+                spec.wgen = saved["wgen"]
+            self._deploy["generation"] = revert["generation"]
+            self._deploy["previous"] = revert["previous"]
+            self._deploy["last_rollback"] = {"generation": bad_gen,
+                                             "reason": reason}
+            self._deploy["revert"] = None
+            closed = self._closed
+        if not closed:
+            # rebuild any placement the rollback dropped, off this thread
+            threading.Thread(target=self._rebalance,
+                             name="fleet-rebalance", daemon=True).start()
+
+    def abort_swap(self, reason=None):
+        """Discard a pre-commit staging: staged copies detach/unload and
+        stop; routing never changed, so the fleet simply continues on the
+        old generation.  Idempotent (no staging = no-op)."""
+        with self._lock:
+            st = self._deploy["staging"]
+            self._deploy["staging"] = None
+            work = []
+            if st is not None:
+                for (name, rid), eng in st["engines"].items():
+                    rep = self._replicas.get(rid)
+                    if rep is not None and rep.state != DEAD:
+                        work.append(("engine", rep.server, eng))
+                for (name, rid), sname in st["models"].items():
+                    rep = self._replicas.get(rid)
+                    if rep is not None and rep.state != DEAD:
+                        work.append(("model", rep.server, sname))
+        for kind, server, obj in work:
+            if kind == "engine":
+                try:
+                    server.detach_engine(obj.name)
+                except MXNetError:
+                    pass
+                obj.stop()
+            else:
+                try:
+                    server.unload(obj)
+                except MXNetError:
+                    pass
+        return st is not None
+
+    def retire_swap(self, timeout_s=10.0):
+        """Finish and tear down every retiring copy; clears the revert
+        record (the swap's point of no return — rollback_swap is
+        impossible after this returns).
+
+        Retiring engines of one (name, generation) group consolidate
+        before teardown: all but one quiesce and fenced-handoff their
+        still-running streams onto the group's surviving sink (the
+        already-cut-over survivor), which then finishes them — bounded by
+        ``timeout_s``, after which leftovers fence-terminate UNAVAILABLE
+        with their prefix intact.  Retiring model copies unload once
+        their replica's in-flight predicts clear (bounded the same
+        way)."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            entries = list(self._retiring)
+        groups = {}
+        model_entries = []
+        for e in entries:
+            if e["kind"] == "engine":
+                groups.setdefault((e["name"], e["wgen"]), []).append(e)
+            else:
+                model_entries.append(e)
+        handed = fenced = 0
+
+        def _teardown(entry):
+            with self._lock:
+                present = any(x is entry for x in self._retiring)
+                self._retiring = [x for x in self._retiring
+                                  if x is not entry]
+                rep = self._replicas.get(entry["rid"])
+                server = (rep.server if rep is not None
+                          and rep.state != DEAD else None)
+            if not present or server is None:
+                return
+            eng = entry["eng"]
+            try:
+                server.detach_engine(eng.name)
+            except MXNetError:
+                pass
+            eng.stop()
+
+        def _fence_left(name, wgen, rid=None):
+            n = 0
+            with self._lock:
+                stuck = [s for s, rec in self._streams.items()
+                         if rec.name == name and rec.wgen == wgen
+                         and (rid is None or rec.rid == rid)]
+            for stream in stuck:
+                self._fence_terminate(
+                    stream, "weight generation %r retired before the "
+                            "stream finished; re-admit with the emitted "
+                            "prefix as prompt" % (wgen,))
+                n += 1
+            return n
+
+        for (name, wgen), group in sorted(
+                groups.items(), key=lambda kv: (kv[0][0], str(kv[0][1]))):
+            with self._lock:
+                alive = {id(e) for e in self._retiring}
+                live = [e for e in group if id(e) in alive
+                        and (rep := self._replicas.get(e["rid"]))
+                        is not None and rep.state == LIVE]
+            sink = live[-1] if live else None
+            for e in group:
+                if e is sink:
+                    continue
+                with self._lock:
+                    if not any(x is e for x in self._retiring):
+                        continue   # swept by a concurrent replica death
+                eng = e["eng"]
+                if sink is not None and eng.quiesce(timeout_s=5.0):
+                    for stream, snap in eng.export_streams():
+                        if self._resume_on_retiring(name, stream, snap,
+                                                    wgen, exclude=e["rid"]):
+                            handed += 1
+                        else:
+                            fenced += 1
+                else:
+                    fenced += _fence_left(name, wgen, rid=e["rid"])
+                _teardown(e)
+            if sink is None:
+                fenced += _fence_left(name, wgen)
+                continue
+            while time.monotonic() < deadline:
+                with self._lock:
+                    left = any(rec.name == name and rec.wgen == wgen
+                               for rec in self._streams.values())
+                if not left:
+                    break
+                time.sleep(0.01)
+            fenced += _fence_left(name, wgen)
+            _teardown(sink)
+        for e in model_entries:
+            with self._lock:
+                present = any(x is e for x in self._retiring)
+                self._retiring = [x for x in self._retiring if x is not e]
+                rep = self._replicas.get(e["rid"])
+                server = (rep.server if rep is not None
+                          and rep.state != DEAD else None)
+            if not present or server is None:
+                continue
+            while time.monotonic() < deadline:
+                with self._lock:
+                    inflight = rep.inflight
+                if inflight == 0:
+                    break
+                time.sleep(0.005)
+            try:
+                server.unload(e["sname"])
+            except MXNetError:
+                pass
+        with self._lock:
+            self._deploy["revert"] = None
+        return {"handoffs": handed, "fenced": fenced,
+                "retired": len(entries)}
 
     # -- observability ----------------------------------------------------
     def health(self, name=None):
@@ -1603,6 +2221,25 @@ class FleetRouter:
                     roll[key] += snap.get(key, 0)
         out["decode"]["prefix_spec"] = roll
         out["tenants"] = self.tenant_snapshot()
+        with self._lock:
+            st = self._deploy["staging"]
+            out["deploy"] = {
+                "generation": self._deploy["generation"],
+                "previous": self._deploy["previous"],
+                "in_progress": None if st is None else {
+                    "generation": st["generation"],
+                    "staged_engines": sorted(
+                        "%s@%s" % k for k in st["engines"]),
+                    "staged_models": sorted(
+                        "%s@%s" % k for k in st["models"]),
+                    "fenced": st["fenced"],
+                    "aborted": st["aborted"],
+                },
+                "retiring": len(self._retiring),
+                "aliases": {n: a for n, a in self._aliases.items()
+                            if a != n},
+                "last_rollback": self._deploy["last_rollback"],
+            }
         return out
 
     # -- lifecycle ---------------------------------------------------------

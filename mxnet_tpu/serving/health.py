@@ -138,6 +138,22 @@ class CircuitBreaker:
                 return True
             return False
 
+    def reset(self):
+        """Forget all failure history: closed, clean streak, base backoff.
+
+        Used when the object the breaker guards is *replaced* rather than
+        recovered — e.g. a rolling weight swap retires the copy whose
+        failures were counted (serving/deploy.py) — so stale history from
+        the old copy neither rejects traffic to the new one nor masks its
+        fresh failures.  Lifetime ``opens``/``rejections`` counters are
+        kept (they describe the slot, not the copy)."""
+        with self._lock:
+            self._state = _CLOSED
+            self._consecutive = 0
+            self._backoff = self._base_backoff
+            self._open_until = 0.0
+            self._probe_expire = None
+
     # -- observability ---------------------------------------------------
     def state(self):
         with self._lock:

@@ -42,9 +42,12 @@ class ServableModel:
     def __init__(self, name, block, input_shapes, dtype="float32",
                  max_batch=8, batch_ladder=None, flags=None,
                  breaker_threshold=5, breaker_backoff_ms=50.0,
-                 breaker_max_backoff_ms=2000.0):
+                 breaker_max_backoff_ms=2000.0, generation=None):
         self.name = name
         self.block = block
+        # weight generation tag (serving/deploy.py): which checkpoint epoch
+        # this copy's params came from; None = untagged standalone use
+        self.generation = generation
         self.ladder = (batch_ladder if isinstance(batch_ladder, BucketLadder)
                        else BucketLadder(max_batch, batch_ladder))
         self.variants = normalize_shape_variants(input_shapes)

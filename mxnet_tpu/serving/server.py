@@ -129,7 +129,7 @@ class ModelServer:
                    max_batch=8, batch_ladder=None, max_queue=64,
                    linger_ms=2.0, default_timeout_ms=None, warmup=True,
                    flags=None, breaker_threshold=5, breaker_backoff_ms=50.0,
-                   breaker_max_backoff_ms=2000.0):
+                   breaker_max_backoff_ms=2000.0, generation=None):
         """Load a Gluon block (hybridizable or plain) for serving.
 
         ``input_shapes`` is the complete menu of admissible per-request
@@ -157,7 +157,8 @@ class ModelServer:
                               max_batch=max_batch, batch_ladder=batch_ladder,
                               flags=flags, breaker_threshold=breaker_threshold,
                               breaker_backoff_ms=breaker_backoff_ms,
-                              breaker_max_backoff_ms=breaker_max_backoff_ms)
+                              breaker_max_backoff_ms=breaker_max_backoff_ms,
+                              generation=generation)
         if warmup:
             model.warmup()
         self._registry.add(model)

@@ -47,8 +47,17 @@ under preemption) after which the attachment ledger must conserve
 (allocated == freed, used == 0), the byte accountant must mirror it
 exactly (live_bytes == 0, alloc counts equal), region peak_bytes must
 stay under the declared admission budget, and physical peak_used must
-stay <= pool capacity.  Exit code is non-zero iff any
-seed violated any invariant.
+stay <= pool capacity.  The ``deploy`` scenario storms the rolling
+weight-deployment controller (``serving.deploy``; docs/ROBUSTNESS.md
+"Rolling deployment"): each seed publishes a checkpoint epoch with
+DIFFERENT weights and either rolls it across the live fleet under client
+streams (sometimes racing a replica kill) or crashes the controller at a
+seeded ``deploy.resolve``/``warmup``/``cutover``/``commit`` fault point —
+a killed controller must leave the fleet HEALTHY on the OLD generation,
+every stream must finish against exactly ONE weight generation (bitwise
+vs that generation's reference), the router ledger must conserve, KV
+pools must drain whole, and post-swap probes must never recompile.  Exit
+code is non-zero iff any seed violated any invariant.
 
 Usage:
   python tools/mxstress.py --smoke              # 25 fixed seeds, <=20 s

@@ -61,6 +61,21 @@ Two load profiles:
   stream conservation, zero steady-state recompiles / leaked KV blocks
   on every engine of both tiers, every OK stream bitwise-equal to the
   single-engine reference — to a BENCH_DISAGG.json artifact.
+* ``--profile deploy`` — zero-downtime weight hot-swap under OPEN-loop
+  load: a two-replica decode fleet replays a seeded Poisson arrival
+  trace while a ``DeploymentController`` (serving/deploy.py) rolls the
+  fleet from checkpoint generation 1 to generation 2 MID-TRACE —
+  build + warm the new engines outside the router lock, fence, commit,
+  drain the old generation onto a same-generation sink, retire.
+  Reports the swap duration, per-replica warmup compile counts,
+  handoff/fence counts, and TTFT p99 for streams submitted during the
+  swap window vs steady state; hard gates — zero dropped streams
+  (every arrival terminates OK and the ledger conserves), every OK
+  stream bitwise-equal to exactly ONE generation's reference (none
+  torn, both generations observed), zero steady-state recompiles on
+  the new AND the retired engines, zero leaked KV blocks fleet-wide,
+  and swap-window TTFT p99 within ``--swap-ttft-x`` of steady state —
+  to a BENCH_DEPLOY.json artifact.
 
 Profiles live in the ``PROFILES`` table (one row each: artifact path,
 environment, runner); adding a profile is one entry plus its runner.
@@ -72,6 +87,7 @@ Usage:
   python tools/serve_bench.py --profile prefix-spec  # stacked multipliers
   python tools/serve_bench.py --profile sharded-decode  # tp=2 vs tp=1
   python tools/serve_bench.py --profile disagg       # open-loop tiers
+  python tools/serve_bench.py --profile deploy       # live weight swap
   python tools/serve_bench.py --smoke [--profile decode]  # tier-1 smokes
   python tools/serve_bench.py --clients 16 --requests 64 --out bench.json
 """
@@ -1180,6 +1196,353 @@ def _disagg_ok(report):
     return True
 
 
+def run_deploy_bench(rate_hz, duration_s, slots, block_size, max_prompt,
+                     max_new, seed, model_cfg, replicas=2, swap_ttft_x=5.0,
+                     time_scale=1.0):
+    """Live weight hot-swap under OPEN-loop load (serving/deploy.py).
+
+    One ``FleetRouter`` (``replicas`` decode replicas) serves a seeded
+    Poisson arrival trace while a ``DeploymentController`` rolls the
+    fleet from checkpoint generation 1 to generation 2 MID-TRACE (the
+    swap triggers once ~10% of arrivals have fired).  Two weight
+    generations exist on disk as manifest-committed checkpoints; the
+    per-generation greedy/sampled references make "every stream finishes
+    against exactly one weight generation" checkable bitwise.  Hard
+    gates: zero dropped streams (every arrival terminates OK and the
+    ledger conserves), both generations observed among the OK streams
+    (the swap really overlapped traffic), zero steady-state recompiles
+    on the NEW engines and on the RETIRED generation-1 engines, zero
+    leaked KV blocks fleet-wide (HBM accountant), and TTFT p99 for
+    streams submitted during the swap window within ``swap_ttft_x`` of
+    the steady-state p99."""
+    import shutil
+    import tempfile
+
+    from mxnet_tpu import model as model_mod
+    from mxnet_tpu import symbol as sym_mod
+    from mxnet_tpu.memory_accounting import (memory_counters,
+                                             reset_memory_counters)
+    from mxnet_tpu.serving import traffic
+    from mxnet_tpu.serving.decode import DecodeEngine, TinyCausalLM
+    from mxnet_tpu.serving.deploy import DeploymentController
+    from mxnet_tpu.serving.fleet import FleetRouter
+
+    arrivals = traffic.poisson_trace(rate_hz, duration_s, seed=seed)
+    n = len(arrivals)
+    rng = np.random.RandomState(seed)
+    vocab = model_cfg["vocab_size"]
+    prompts = [rng.randint(0, vocab,
+                           rng.randint(1, max_prompt + 1)).tolist()
+               for _ in range(n)]
+    budgets = [int(rng.randint(2, max_new + 1)) for _ in range(n)]
+    sampling = [{"temperature": 0.8, "top_k": 8, "seed": 3000 + i}
+                if i % 4 == 3 else {} for i in range(n)]
+    max_width = DecodeEngine.worst_case_width(max_prompt, max_new,
+                                              block_size)
+    per_stream = -(-(max_prompt + max_new) // block_size)
+    # KV capacity off the table (any engine could hold the whole trace):
+    # the axis under test is the swap, not memory pressure
+    num_blocks = n * per_stream + 1
+    engine_kw = dict(max_slots=slots, block_size=block_size,
+                     max_prompt_len=max_prompt, max_new_tokens=max_new,
+                     max_queue=max(8, n), num_blocks=num_blocks,
+                     width_blocks=[max_width])
+
+    # two weight generations, published as manifest-committed checkpoints
+    gen_cfg = {1: dict(model_cfg),
+               2: dict(model_cfg, seed=model_cfg["seed"] + 1)}
+    tmpdir = tempfile.mkdtemp(prefix="serve-bench-deploy-")
+    prefix = os.path.join(tmpdir, "ck")
+    refs = {}
+    try:
+        for gen, cfg in sorted(gen_cfg.items()):
+            lm = TinyCausalLM(**cfg)
+            model_mod.save_checkpoint(prefix, gen, sym_mod.Variable("data"),
+                                      dict(lm._params), {})
+            ref_eng = DecodeEngine(TinyCausalLM(**cfg),
+                                   name="bench-deploy-ref%d" % gen,
+                                   **engine_kw)
+            try:
+                refs[gen] = [ref_eng.generate_reference(p, b,
+                                                        **opts).tolist()
+                             for p, b, opts in zip(prompts, budgets,
+                                                   sampling)]
+            finally:
+                ref_eng.stop()
+
+        def builder(srv_name, arg_params, aux_params, generation):
+            return DecodeEngine(
+                TinyCausalLM(params=arg_params, **gen_cfg[1]),
+                name=srv_name, generation=generation, **engine_kw)
+
+        reset_memory_counters()
+        t0_warm = time.monotonic()
+        router = FleetRouter(replicas=replicas, failover_budget=2)
+        router.load_decode(
+            "bench-deploy",
+            lambda nm: DecodeEngine(TinyCausalLM(**gen_cfg[1]), name=nm,
+                                    **engine_kw),
+            replicas=replicas)
+        ctl = DeploymentController(router, prefix,
+                                   engines={"bench-deploy": builder})
+        boot = ctl.deploy(1)
+        assert boot["status"] == "deployed", boot
+        warmup_s = time.monotonic() - t0_warm
+        # hold the generation-1 engines: their recompile gate outlives
+        # their retirement
+        placement = router.stats()["decode_models"]["bench-deploy"][
+            "placement"]
+        old_engines = [router.engine("bench-deploy", rid)
+                       for rid in placement]
+
+        handles = [None] * n
+        submit_t = [None] * n
+        swap_at = max(1, n // 10)
+        swap_trigger = threading.Event()
+        swap_result = {}
+
+        def submit(i, _t):
+            submit_t[i] = time.monotonic()
+            handles[i] = router.submit_stream(
+                "bench-deploy", prompts[i], max_new_tokens=budgets[i],
+                **sampling[i])
+            if i + 1 == swap_at:
+                swap_trigger.set()
+
+        def swapper():
+            if not swap_trigger.wait(60.0):
+                return
+            swap_result["t0"] = time.monotonic()
+            try:
+                swap_result["report"] = ctl.deploy(2)
+            except Exception as exc:      # surfaces in the gate
+                swap_result["error"] = "%s: %s" % (type(exc).__name__, exc)
+            swap_result["t1"] = time.monotonic()
+
+        swap_thread = threading.Thread(target=swapper, daemon=True)
+        swap_thread.start()
+        wall0 = time.monotonic()
+        fired = traffic.replay(arrivals, submit, time_scale=time_scale)
+        for h in handles:
+            if h is not None:
+                h.wait(60.0)
+        swap_thread.join(120.0)
+        wall = time.monotonic() - wall0
+
+        # deterministic post-swap probes: whatever the trace/swap timing
+        # race produced, these streams run on the FINAL generation and
+        # must match ITS reference bitwise (and, with the engine gate
+        # below, without a single recompile)
+        final_gen = (2 if (swap_result.get("report") or {}).get(
+            "status") == "deployed" else 1)
+        probe_rows = []
+        probe_handles = [(i, router.submit_stream(
+            "bench-deploy", prompts[i], max_new_tokens=budgets[i],
+            **sampling[i])) for i in range(min(4, n))]
+        probes_bitwise = True
+        for i, h in probe_handles:
+            h.wait(30.0)
+            status, toks, _t, _l, _e = h.snapshot()
+            probe_rows.append({"status": status, "tokens": len(toks)})
+            if status != "OK" or list(toks) != refs[final_gen][i]:
+                probes_bitwise = False
+
+        # settle the ledger and the pools before reading the gates
+        conserved = pools_whole = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            d = router.decode_stats.snapshot()
+            conserved = d["requests"] == (d["ok"] + d["timeouts"]
+                                          + d["errors"]
+                                          + d["unavailable"])
+            snaps = router.stats()["engines"].get("bench-deploy", {})
+            pools_whole = all(
+                s["kv"]["used"] == 0 and s["kv"]["reserved"] == 0
+                for s in snaps.values())
+            if conserved and pools_whole:
+                break
+            time.sleep(0.005)
+
+        # per-stream verdicts: every OK stream must equal ONE
+        # generation's reference bitwise; swap-window membership comes
+        # from the submit timestamp
+        statuses = {}
+        rows_in, rows_out = [], []
+        ok_by_gen = {1: 0, 2: 0}
+        torn = 0
+        t_sw0 = swap_result.get("t0")
+        t_sw1 = swap_result.get("t1")
+        for i, h in enumerate(handles):
+            status, toks, ttft, latency, _err = h.snapshot()
+            statuses[status] = statuses.get(status, 0) + 1
+            in_window = (t_sw0 is not None and t_sw1 is not None
+                         and t_sw0 <= submit_t[i] <= t_sw1)
+            (rows_in if in_window else rows_out).append(
+                {"status": status, "ttft_ms": ttft,
+                 "latency_ms": latency, "tokens": len(toks)})
+            if status == "OK":
+                toks = list(toks)
+                m1 = toks == refs[1][i]
+                m2 = toks == refs[2][i]
+                if m1 and not m2:
+                    ok_by_gen[1] += 1
+                elif m2 and not m1:
+                    ok_by_gen[2] += 1
+                elif not m1 and not m2:
+                    torn += 1
+        if probes_bitwise:
+            ok_by_gen[final_gen] += len(probe_handles)
+
+        def p99(rows):
+            vals = sorted(r["ttft_ms"] for r in rows
+                          if r["ttft_ms"] is not None)
+            if not vals:
+                return None
+            return vals[min(len(vals) - 1,
+                            int(round(0.99 * (len(vals) - 1))))]
+
+        ttft_in, ttft_out = p99(rows_in), p99(rows_out)
+        engines = {}
+        snaps = router.stats()["engines"].get("bench-deploy", {})
+        for rid, s in sorted(snaps.items()):
+            kv = s["kv"]
+            engines[rid] = {
+                "generation": s.get("generation"),
+                "requests": s["requests"],
+                "imported": s["imported"],
+                "handed_off": s["handed_off"],
+                "steady_state_recompiles": (
+                    s["cache"]["recompiles"]
+                    - s["warmup"]["cache"]["misses"]),
+                "kv_leaked_blocks": (kv["allocated_total"]
+                                     - kv["freed_total"]),
+                "kv_peak_blocks": kv["peak_used"],
+            }
+        # the retired generation-1 engines: lived from warmup through
+        # retirement — any miss beyond their warmup is a swap-caused
+        # recompile
+        retired = {}
+        for eng in old_engines:
+            retired[eng.name] = {
+                "steady_state_recompiles": (
+                    eng.cache_stats()["misses"]
+                    - eng.warmup_report["cache"]["misses"]),
+            }
+        deploy_stats = router.stats()["deploy"]
+        router.stop()
+
+        kv_regions = {r: c for r, c in memory_counters().items()
+                      if r.startswith("kv:")}
+        blocks = {r: c for r, c in kv_regions.items()
+                  if not r.endswith((":pools", ":import"))}
+        memory = {
+            "kv_regions": len(kv_regions),
+            "kv_alloc_bytes": sum(c["alloc_bytes"]
+                                  for c in kv_regions.values()),
+            "kv_live_bytes": sum(c["live_bytes"]
+                                 for c in blocks.values()),
+            "balanced": bool(blocks) and all(
+                c["alloc_bytes"] == c["freed_bytes"]
+                and c["live_bytes"] == 0 for c in blocks.values()),
+        }
+        swap_report = swap_result.get("report")
+        return {
+            "profile": "deploy",
+            "workload": {
+                "rate_hz": rate_hz,
+                "duration_s": duration_s,
+                "time_scale": time_scale,
+                "arrivals": n,
+                "fired": fired,
+                "replicas": replicas,
+                "slots": slots,
+                "block_size": block_size,
+                "max_prompt_len": max_prompt,
+                "max_new_tokens": max_new,
+                "sampled_every": 4,
+                "swap_at_arrival": swap_at,
+                "swap_ttft_x": swap_ttft_x,
+                "seed": seed,
+                "model": dict(model_cfg),
+            },
+            "wall_s": round(wall, 3),
+            "warmup_s": round(warmup_s, 3),
+            "statuses": statuses,
+            "conserved": conserved,
+            "pools_whole": pools_whole,
+            "ok_by_generation": ok_by_gen,
+            "torn_streams": torn,
+            "probes": {"rows": probe_rows, "bitwise": probes_bitwise,
+                       "generation": final_gen},
+            "swap": {
+                "status": (swap_report or {}).get("status"),
+                "error": swap_result.get("error"),
+                "swap_ms": (swap_report or {}).get("swap_ms"),
+                "handoffs": (swap_report or {}).get("handoffs"),
+                "fenced": (swap_report or {}).get("fenced"),
+                "warmup_compiles": (swap_report or {}).get(
+                    "warmup_compiles"),
+                "generation": deploy_stats["generation"],
+                "streams_during_swap": len(rows_in),
+                "ttft_p99_during_swap_ms": ttft_in,
+                "ttft_p99_steady_ms": ttft_out,
+            },
+            "engines": engines,
+            "retired_engines": retired,
+            "memory": memory,
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _deploy_bench_ok(report):
+    """Exit gate for the deploy profile: the full trace fires and every
+    stream ends OK (zero dropped), the ledger conserves and pools drain,
+    the swap commits generation 2 with streams observed finishing on
+    BOTH generations and none torn, zero steady-state recompiles on the
+    new AND the retired engines, zero leaked KV blocks (per-engine and
+    HBM-accountant-wide), and the swap-window TTFT p99 stays within the
+    declared ``swap_ttft_x`` of steady state."""
+    wl = report["workload"]
+    if wl["fired"] != wl["arrivals"]:
+        return False
+    if report["statuses"] != {"OK": wl["arrivals"]}:
+        return False
+    if not (report["conserved"] and report["pools_whole"]):
+        return False
+    swap = report["swap"]
+    if swap["status"] != "deployed" or swap["error"] is not None \
+            or swap["generation"] != 2:
+        return False
+    if report["torn_streams"] != 0:
+        return False
+    if report["ok_by_generation"][1] < 1 \
+            or report["ok_by_generation"][2] < 1:
+        return False
+    if not report["probes"]["bitwise"] \
+            or report["probes"]["generation"] != 2:
+        return False
+    if swap["streams_during_swap"] < 1:
+        return False
+    for snap in report["engines"].values():
+        if snap["steady_state_recompiles"] != 0 \
+                or snap["kv_leaked_blocks"]:
+            return False
+        if snap["generation"] != 2:
+            return False
+    for snap in report["retired_engines"].values():
+        if snap["steady_state_recompiles"] != 0:
+            return False
+    if not report["memory"]["balanced"]:
+        return False
+    if swap["ttft_p99_during_swap_ms"] is not None \
+            and swap["ttft_p99_steady_ms"] is not None \
+            and swap["ttft_p99_during_swap_ms"] > (
+                wl["swap_ttft_x"] * max(swap["ttft_p99_steady_ms"], 1.0)):
+        return False
+    return True
+
+
 def _main_sharded_decode(args, ap):
     if args.smoke:
         args.streams, args.slots = 12, 4
@@ -1359,6 +1722,52 @@ def _main_disagg(args, ap):
     return 0 if _disagg_ok(report) else 1
 
 
+def _main_deploy(args, ap):
+    if args.smoke:
+        args.slots = 4
+        args.block_size, args.max_prompt, args.max_new = 4, 8, 12
+        args.replicas = 2
+        # the trace must OUTLAST the swap (two engine warmups) so
+        # generation-2 traffic is organic, not just the probes
+        rate_hz, duration_s = 20.0, 3.5
+        model_cfg = dict(vocab_size=32, hidden=16, num_layers=1,
+                         num_heads=2, max_len=32, seed=7)
+    else:
+        if args.slots == ap.get_default("slots"):
+            args.slots = 4
+        if args.max_new == ap.get_default("max_new"):
+            args.max_new = 24
+        # the full-size swap is ~8 s (two 2-layer engine warmups + the
+        # retire drain); the trace must outlast it so generation-2
+        # traffic is organic, not just the probes
+        if args.duration_s == ap.get_default("duration_s"):
+            args.duration_s = 12.0
+        rate_hz, duration_s = args.rate_hz, args.duration_s
+        model_cfg = dict(vocab_size=48, hidden=32, num_layers=2,
+                         num_heads=2, max_len=128, seed=7)
+    report = run_deploy_bench(
+        rate_hz, duration_s, args.slots, args.block_size,
+        args.max_prompt, args.max_new, args.seed, model_cfg,
+        replicas=args.replicas, swap_ttft_x=args.swap_ttft_x,
+        time_scale=args.time_scale)
+    _write_artifact(report, args.out)
+    swap = report["swap"]
+    print("deploy: %d stream(s) all %s  by generation: %s  torn: %d"
+          % (report["workload"]["arrivals"], report["statuses"],
+             report["ok_by_generation"], report["torn_streams"]))
+    print("swap: %s gen %s in %s ms  handoffs: %d  fenced: %d  "
+          "warmup compiles: %s"
+          % (swap["status"], swap["generation"], swap["swap_ms"],
+             swap["handoffs"] or 0, swap["fenced"] or 0,
+             swap["warmup_compiles"]))
+    print("ttft p99: %s ms during swap (%d stream(s)) vs %s ms steady  "
+          "memory balanced: %s  wrote %s"
+          % (swap["ttft_p99_during_swap_ms"], swap["streams_during_swap"],
+             swap["ttft_p99_steady_ms"], report["memory"]["balanced"],
+             args.out))
+    return 0 if _deploy_bench_ok(report) else 1
+
+
 def _main_batch(args, ap):
     if args.smoke:
         args.clients, args.requests = 4, 6
@@ -1415,6 +1824,10 @@ PROFILES = {
         "artifact": "BENCH_DISAGG.json",
         "run": _main_disagg,
     },
+    "deploy": {
+        "artifact": "BENCH_DEPLOY.json",
+        "run": _main_deploy,
+    },
 }
 
 
@@ -1462,6 +1875,9 @@ def main(argv=None):
                     help="[disagg] p99 time-to-first-token SLO")
     ap.add_argument("--slo-tpot-ms", type=float, default=150.0,
                     help="[disagg] p99 time-per-output-token SLO")
+    ap.add_argument("--swap-ttft-x", type=float, default=5.0,
+                    help="[deploy] allowed TTFT p99 multiple during the "
+                         "swap window vs steady state")
     ap.add_argument("--out", default=None,
                     help="artifact path (default BENCH_SERVE.json / "
                          "BENCH_DECODE.json by profile)")
